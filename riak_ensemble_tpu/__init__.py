@@ -1,0 +1,40 @@
+"""riak_ensemble_tpu — a TPU-native multi-ensemble consensus framework.
+
+A ground-up re-design of the capabilities of riak_ensemble (an Erlang
+library of N independent Multi-Paxos consensus groups with per-key
+linearizable K/V operations, Merkle-tree integrity, leader leases, and
+gossip-based cluster management) for TPU hardware:
+
+- The *protocol math* — ballot transitions, quorum vote reduction, Merkle
+  hashing — runs as batched JAX kernels over an ``[ensembles, peers]``
+  array program (see :mod:`riak_ensemble_tpu.ops` and
+  :mod:`riak_ensemble_tpu.parallel`), sharded over a
+  ``jax.sharding.Mesh`` with vote collection as an ICI ``psum``.
+- The *orchestration* — timers, membership, supervision, disk — runs in a
+  deterministic Python host runtime (:mod:`riak_ensemble_tpu.runtime`)
+  with native C++ components for the monotonic clock and synctree
+  persistence (:mod:`riak_ensemble_tpu.utils.clock`, ``native/``).
+
+Layer map (mirrors SURVEY.md §1; reference files cited in each module):
+
+====  =======================  ============================================
+L0    platform/runtime         config, runtime, utils.clock
+L1    persistence              storage, save, synctree backends
+L2    integrity                synctree, peer_tree, exchange
+L3    communication/quorum     msg, router, ops.quorum
+L4    consensus core           peer, worker, lease, backend
+L5    cluster management       manager, root, state
+L6    client API               client
+--    batched TPU engine       parallel.engine, ops.ballot, ops.hash
+====  =======================  ============================================
+"""
+
+__version__ = "0.1.0"
+
+from riak_ensemble_tpu.types import (  # noqa: F401
+    Obj,
+    Fact,
+    PeerId,
+    EnsembleInfo,
+    NOTFOUND,
+)

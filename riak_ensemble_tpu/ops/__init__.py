@@ -1,0 +1,11 @@
+"""Jit-compiled protocol kernels: quorum reduction, ballot matrix
+transitions, Merkle hashing.  These are the TPU data path; the host
+runtime (:mod:`riak_ensemble_tpu.runtime`) drives them."""
+
+from riak_ensemble_tpu.ops.quorum import (  # noqa: F401
+    MET,
+    UNDECIDED,
+    NACK,
+    quorum_met,
+    quorum_met_batch,
+)

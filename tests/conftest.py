@@ -1,0 +1,19 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before any jax import (pytest loads conftest first).  Bench and
+production run on real TPU; tests exercise the multi-chip sharding paths
+on virtual CPU devices per the driver contract.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Make the repo root importable regardless of pytest invocation dir.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
