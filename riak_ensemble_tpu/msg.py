@@ -1,0 +1,243 @@
+"""Quorum fan-out + vote collection (host side).
+
+Mirrors ``src/riak_ensemble_msg.erl``.  Two paths:
+
+- **Non-blocking** (:func:`send_all` / :func:`handle_reply`): used from
+  inside the peer FSM (probe/prepare/prelead/commit).  Replies arrive
+  as ``('reply', reqid, peer, value)`` events on the peer; once quorum
+  is met the peer receives ``('quorum_met', valid_replies)``, on nack
+  or timeout ``('quorum_timeout', valid_replies)``
+  (msg.erl:85-97,336-366).
+- **Blocking** (:func:`blocking_send_all`): spawns a collector actor
+  (the analog of the collector process, msg.erl:196-237) and returns a
+  :class:`~riak_ensemble_tpu.runtime.Future` resolving to
+  ``('quorum_met', valid_replies)`` or ``('timeout', replies)``; K/V
+  worker tasks ``yield`` it (= ``wait_for_quorum``, msg.erl:319-332).
+
+``peers`` everywhere is a list of ``(peer_id, addr_or_None)``; a None
+address is an offline peer and gets a synthesized nack
+(msg.erl:132-142).  Requests carry a ``From = (dst_name, reqid)``;
+responders answer with :func:`reply`, so replies route to whichever
+actor issued the fan-out (peer or collector) — the reference's
+"reply directly to the caller" optimization falls out for free.
+
+The per-call quorum predicate is
+:func:`riak_ensemble_tpu.ops.quorum.quorum_met`; the batched engine
+runs the same predicate as the jitted kernel.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from riak_ensemble_tpu.ops.quorum import MET, NACK, UNDECIDED, quorum_met, \
+    find_valid
+from riak_ensemble_tpu.runtime import Actor, Future, Runtime, Timer
+
+_reqids = itertools.count(1)
+
+#: wire sentinel for negative votes
+NACK_REPLY = "nack"
+
+
+def make_from(owner_name: Any, reqid: int) -> Tuple[Any, int]:
+    return (owner_name, reqid)
+
+
+def reply(actor: Actor, from_: Tuple[Any, int], peer_id: Any,
+          value: Any) -> None:
+    """Route a reply back to the fan-out owner (msg.erl:180-182)."""
+    owner, reqid = from_
+    actor.send(owner, ("reply", reqid, peer_id, value))
+
+
+@dataclass
+class MsgState:
+    """Non-blocking vote-collection state embedded in the peer FSM
+    (``#msgstate{}``, msg.erl:44-49)."""
+
+    id: Any
+    awaiting: Optional[int] = None
+    timer: Optional[Timer] = None
+    required: str = "quorum"
+    views: Sequence[Sequence[Any]] = ()
+    replies: List[Tuple[Any, Any]] = field(default_factory=list)
+
+
+def _fan_out(actor: Actor, owner_name: Any, msg: Tuple, reqid: int,
+             peers, self_id) -> None:
+    from_ = make_from(owner_name, reqid)
+    request = msg + (from_,)
+    for peer_id, addr in peers:
+        if peer_id == self_id:
+            continue
+        if addr is None:
+            # Offline peer: synthesized nack (msg.erl:134-138).
+            actor.runtime.post(owner_name, ("reply", reqid, peer_id,
+                                            NACK_REPLY))
+        else:
+            actor.send(addr, request)
+
+
+def send_all(actor: Actor, msg: Tuple, self_id: Any, peers, views,
+             required: str = "quorum") -> MsgState:
+    """Fan out msg; replies flow back into the owning peer FSM.
+
+    Returns the MsgState the peer must keep in its state and thread
+    through :func:`handle_reply`.
+    """
+    if [p for p, _ in peers] == [self_id]:
+        # Singleton ensemble: trivially met (msg.erl:86-89).
+        actor.runtime.post(actor.name, ("quorum_met", []))
+        return MsgState(id=self_id)
+    reqid = next(_reqids)
+    _fan_out(actor, actor.name, msg, reqid, peers, self_id)
+    timer = actor.send_after(actor.config.quorum(), ("quorum_timeout_tick",
+                                                     reqid))
+    return MsgState(id=self_id, awaiting=reqid, timer=timer,
+                    required=required, views=views)
+
+
+def cast_all(actor: Actor, msg: Tuple, self_id: Any, peers) -> None:
+    """Fire-and-forget to all peers but self (msg.erl:101-106)."""
+    for peer_id, addr in peers:
+        if peer_id != self_id and addr is not None:
+            actor.send(addr, msg)
+
+
+def handle_reply(actor: Actor, reqid: int, peer: Any, value: Any,
+                 mstate: MsgState) -> MsgState:
+    """Accumulate one reply (msg.erl:336-359)."""
+    if reqid != mstate.awaiting:
+        return mstate
+    mstate.replies.append((peer, value))
+    met = quorum_met(mstate.replies, mstate.id, mstate.views, mstate.required)
+    if met == MET:
+        if mstate.timer:
+            mstate.timer.cancel()
+        valid, _ = find_valid(mstate.replies)
+        actor.runtime.post(actor.name, ("quorum_met", valid))
+        return MsgState(id=mstate.id)
+    if met == NACK:
+        if mstate.timer:
+            mstate.timer.cancel()
+        return quorum_timeout(actor, mstate)
+    return mstate
+
+
+def quorum_timeout(actor: Actor, mstate: MsgState) -> MsgState:
+    """Report failure with whatever valid replies arrived
+    (msg.erl:361-366)."""
+    valid, _ = find_valid(mstate.replies)
+    actor.runtime.post(actor.name, ("timeout", valid))
+    return MsgState(id=mstate.id)
+
+
+# ---------------------------------------------------------------------------
+# Blocking path: collector actor + future
+
+
+class _Collector(Actor):
+    """Stand-in for the collector process (msg.erl:212-317).
+
+    Resolution rules (matching check_enough/try_collect_all):
+      - quorum met, required != all_or_quorum  -> ('quorum_met', valid)
+      - quorum met, all_or_quorum              -> keep collecting up to
+        notfound_read_delay for *all* replies; resolve ('quorum_met',
+        valid-so-far) on all-met, first nack, or delay expiry.
+      - nack                                   -> ('timeout', replies)
+      - idle for one quorum interval           -> ('timeout', replies)
+    """
+
+    def __init__(self, runtime: Runtime, name, node, config, self_id, views,
+                 required, extra, future: Future) -> None:
+        super().__init__(runtime, name, node)
+        self.config = config
+        self.self_id = self_id
+        self.views = views
+        self.required = required
+        self.extra = extra
+        self.future = future
+        self.replies: List[Tuple[Any, Any]] = []
+        self.reqid: Optional[int] = None
+        self.phase = "collect"  # or "collect_all"
+        self.idle_timer: Optional[Timer] = None
+        self.all_timer: Optional[Timer] = None
+
+    def _arm_idle(self) -> None:
+        if self.idle_timer:
+            self.idle_timer.cancel()
+        self.idle_timer = self.send_after(self.config.quorum(),
+                                          ("idle_timeout",))
+
+    def _finish(self, result: Tuple) -> None:
+        self.future.resolve(result)
+        self.stop()
+
+    def on_stop(self) -> None:
+        for t in (self.idle_timer, self.all_timer):
+            if t:
+                t.cancel()
+
+    def handle(self, msg: Tuple) -> None:
+        kind = msg[0]
+        if kind == "reply":
+            _, reqid, peer, value = msg
+            if reqid != self.reqid:
+                return
+            self.replies.append((peer, value))
+            self._check()
+        elif kind == "idle_timeout":
+            if self.phase == "collect":
+                self._finish(("timeout", list(self.replies)))
+        elif kind == "all_timeout":
+            valid, _ = find_valid(self.replies)
+            self._finish(("quorum_met", valid))
+
+    def _check(self) -> None:
+        met = quorum_met(self.replies, self.self_id, self.views,
+                         self.required, extra=self.extra)
+        if self.phase == "collect_all":
+            # Waiting for all after quorum (try_collect_all_impl).
+            all_met = quorum_met(self.replies, self.self_id, self.views,
+                                 "all")
+            if all_met != UNDECIDED:
+                valid, _ = find_valid(self.replies)
+                self._finish(("quorum_met", valid))
+            return
+        self._arm_idle()
+        if met == MET:
+            if self.required == "all_or_quorum":
+                self.phase = "collect_all"
+                self.all_timer = self.send_after(
+                    self.config.notfound_read_delay, ("all_timeout",))
+            else:
+                valid, _ = find_valid(self.replies)
+                self._finish(("quorum_met", valid))
+        elif met == NACK:
+            self._finish(("timeout", list(self.replies)))
+
+
+_collector_ids = itertools.count(1)
+
+
+def blocking_send_all(actor: Actor, msg: Tuple, self_id: Any, peers, views,
+                      required: str = "quorum",
+                      extra: Optional[Callable] = None) -> Future:
+    """Fan out and return a Future for a worker task to yield on
+    (msg.erl:185-210 + wait_for_quorum:319-332)."""
+    future = Future()
+    others = [(p, a) for p, a in peers if p != self_id]
+    if not others:
+        future.resolve(("quorum_met", []))
+        return future
+    name = ("collector", next(_collector_ids))
+    collector = _Collector(actor.runtime, name, actor.node, actor.config,
+                           self_id, views, required, extra, future)
+    reqid = next(_reqids)
+    collector.reqid = reqid
+    _fan_out(collector, name, msg, reqid, peers, self_id)
+    collector._arm_idle()
+    return future

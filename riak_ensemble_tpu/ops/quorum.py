@@ -25,12 +25,18 @@ Two implementations with identical semantics:
   ``[E]`` ensemble batch with an ``[M]`` peer axis and ``[V, M]`` view
   membership masks.  This is the majority-reduce that rides ICI
   (``psum`` over the peer mesh axis) in the sharded engine.
+
+The two agree exactly for ``extra=None`` (differentially tested).  The
+``extra`` predicate (read-path hash-validity check) exists only on the
+scalar path by design: the batched engine's read kernel expresses the
+same check directly as array ops over its reply buffers
+(an arbitrary Python callable can't cross into jit).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Iterable, Sequence, Tuple
+from typing import Callable, Iterable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -48,12 +54,15 @@ REQUIRED_MODES = ("quorum", "all", "all_or_quorum", "other")
 def quorum_met(replies: Iterable[Tuple[object, object]],
                self_id: object,
                views: Sequence[Sequence[object]],
-               required: str = "quorum") -> int:
+               required: str = "quorum",
+               extra: "Optional[Callable[[list], bool]]" = None) -> int:
     """Scalar quorum predicate.
 
     ``replies`` is an iterable of ``(peer_id, reply)`` where a reply of
     the string ``'nack'`` is a negative vote.  Returns MET / UNDECIDED /
-    NACK.
+    NACK.  ``extra`` is an optional extra predicate on the replies,
+    evaluated only once every view has met (the recursion base case,
+    msg.erl:382-388) — used by the read path's hash-validity check.
     """
     assert required in REQUIRED_MODES, required
     replies = list(replies)
@@ -75,6 +84,8 @@ def quorum_met(replies: Iterable[Tuple[object, object]],
             return NACK
         if heard + len(nacks) == len(members):
             return NACK
+        return UNDECIDED
+    if extra is not None and not extra(replies):
         return UNDECIDED
     return MET
 

@@ -1,0 +1,320 @@
+"""Deterministic discrete-event host runtime.
+
+The reference runs on Erlang/OTP: peers are gen_fsm processes, quorum
+collectors and K/V FSMs are spawned processes, timers are
+``send_after``, and tests freeze processes with
+``erlang:suspend_process`` (``test/basic_test.erl:15-21``).  This module
+provides those capabilities as a seeded, virtual-time event simulator:
+
+- :class:`Actor` — addressable event handler bound to a (virtual) node.
+  Peers, managers, storage, and tree servers are actors.
+- :class:`Task` — a generator-based coroutine (the analog of a spawned
+  worker/collector process): ``yield future`` suspends until the future
+  resolves; ``yield runtime.sleep(d)`` sleeps.
+- :class:`Network` — delivery policy: per-message latency, partitions
+  (``test/sc.erl:1012-1036``), and a drop hook mirroring the
+  compiled-in drop table ``riak_ensemble_msg:maybe_send_request``
+  (``msg.erl:111-128``).
+- Suspension parity: a suspended actor's messages and timer firings are
+  backlogged and delivered in order on resume, like a suspended Erlang
+  process's mailbox.
+
+Everything is deterministic given the seed: the event queue is ordered
+by (time, insertion seq).  Virtual seconds run in microseconds of real
+time, so the integration suite exercises multi-second protocol
+timelines (elections, lease expiry, gossip convergence) instantly.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from typing import Any, Callable, Dict, Generator, List, Optional, Tuple
+
+
+class Future:
+    __slots__ = ("done", "value", "_waiters")
+
+    def __init__(self) -> None:
+        self.done = False
+        self.value: Any = None
+        self._waiters: List[Callable[[Any], None]] = []
+
+    def resolve(self, value: Any) -> None:
+        if self.done:
+            return
+        self.done = True
+        self.value = value
+        waiters, self._waiters = self._waiters, []
+        for w in waiters:
+            w(value)
+
+    def add_waiter(self, fn: Callable[[Any], None]) -> None:
+        if self.done:
+            fn(self.value)
+        else:
+            self._waiters.append(fn)
+
+
+class Timer:
+    __slots__ = ("cancelled", "fire_at")
+
+    def __init__(self, fire_at: float) -> None:
+        self.cancelled = False
+        self.fire_at = fire_at
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
+class Actor:
+    """Base class for addressable event handlers.
+
+    Subclasses implement :meth:`handle` (the gen_fsm/gen_server event
+    callback).  ``name`` is any hashable address; ``node`` scopes the
+    actor to a virtual node for partitions and node-down semantics.
+    """
+
+    def __init__(self, runtime: "Runtime", name: Any, node: str) -> None:
+        self.runtime = runtime
+        self.name = name
+        self.node = node
+        self.suspended = False
+        self.alive = True
+        self._backlog: List[Any] = []
+        runtime.register(self)
+
+    # -- messaging ---------------------------------------------------------
+
+    def send(self, dst: Any, msg: Any) -> None:
+        """Send over the (virtual) network from this actor's node."""
+        self.runtime.net_send(self.node, dst, msg)
+
+    def send_local(self, dst: Any, msg: Any) -> None:
+        """Same-node send: no network policy, but still async."""
+        self.runtime.post(dst, msg)
+
+    def send_after(self, delay: float, msg: Any) -> Timer:
+        """Timer message to self (erlang:send_after)."""
+        return self.runtime.send_after(delay, self.name, msg)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def handle(self, msg: Any) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def on_stop(self) -> None:
+        """Cleanup hook when the actor is stopped/killed."""
+
+    def stop(self) -> None:
+        self.runtime.stop_actor(self.name)
+
+    def _deliver(self, msg: Any) -> None:
+        if not self.alive:
+            return
+        if self.suspended:
+            self._backlog.append(msg)
+            return
+        self.handle(msg)
+
+
+class Task:
+    """Generator coroutine driven by the runtime.
+
+    The generator yields :class:`Future` objects; the runtime resumes it
+    with the future's value.  Yielding ``None`` re-schedules immediately
+    (a cooperative yield point).
+    """
+
+    __slots__ = ("gen", "runtime", "alive", "name")
+
+    def __init__(self, runtime: "Runtime", gen: Generator,
+                 name: str = "task") -> None:
+        self.runtime = runtime
+        self.gen = gen
+        self.alive = True
+        self.name = name
+
+    def kill(self) -> None:
+        if self.alive:
+            self.alive = False
+            self.gen.close()
+
+    def _step(self, send_value: Any) -> None:
+        if not self.alive:
+            return
+        try:
+            yielded = self.gen.send(send_value)
+        except StopIteration:
+            self.alive = False
+            return
+        if yielded is None:
+            self.runtime.defer(lambda: self._step(None))
+        elif isinstance(yielded, Future):
+            yielded.add_waiter(
+                lambda v: self.runtime.defer(lambda: self._step(v)))
+        else:  # pragma: no cover - programming error
+            raise TypeError(f"task {self.name} yielded {yielded!r}")
+
+
+class Network:
+    """Delivery policy between virtual nodes."""
+
+    def __init__(self, runtime: "Runtime") -> None:
+        self.runtime = runtime
+        #: set of frozenset({a, b}) pairs that cannot communicate
+        self.cut_links: set = set()
+        #: test drop hook: fn(src_node, dst_name, msg) -> bool (drop?)
+        self.drop_hook: Optional[Callable[[str, Any, Any], bool]] = None
+        self.min_latency = 1e-4
+        self.max_latency = 5e-4
+
+    def partition(self, group_a: List[str], group_b: List[str]) -> None:
+        """Cut all links between two node groups (sc.erl:1012-1022)."""
+        for a in group_a:
+            for b in group_b:
+                self.cut_links.add(frozenset((a, b)))
+
+    def heal(self) -> None:
+        self.cut_links.clear()
+
+    def can_reach(self, src: str, dst: str) -> bool:
+        return src == dst or frozenset((src, dst)) not in self.cut_links
+
+    def latency(self) -> float:
+        return self.runtime.rng.uniform(self.min_latency, self.max_latency)
+
+
+class Runtime:
+    def __init__(self, seed: int = 0) -> None:
+        self.now = 0.0
+        self.rng = random.Random(seed)
+        self._heap: List[Tuple[float, int, Callable[[], None]]] = []
+        self._seq = 0
+        self.actors: Dict[Any, Actor] = {}
+        self.net = Network(self)
+        self.trace: Optional[Callable[[str, Any], None]] = None
+
+    # -- registry ----------------------------------------------------------
+
+    def register(self, actor: Actor) -> None:
+        assert actor.name not in self.actors, f"duplicate actor {actor.name}"
+        self.actors[actor.name] = actor
+
+    def whereis(self, name: Any) -> Optional[Actor]:
+        return self.actors.get(name)
+
+    def stop_actor(self, name: Any) -> None:
+        actor = self.actors.pop(name, None)
+        if actor is not None:
+            actor.alive = False
+            actor.on_stop()
+
+    def suspend(self, name: Any) -> None:
+        """Freeze an actor (erlang:suspend_process analog)."""
+        self.actors[name].suspended = True
+
+    def resume(self, name: Any) -> None:
+        actor = self.actors[name]
+        if not actor.suspended:
+            return
+        actor.suspended = False
+        backlog, actor._backlog = actor._backlog, []
+        for msg in backlog:
+            self.post(actor.name, msg)
+
+    # -- scheduling --------------------------------------------------------
+
+    def _push(self, at: float, fn: Callable[[], None]) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, (at, self._seq, fn))
+
+    def defer(self, fn: Callable[[], None]) -> None:
+        """Run fn at the current time, after already-queued events."""
+        self._push(self.now, fn)
+
+    def schedule(self, delay: float, fn: Callable[[], None]) -> Timer:
+        timer = Timer(self.now + delay)
+
+        def fire() -> None:
+            if not timer.cancelled:
+                fn()
+
+        self._push(timer.fire_at, fire)
+        return timer
+
+    def send_after(self, delay: float, dst: Any, msg: Any) -> Timer:
+        return self.schedule(delay, lambda: self.post(dst, msg))
+
+    def sleep(self, delay: float) -> Future:
+        fut = Future()
+        self.schedule(delay, lambda: fut.resolve(None))
+        return fut
+
+    def with_timeout(self, fut: Future, timeout: float,
+                     timeout_value: Any = "timeout") -> Future:
+        """Future resolving to fut's value, or timeout_value after
+        `timeout` seconds (the gen_fsm call-timeout analog)."""
+        out = Future()
+        fut.add_waiter(out.resolve)
+        self.schedule(timeout, lambda: out.resolve(timeout_value))
+        return out
+
+    def post(self, dst: Any, msg: Any) -> None:
+        """Deliver msg to actor dst at the current time (local send)."""
+        def deliver() -> None:
+            actor = self.actors.get(dst)
+            if actor is not None:
+                if self.trace:
+                    self.trace("deliver", (dst, msg))
+                actor._deliver(msg)
+
+        self.defer(deliver)
+
+    def net_send(self, src_node: str, dst: Any, msg: Any) -> None:
+        """Network send with latency/partition/drop policy applied."""
+        actor = self.actors.get(dst)
+        dst_node = actor.node if actor is not None else None
+        if dst_node is not None and not self.net.can_reach(src_node, dst_node):
+            return
+        if self.net.drop_hook is not None and \
+                self.net.drop_hook(src_node, dst, msg):
+            return
+        delay = 0.0 if dst_node == src_node else self.net.latency()
+        self.send_after(delay, dst, msg)
+
+    def spawn_task(self, gen: Generator, name: str = "task") -> Task:
+        task = Task(self, gen, name)
+        self.defer(lambda: task._step(None))
+        return task
+
+    # -- execution ---------------------------------------------------------
+
+    def run_for(self, duration: float) -> None:
+        self.run_until_time(self.now + duration)
+
+    def run_until_time(self, deadline: float) -> None:
+        while self._heap and self._heap[0][0] <= deadline:
+            at, _, fn = heapq.heappop(self._heap)
+            self.now = max(self.now, at)
+            fn()
+        self.now = max(self.now, deadline)
+
+    def run_until(self, pred: Callable[[], bool], max_time: float = 60.0,
+                  poll: float = 0.01) -> bool:
+        """Advance until pred() is true (checked every `poll` virtual
+        seconds); returns False on virtual-time budget exhaustion."""
+        deadline = self.now + max_time
+        while self.now < deadline:
+            if pred():
+                return True
+            self.run_until_time(min(self.now + poll, deadline))
+        return pred()
+
+    def await_future(self, fut: Future, timeout: float = 60.0) -> Any:
+        """Drive the loop until fut resolves (external/test entry point).
+        Raises TimeoutError on virtual-time timeout."""
+        ok = self.run_until(lambda: fut.done, max_time=timeout, poll=0.001)
+        if not ok:
+            raise TimeoutError("future not resolved in virtual time budget")
+        return fut.value

@@ -1,0 +1,72 @@
+"""Paranoid blob persistence: 4 CRC-framed copies across 2 files.
+
+Mirrors ``src/riak_ensemble_save.erl``: each file holds
+``[CRC:32][Size:32][Data]`` (forward copy) followed by
+``[Data][CRC:32][Size:32]`` (trailing copy, read back-to-front); the
+same image is written to ``<file>`` and ``<file>.backup``
+(save.erl:31-47).  Read tries forward copy, trailing copy, then the
+backup file (save.erl:49-98).  Writes go through tmp+fsync+rename with
+read-back verification (riak_ensemble_util:replace_file, util.erl:36-50).
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+from typing import Optional
+
+
+def _crc(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _replace_file(path: str, payload: bytes) -> None:
+    """tmp + fsync + rename + read-back verify (util.erl:36-50)."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, path)
+    with open(path, "rb") as f:
+        assert f.read() == payload, f"read-back verify failed for {path}"
+
+
+def write(path: str, data: bytes) -> None:
+    meta = _crc(data).to_bytes(4, "big") + len(data).to_bytes(4, "big")
+    payload = meta + data + data + meta
+    _replace_file(path, payload)
+    _replace_file(path + ".backup", payload)
+
+
+def _safe_read(raw: bytes) -> Optional[bytes]:
+    # Forward copy: CRC, size, data.
+    if len(raw) >= 8:
+        crc = int.from_bytes(raw[0:4], "big")
+        size = int.from_bytes(raw[4:8], "big")
+        data = raw[8:8 + size]
+        if len(data) == size and _crc(data) == crc:
+            return data
+    # Trailing copy: ...data, CRC, size at the very end.
+    if len(raw) > 8:
+        crc = int.from_bytes(raw[-8:-4], "big")
+        size = int.from_bytes(raw[-4:], "big")
+        if size <= len(raw) - 8:
+            data = raw[-8 - size:-8]
+            if _crc(data) == crc:
+                return data
+    return None
+
+
+def read(path: str) -> Optional[bytes]:
+    for p in (path, path + ".backup"):
+        try:
+            with open(p, "rb") as f:
+                raw = f.read()
+        except OSError:
+            continue
+        data = _safe_read(raw)
+        if data is not None:
+            return data
+    return None

@@ -1,0 +1,11 @@
+"""Integrity subsystem: self-validating Merkle hash trie.
+
+Python/host implementation of the reference's ``synctree.erl`` family
+(always-up-to-date, verify-on-every-access trie; see
+``src/synctree.erl:44-73`` for the design rationale) plus the
+tree-server actor and the peer-to-peer exchange driver.  The batched
+device-side Merkle kernel lives in :mod:`riak_ensemble_tpu.ops.hash`.
+"""
+
+from riak_ensemble_tpu.synctree.tree import SyncTree, Corrupted, NONE  # noqa: F401
+from riak_ensemble_tpu.synctree.peer_tree import PeerTree  # noqa: F401

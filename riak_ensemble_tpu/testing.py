@@ -1,0 +1,196 @@
+"""Integration-test harness: multi-peer ensembles in one host process.
+
+The reference's central test trick (``test/ens_test.erl:31-45``) is to
+run a whole ensemble on ONE Erlang node — peers are just processes.
+Here peers are actors in one deterministic virtual-time runtime, so a
+multi-second protocol timeline (election, lease expiry, failover) runs
+in milliseconds and is reproducible from the seed.
+
+Fault-injection surface (SURVEY §4 parity):
+- ``suspend_peer``/``resume_peer``: erlang:suspend_process analog
+  (test/basic_test.erl:15-21).
+- ``runtime.net.drop_hook`` / ``partition``: message dropping
+  (riak_ensemble_msg maybe_drop; sc.erl partitions).
+- backend subclasses dropping puts; tree corruption via
+  ``tree_of(...).tree.corrupt(...)`` (synctree intercepts).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from riak_ensemble_tpu import peer as peerlib
+from riak_ensemble_tpu.config import Config, fast_test_config
+from riak_ensemble_tpu.directory import StaticDirectory
+from riak_ensemble_tpu.peer import (
+    Peer, do_kmodify, do_kput_once, do_kupdate, peer_name, sync_send_event,
+)
+from riak_ensemble_tpu.runtime import Runtime
+from riak_ensemble_tpu.storage import Storage
+from riak_ensemble_tpu.types import NOTFOUND, Obj, PeerId
+
+
+class Cluster:
+    def __init__(self, seed: int = 0, config: Optional[Config] = None,
+                 data_root: Optional[str] = None) -> None:
+        self.runtime = Runtime(seed)
+        self.config = config if config is not None else fast_test_config()
+        self.directory = StaticDirectory(self.runtime)
+        self.data_root = data_root
+        self.storages: Dict[str, Storage] = {}
+
+    def storage(self, node: str) -> Storage:
+        if node not in self.storages:
+            root = (f"{self.data_root}/{node}" if self.data_root else None)
+            self.storages[node] = Storage(self.runtime, node, self.config,
+                                          root)
+        return self.storages[node]
+
+    # -- ensemble lifecycle ------------------------------------------------
+
+    def create_ensemble(self, ensemble: Any, peer_ids: Sequence[PeerId],
+                        backend: str = "basic", **peer_kw) -> List[Peer]:
+        views = (tuple(peer_ids),)
+        peers = []
+        for pid in peer_ids:
+            peers.append(self.start_peer(ensemble, pid, views, backend,
+                                         **peer_kw))
+        return peers
+
+    def start_peer(self, ensemble, pid: PeerId, views=None,
+                   backend: str = "basic", **peer_kw) -> Peer:
+        p = Peer(self.runtime, ensemble, pid, self.config, self.directory,
+                 self.storage(pid.node), backend=backend,
+                 initial_views=views, **peer_kw)
+        self.directory.register_peer(ensemble, pid, p.name)
+        return p
+
+    def peer(self, ensemble, pid: PeerId) -> Optional[Peer]:
+        return self.runtime.whereis(peer_name(ensemble, pid))
+
+    def tree_of(self, ensemble, pid: PeerId):
+        return self.runtime.whereis(peerlib.tree_name(ensemble, pid))
+
+    # -- convergence -------------------------------------------------------
+
+    def leader_id(self, ensemble) -> Optional[PeerId]:
+        """The live leader: a non-suspended peer in `leading` state.
+        (A suspended ex-leader is still frozen in `leading`; among
+        multiple claimants the highest epoch is the real one.)"""
+        best = None
+        for actor in list(self.runtime.actors.values()):
+            if isinstance(actor, Peer) and actor.ensemble == ensemble \
+                    and actor.fsm_state == "leading" \
+                    and not actor.suspended:
+                if best is None or actor.epoch > best.epoch:
+                    best = actor
+        return best.id if best else None
+
+    def leader(self, ensemble) -> Optional[Peer]:
+        lid = self.leader_id(ensemble)
+        return self.peer(ensemble, lid) if lid else None
+
+    def wait_leader(self, ensemble, max_time: float = 60.0) -> PeerId:
+        ok = self.runtime.run_until(
+            lambda: self.leader_id(ensemble) is not None, max_time)
+        assert ok, f"no leader for {ensemble} in {max_time}s virtual"
+        return self.leader_id(ensemble)
+
+    def wait_stable(self, ensemble, max_time: float = 60.0) -> PeerId:
+        """ens_test:wait_stable (ens_test.erl:47-66): poll until a
+        leader exists, its tree is ready, and check_quorum succeeds
+        (retried — a stale claimant mid-step-down may answer first)."""
+        deadline = self.runtime.now + max_time
+        while self.runtime.now < deadline:
+            ldr = self.leader(ensemble)
+            if ldr is None or not ldr.tree_ready:
+                self.runtime.run_for(0.05)
+                continue
+            if self.check_quorum(ensemble) == "ok":
+                lid = self.leader_id(ensemble)
+                if lid is not None:
+                    return lid
+            self.runtime.run_for(0.05)
+        raise AssertionError(f"{ensemble} not stable in {max_time}s virtual")
+
+    def check_quorum(self, ensemble, timeout: float = 10.0):
+        lid = self.leader_id(ensemble)
+        if lid is None:
+            return "timeout"
+        return sync_send_event(self.runtime, peer_name(ensemble, lid),
+                               ("check_quorum",), timeout)
+
+    # -- fault injection ---------------------------------------------------
+
+    def suspend_peer(self, ensemble, pid: PeerId) -> None:
+        self.runtime.suspend(peer_name(ensemble, pid))
+
+    def resume_peer(self, ensemble, pid: PeerId) -> None:
+        self.runtime.resume(peer_name(ensemble, pid))
+
+    # -- K/V surface (client-level ops against an ensemble) ---------------
+
+    def _target(self, ensemble):
+        lid = self.leader_id(ensemble) or self.directory.get_leader(ensemble)
+        assert lid is not None, "no known leader"
+        return peer_name(ensemble, lid)
+
+    def kget(self, ensemble, key, timeout: float = 10.0, opts=()):
+        return sync_send_event(self.runtime, self._target(ensemble),
+                               ("get", key, tuple(opts)), timeout)
+
+    def kover(self, ensemble, key, value, timeout: float = 10.0):
+        return sync_send_event(self.runtime, self._target(ensemble),
+                               ("overwrite", key, value), timeout)
+
+    def kput_once(self, ensemble, key, value, timeout: float = 10.0):
+        return sync_send_event(self.runtime, self._target(ensemble),
+                               ("put", key, do_kput_once, [value]), timeout)
+
+    def kupdate(self, ensemble, key, current: Obj, new, timeout=10.0):
+        return sync_send_event(self.runtime, self._target(ensemble),
+                               ("put", key, do_kupdate, [current, new]),
+                               timeout)
+
+    def kmodify(self, ensemble, key, mod_fun, default, timeout=10.0):
+        return sync_send_event(self.runtime, self._target(ensemble),
+                               ("put", key, do_kmodify, [mod_fun, default]),
+                               timeout)
+
+    def kdelete(self, ensemble, key, timeout: float = 10.0):
+        return self.kover(ensemble, key, NOTFOUND, timeout)
+
+    def ksafe_delete(self, ensemble, key, current: Obj, timeout=10.0):
+        return self.kupdate(ensemble, key, current, NOTFOUND, timeout)
+
+    def update_members(self, ensemble, changes, timeout: float = 20.0):
+        return sync_send_event(self.runtime, self._target(ensemble),
+                               ("update_members", tuple(changes)), timeout)
+
+    # -- assertion helpers -------------------------------------------------
+
+    def kput_ok(self, ensemble, key, value):
+        result = self.kover(ensemble, key, value)
+        assert isinstance(result, tuple) and result[0] == "ok", result
+        return result[1]
+
+    def kget_value(self, ensemble, key):
+        result = self.kget(ensemble, key)
+        assert isinstance(result, tuple) and result[0] == "ok", result
+        return result[1].value
+
+    def read_until(self, ensemble, key, expect, max_time: float = 30.0):
+        """drop_write_test's read_until: retry reads until the expected
+        value is visible (healed)."""
+        def check():
+            r = self.kget(ensemble, key)
+            return isinstance(r, tuple) and r[0] == "ok" and \
+                r[1].value == expect
+        ok = self.runtime.run_until(check, max_time, poll=0.1)
+        assert ok, f"value {expect!r} for {key!r} not visible"
+
+
+def make_peers(n: int, n_nodes: Optional[int] = None) -> List[PeerId]:
+    """Peer ids spread over nodes (node per peer by default)."""
+    n_nodes = n_nodes if n_nodes is not None else n
+    return [PeerId(i, f"node{i % n_nodes}") for i in range(n)]

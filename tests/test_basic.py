@@ -1,0 +1,98 @@
+"""basic_test.erl parity: 3-peer ensemble, put/get, leader suspension,
+re-election, get again (test/basic_test.erl:5-24) — the minimum-slice
+acceptance test — plus singleton-ensemble and kv-op coverage."""
+
+import pytest
+
+from riak_ensemble_tpu.testing import Cluster, make_peers
+from riak_ensemble_tpu.types import NOTFOUND
+
+
+def test_singleton_ensemble():
+    c = Cluster(seed=1)
+    (pid,) = make_peers(1)
+    c.create_ensemble("ens", [pid])
+    leader = c.wait_stable("ens")
+    assert leader == pid
+    c.kput_ok("ens", "k", b"v1")
+    assert c.kget_value("ens", "k") == b"v1"
+
+
+def test_basic_three_peers():
+    c = Cluster(seed=2)
+    peers = make_peers(3)
+    c.create_ensemble("ens", peers)
+    leader = c.wait_stable("ens")
+
+    c.kput_ok("ens", "test", b"current")
+    assert c.kget_value("ens", "test") == b"current"
+
+    # Suspend the leader; a new one must take over.
+    c.suspend_peer("ens", leader)
+    c.runtime.run_for(0.1)
+
+    def new_leader():
+        lid = c.leader_id("ens")
+        return lid is not None and lid != leader
+    assert c.runtime.run_until(new_leader, 60.0), "no re-election"
+    c.wait_stable("ens")
+    assert c.leader_id("ens") != leader
+
+    # Value survives the failover.
+    assert c.kget_value("ens", "test") == b"current"
+
+    # Resume the old leader; it must rejoin as follower/catch up, and
+    # the ensemble keeps serving.
+    c.resume_peer("ens", leader)
+    c.runtime.run_for(2.0)
+    c.kput_ok("ens", "test", b"updated")
+    assert c.kget_value("ens", "test") == b"updated"
+
+
+def test_kget_notfound_skips_tombstone():
+    c = Cluster(seed=3)
+    c.create_ensemble("ens", make_peers(3))
+    c.wait_stable("ens")
+    r = c.kget("ens", "missing")
+    assert r[0] == "ok" and r[1].value is NOTFOUND
+
+
+def test_kput_once_and_update():
+    c = Cluster(seed=4)
+    c.create_ensemble("ens", make_peers(3))
+    c.wait_stable("ens")
+
+    r = c.kput_once("ens", "k", b"a")
+    assert r[0] == "ok"
+    # Second put_once fails the precondition.
+    assert c.kput_once("ens", "k", b"b") == "failed"
+
+    cur = c.kget("ens", "k")[1]
+    r = c.kupdate("ens", "k", cur, b"c")
+    assert r[0] == "ok"
+    assert c.kget_value("ens", "k") == b"c"
+
+    # Stale CAS (old version) fails.
+    assert c.kupdate("ens", "k", cur, b"d") == "failed"
+
+
+def test_kmodify_and_delete():
+    c = Cluster(seed=5)
+    c.create_ensemble("ens", make_peers(3))
+    c.wait_stable("ens")
+
+    r = c.kmodify("ens", "ctr", lambda vsn, v: v + 1, 0)
+    assert r[0] == "ok" and r[1].value == 1
+    r = c.kmodify("ens", "ctr", lambda vsn, v: v + 1, 0)
+    assert r[0] == "ok" and r[1].value == 2
+
+    c.kdelete("ens", "ctr")
+    got = c.kget("ens", "ctr")
+    assert got[0] == "ok" and got[1].value is NOTFOUND
+
+    # safe delete: CAS on current version
+    c.kput_ok("ens", "d", b"x")
+    cur = c.kget("ens", "d")[1]
+    r = c.ksafe_delete("ens", "d", cur)
+    assert r[0] == "ok"
+    assert c.kget("ens", "d")[1].value is NOTFOUND

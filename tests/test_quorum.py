@@ -135,3 +135,36 @@ class TestBatchedDifferential:
             replies += [(peers[i], "nack") for i in range(M) if nack[e, i]]
             assert int(out[e]) == quorum_met(replies, peers[0],
                                              [peers], "quorum")
+
+
+class TestExtraCheck:
+    def test_extra_gates_met(self):
+        # extra evaluated only once all views met (msg.erl:382-388);
+        # False maps to UNDECIDED (keep collecting), never NACK.
+        views = [[P(0), P(1), P(2)]]
+        replies = [(P(1), "obj")]
+        assert quorum_met(replies, P(0), views, "quorum",
+                          extra=lambda rs: False) == UNDECIDED
+        assert quorum_met(replies, P(0), views, "quorum",
+                          extra=lambda rs: True) == MET
+
+    def test_extra_not_consulted_before_views_met(self):
+        views = [[P(0), P(1), P(2)]]
+        calls = []
+
+        def extra(rs):
+            calls.append(rs)
+            return True
+
+        assert quorum_met([], P(0), views, "quorum", extra=extra) == UNDECIDED
+        assert calls == []
+
+    def test_extra_receives_all_replies_unfiltered(self):
+        # The reference passes the full reply list (incl. non-members
+        # and nacks) to Extra (msg.erl:382-388).
+        views = [[P(0), P(1)]]
+        replies = [(P(1), "obj"), (P(9), "stranger"), (P(1), "nack")]
+        seen = []
+        quorum_met(replies, P(0), views, "quorum",
+                   extra=lambda rs: seen.append(list(rs)) or True)
+        assert seen and seen[0] == replies
