@@ -1,0 +1,385 @@
+"""Batched consensus engine — the vmapped ballot matrix.
+
+The reference runs one Erlang gen_fsm process per peer per ensemble
+(``src/riak_ensemble_peer.erl``); independent consensus groups are the
+parallelism axis (SURVEY §2.7).  Here that axis is literal: the ballot
+state of E ensembles x M peers lives in device arrays, and the protocol
+transitions are jitted array kernels:
+
+- :func:`elect_step` — batched leader election: phase-1 prepare
+  (``prepare/2``, peer.erl:579-596; NextEpoch = epoch+1, :877-885) and
+  phase-2 new_epoch (``prelead/2``, :609-620) fused into one kernel,
+  with the quorum predicate of ``riak_ensemble_msg:quorum_met/5``
+  (msg.erl:377-418) as a masked majority-reduce.
+- :func:`kv_step` — batched steady-state K/V data path: the leased
+  local read (``do_get_fsm`` fast path, peer.erl:1460-1462,1493-1516),
+  the quorum epoch-check read (``check_epoch`` round, :1493-1516), the
+  quorum replicated write (``put_obj``: local put + blocking_send_all
+  {put,...} + wait_for_quorum, peer.erl:1669-1698), the quorum
+  latest-object read (``get_latest_obj``, :1623-1662) and the
+  stale-epoch rewrite (``update_key``, :1564-1596) — the
+  "thundering herd" of first-touch rewrites after an election is
+  batched across all ensembles in one kernel step (SURVEY §7).
+- :func:`kv_step_scan` — K sequential ops per ensemble per launch via
+  ``lax.scan`` (amortizes dispatch; per-key serialization analog of the
+  key-hashed worker pool, peer.erl:1220-1225).
+
+Peer-axis reductions go through :func:`quorum.reduce_peers` / :func:`_pmax`, which
+lower to ``jax.lax.psum``/``pmax`` over a mesh axis when ``axis_name``
+is given — under ``shard_map`` over a ``('ens', 'peer')`` mesh the vote
+count literally rides the ICI all-reduce (see
+:mod:`riak_ensemble_tpu.parallel.mesh`).  Host-side concerns — timers,
+leases (monotonic clock), failure detection, membership gossip — stay
+in the host runtime; the ``up`` and ``lease_ok`` masks are how the host
+injects them into the kernels.
+
+All integers are int32 (TPU-native; x64 stays disabled).  Object
+payloads are int32 handles — real values live in the host/backend
+object store keyed by (slot, epoch, seq); the device arrays carry the
+version discipline, which is what consensus is about.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from riak_ensemble_tpu.ops import quorum as quorum_lib
+from riak_ensemble_tpu.ops.quorum import (
+    quorum_met_batch, reduce_peers, views_to_mask,
+)
+
+# Op kinds for kv_step.
+OP_NOOP = 0
+OP_GET = 1
+OP_PUT = 2
+
+
+class EngineState(NamedTuple):
+    """Ballot + replicated-store state for E ensembles x M peers.
+
+    Leading axes: E (ensemble) shardable over mesh axis 'ens', M (peer)
+    shardable over mesh axis 'peer'.  With sharded M, each shard holds
+    its local peer slice; ``leader``/``obj_seq_ctr`` are replicated
+    along 'peer'.
+    """
+
+    epoch: jax.Array        # [E, M] int32  per-peer current epoch
+    fact_seq: jax.Array     # [E, M] int32  per-peer fact seq
+    leader: jax.Array       # [E]    int32  global leader peer idx, -1 none
+    view_mask: jax.Array    # [E, V, M] bool  joint-consensus views
+    obj_seq_ctr: jax.Array  # [E]    int32  leader per-epoch obj counter
+    obj_epoch: jax.Array    # [E, M, S] int32  replica store: obj epochs
+    obj_seq: jax.Array      # [E, M, S] int32  replica store: obj seqs
+    obj_val: jax.Array      # [E, M, S] int32  replica store: payloads
+
+
+class KvResult(NamedTuple):
+    committed: jax.Array   # [E] bool  put (or rewrite) reached quorum
+    get_ok: jax.Array      # [E] bool  read served (lease or epoch quorum)
+    found: jax.Array       # [E] bool  read found an object
+    value: jax.Array       # [E] int32 read payload (0 if not found)
+    obj_vsn: jax.Array     # [E, 2] int32 (epoch, seq) of the read/put obj
+
+
+def init_state(n_ensembles: int, n_peers: int, n_slots: int,
+               n_views: int = 2,
+               views: Optional[Sequence[Sequence[int]]] = None) -> EngineState:
+    """Fresh state: no leader, epoch 0, empty stores.
+
+    ``views`` is a list of views (each a list of global peer indices)
+    applied to every ensemble; default one view of all peers.
+    """
+    e, m, s, v = n_ensembles, n_peers, n_slots, n_views
+    if views is None:
+        vm = np.zeros((v, m), dtype=bool)
+        vm[0, :] = True
+    else:
+        assert len(views) <= v
+        vm = views_to_mask(views, v, m)
+    return EngineState(
+        epoch=jnp.zeros((e, m), jnp.int32),
+        fact_seq=jnp.zeros((e, m), jnp.int32),
+        leader=jnp.full((e,), -1, jnp.int32),
+        view_mask=jnp.broadcast_to(jnp.asarray(vm), (e, v, m)),
+        obj_seq_ctr=jnp.zeros((e,), jnp.int32),
+        obj_epoch=jnp.zeros((e, m, s), jnp.int32),
+        obj_seq=jnp.zeros((e, m, s), jnp.int32),
+        obj_val=jnp.zeros((e, m, s), jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Peer-axis reductions (ICI collectives under shard_map)
+
+
+def _pmax(x: jax.Array, axis_name: Optional[str]) -> jax.Array:
+    m = x.max(-1)
+    if axis_name is not None:
+        m = jax.lax.pmax(m, axis_name)
+    return m
+
+
+def _global_peer_idx(m_local: int, axis_name: Optional[str]) -> jax.Array:
+    """Global peer indices of the local peer slice ([M_local] int32)."""
+    idx = jnp.arange(m_local, dtype=jnp.int32)
+    if axis_name is not None:
+        idx = idx + jax.lax.axis_index(axis_name).astype(jnp.int32) * m_local
+    return idx
+
+
+def _quorum_met(ack: jax.Array, heard: jax.Array, view_mask: jax.Array,
+                axis_name: Optional[str]) -> jax.Array:
+    """Majority in EVERY active view (msg.erl:377-418), via the shared
+    batched predicate :func:`quorum.quorum_met_batch`.
+
+    ack [E, Ml] bool (epoch-matching up members — the caller's own vote
+    is already included, so self_idx=-1); heard [E, Ml] bool (up
+    members — heard-but-not-acking peers are nacks); view_mask
+    [E, V, Ml] bool -> [E] bool.
+    """
+    res = quorum_met_batch(
+        ack, heard & ~ack, view_mask,
+        jnp.full(ack.shape[:-1], -1, jnp.int32),
+        required="quorum", axis_name=axis_name)
+    return res == quorum_lib.MET
+
+
+def _latest_at_slot(state: EngineState, slot_oh: jax.Array,
+                    heard: jax.Array, axis_name: Optional[str]
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Batched ``get_latest_obj`` (peer.erl:1623-1662): the newest
+    (epoch, seq) object at a slot among the heard member replicas, via
+    a three-stage masked max-reduce over the peer axis.
+
+    Returns (epoch [E], seq [E], val [E], found [E]).
+    """
+    sel = slot_oh[:, None, :]                                # [E, 1, S]
+    pe = (state.obj_epoch * sel).sum(-1)                     # [E, Ml]
+    ps = (state.obj_seq * sel).sum(-1)
+    pv = (state.obj_val * sel).sum(-1)
+    exists = ps > 0                                          # seq>=1 once written
+    h = heard & exists
+    neg = jnp.int32(-1)
+    emax = _pmax(jnp.where(h, pe, neg), axis_name)           # [E]
+    smax = _pmax(jnp.where(h & (pe == emax[:, None]), ps, neg), axis_name)
+    on_max = h & (pe == emax[:, None]) & (ps == smax[:, None])
+    vmax = _pmax(jnp.where(on_max, pv, jnp.iinfo(jnp.int32).min), axis_name)
+    found = smax > 0
+    return (jnp.maximum(emax, 0), jnp.maximum(smax, 0),
+            jnp.where(found, vmax, 0), found)
+
+
+# ---------------------------------------------------------------------------
+# Election kernel
+
+
+@functools.partial(jax.jit, static_argnames=("axis_name",))
+def elect_step(state: EngineState, elect: jax.Array, cand: jax.Array,
+               up: jax.Array, axis_name: Optional[str] = None
+               ) -> Tuple[EngineState, jax.Array]:
+    """Batched two-phase leader election for the ensembles in ``elect``.
+
+    elect [E] bool — run an election in this ensemble this step.
+    cand  [E] int32 — global peer index of the candidate (the reference
+        picks whichever peer's randomized election timer fires first,
+        peer.erl:493-505; the host supplies that choice).
+    up    [E, Ml] bool — host availability mask (down/suspended peers
+        never ack; the analog of synthesized nacks, msg.erl:134-138).
+
+    Phase 1 (prepare, peer.erl:579-588): NextEpoch = max(epochs)+1;
+    member peers with epoch < NextEpoch ack with their fact.  Phase 2
+    (prelead new_epoch, :609-620): on quorum, members adopt NextEpoch,
+    fact seq resets to 0, per-epoch obj counter resets (local_commit
+    resets obj_seq, peer.erl:891-909).  Returns (state', elected [E]).
+    """
+    e, ml = state.epoch.shape
+    gidx = _global_peer_idx(ml, axis_name)
+    member = state.view_mask.any(1)                          # [E, Ml]
+    heard = up & member
+    next_epoch = _pmax(jnp.where(heard, state.epoch, -1), axis_name) + 1
+    ack = heard & (state.epoch < next_epoch[:, None])
+    # The candidate must itself be an up member (it leads the round);
+    # a host race handing in a dead/non-member candidate must not
+    # produce a leader whose replica never adopted the new epoch.
+    cand_heard = reduce_peers(
+        ((gidx[None, :] == cand[:, None]) & heard).astype(jnp.int32),
+        axis_name) > 0
+    won = (_quorum_met(ack, heard, state.view_mask, axis_name)
+           & elect & (cand >= 0) & cand_heard)
+
+    adopt = won[:, None] & heard                             # [E, Ml]
+    epoch = jnp.where(adopt, next_epoch[:, None], state.epoch)
+    fact_seq = jnp.where(adopt, 0, state.fact_seq)
+    leader = jnp.where(won, cand, state.leader)
+    obj_seq_ctr = jnp.where(won, 0, state.obj_seq_ctr)
+    return state._replace(epoch=epoch, fact_seq=fact_seq, leader=leader,
+                          obj_seq_ctr=obj_seq_ctr), won
+
+
+# ---------------------------------------------------------------------------
+# K/V kernel
+
+
+class _KvCtx(NamedTuple):
+    """Loop-invariant K/V round context.
+
+    Everything here depends only on ballot state (epoch/leader/views)
+    and the ``up`` mask — none of which a K/V round mutates — so a
+    scan of K rounds computes it (and its ~4 peer-axis collectives)
+    exactly once (kv_step_scan).
+    """
+
+    heard: jax.Array       # [E, Ml] up members
+    has_leader: jax.Array  # [E]
+    lead_epoch: jax.Array  # [E] proposal epoch (leader's epoch)
+    epoch_ok: jax.Array    # [E] epoch-check round reached quorum
+
+
+def _kv_context(state: EngineState, up: jax.Array,
+                axis_name: Optional[str]) -> _KvCtx:
+    e, ml = state.epoch.shape
+    gidx = _global_peer_idx(ml, axis_name)                   # [Ml]
+    is_leader = gidx[None, :] == state.leader[:, None]       # [E, Ml]
+    has_leader = state.leader >= 0                           # [E]
+    member = state.view_mask.any(1)
+    heard = up & member
+    # Leader's epoch, replicated to every shard (the proposal epoch).
+    lead_epoch = reduce_peers(jnp.where(is_leader, state.epoch, 0),
+                              axis_name)
+    # Epoch-check acks: shared by put replication and non-leased reads.
+    ack = heard & (state.epoch == lead_epoch[:, None])
+    epoch_ok = (_quorum_met(ack, heard, state.view_mask, axis_name)
+                & has_leader)
+    return _KvCtx(heard=heard, has_leader=has_leader,
+                  lead_epoch=lead_epoch, epoch_ok=epoch_ok)
+
+
+def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
+              slot: jax.Array, val: jax.Array, lease_ok: jax.Array,
+              axis_name: Optional[str]) -> Tuple[EngineState, KvResult]:
+    """One K/V protocol round given a precomputed context."""
+    s = state.obj_epoch.shape[-1]
+    heard, has_leader = ctx.heard, ctx.has_leader
+    lead_epoch, epoch_ok = ctx.lead_epoch, ctx.epoch_ok
+
+    is_put = kind == OP_PUT
+    is_get = kind == OP_GET
+    slot_valid = (slot >= 0) & (slot < s)
+
+    # Read: newest object among heard replicas.
+    slot_oh = (jnp.arange(s, dtype=jnp.int32)[None, :]
+               == slot[:, None]).astype(jnp.int32)
+    rd_epoch, rd_seq, rd_val, found = _latest_at_slot(
+        state, slot_oh, heard, axis_name)
+
+    get_gate = is_get & has_leader & (lease_ok | epoch_ok)
+    # Stale-epoch rewrite (update_key): needs the quorum either way.
+    rewrite = get_gate & found & (rd_epoch != lead_epoch) & epoch_ok
+    get_ok = get_gate & (~(found & (rd_epoch != lead_epoch)) | rewrite)
+
+    # Write path (shared by put and rewrite).
+    new_seq = state.obj_seq_ctr + 1                          # [E]
+    put_commit = is_put & epoch_ok & slot_valid
+    commit = put_commit | rewrite
+    wval = jnp.where(is_put, val, rd_val)                    # [E]
+    do_write = commit[:, None] & heard                       # [E, Ml]
+    wmask = (do_write[:, :, None] & (slot_oh[:, None, :] > 0))
+    obj_epoch = jnp.where(wmask, lead_epoch[:, None, None], state.obj_epoch)
+    obj_seq = jnp.where(wmask, new_seq[:, None, None], state.obj_seq)
+    obj_val = jnp.where(wmask, wval[:, None, None], state.obj_val)
+    obj_seq_ctr = jnp.where(commit, new_seq, state.obj_seq_ctr)
+
+    out_epoch = jnp.where(commit, lead_epoch,
+                          jnp.where(get_ok, rd_epoch, 0))
+    out_seq = jnp.where(commit, new_seq, jnp.where(get_ok, rd_seq, 0))
+    res = KvResult(
+        committed=commit,
+        get_ok=get_ok,
+        found=found & get_ok,
+        value=jnp.where(get_ok & found, rd_val, 0),
+        obj_vsn=jnp.stack([out_epoch, out_seq], -1),
+    )
+    new_state = state._replace(obj_epoch=obj_epoch, obj_seq=obj_seq,
+                               obj_val=obj_val, obj_seq_ctr=obj_seq_ctr)
+    return new_state, res
+
+
+@functools.partial(jax.jit, static_argnames=("axis_name",))
+def kv_step(state: EngineState, kind: jax.Array, slot: jax.Array,
+            val: jax.Array, lease_ok: jax.Array, up: jax.Array,
+            axis_name: Optional[str] = None
+            ) -> Tuple[EngineState, KvResult]:
+    """One K/V protocol round per ensemble, batched over E.
+
+    kind [E] int32 (OP_NOOP/OP_GET/OP_PUT); slot [E] int32; val [E]
+    int32 (payload for puts); lease_ok [E] bool (host lease check,
+    check_lease peer.erl:1493-1516); up [E, Ml] bool.
+
+    Semantics per ensemble:
+    - PUT: one quorum round.  Proposal (lead_epoch, ctr+1); member
+      replicas whose epoch matches ack (valid_request, peer.erl
+      :869-871 — stale-epoch followers nack); on majority in every
+      view, all heard member replicas apply the write (put_obj,
+      :1669-1698) and the counter advances (obj_sequence, :1776-1791).
+    - GET: if lease_ok, leased local read; else the quorum epoch-check
+      round gates it (:1460-1468).  The value returned is the newest
+      version among heard replicas (get_latest_obj, :1623-1662); if
+      that version's epoch is stale, it is rewritten at the current
+      epoch through the same quorum machinery (update_key,
+      :1564-1596) — batched across ensembles.
+    """
+    ctx = _kv_context(state, up, axis_name)
+    return _kv_round(state, ctx, kind, slot, val, lease_ok, axis_name)
+
+
+@functools.partial(jax.jit, static_argnames=("axis_name",))
+def kv_step_scan(state: EngineState, kind: jax.Array, slot: jax.Array,
+                 val: jax.Array, lease_ok: jax.Array, up: jax.Array,
+                 axis_name: Optional[str] = None
+                 ) -> Tuple[EngineState, KvResult]:
+    """K sequential K/V rounds per ensemble in one launch.
+
+    kind/slot/val: [K, E]; lease_ok: [K, E]; up: [E, Ml] (held fixed
+    across the K rounds).  Sequentiality per ensemble preserves the
+    per-key serialization the reference gets from key-hashed workers
+    (async/3, peer.erl:1220-1225).  Results are stacked [K, E].
+
+    Ballot state (epoch/leader/views) is invariant across the rounds,
+    so the round context — including its peer-axis collectives — is
+    computed once outside the scan.
+    """
+    ctx = _kv_context(state, up, axis_name)
+
+    def body(st, op):
+        k, sl, v, lz = op
+        st2, r = _kv_round(st, ctx, k, sl, v, lz, axis_name)
+        return st2, r
+
+    return jax.lax.scan(body, state, (kind, slot, val, lease_ok))
+
+
+# ---------------------------------------------------------------------------
+# Fused full step (election + K ops) — the "training step" analog
+
+
+@functools.partial(jax.jit, static_argnames=("axis_name",))
+def full_step(state: EngineState, elect: jax.Array, cand: jax.Array,
+              kind: jax.Array, slot: jax.Array, val: jax.Array,
+              lease_ok: jax.Array, up: jax.Array,
+              axis_name: Optional[str] = None
+              ) -> Tuple[EngineState, jax.Array, KvResult]:
+    """Election round (where needed) followed by K K/V rounds, fused.
+
+    This is the flagship jitted step: the host decides *which*
+    ensembles need elections (failure detection is host-side), the
+    device does all the protocol math.
+    """
+    state, won = elect_step(state, elect, cand, up, axis_name=axis_name)
+    state, res = kv_step_scan(state, kind, slot, val, lease_ok, up,
+                              axis_name=axis_name)
+    return state, won, res

@@ -101,12 +101,23 @@ def find_valid(replies):
 # Batched array version
 
 
-@functools.partial(jax.jit, static_argnames=("required",))
+def reduce_peers(x: jax.Array, axis_name) -> jax.Array:
+    """Sum over the trailing (local) peer axis, then over the mesh
+    'peer' axis when sharded — the vote-count all-reduce.  Shared by
+    every peer-axis reduction in the batched engine."""
+    s = x.sum(-1)
+    if axis_name is not None:
+        s = jax.lax.psum(s, axis_name)
+    return s
+
+
+@functools.partial(jax.jit, static_argnames=("required", "axis_name"))
 def quorum_met_batch(valid: jax.Array,
                      nack: jax.Array,
                      view_mask: jax.Array,
                      self_idx: jax.Array,
-                     required: str = "quorum") -> jax.Array:
+                     required: str = "quorum",
+                     axis_name: Optional[str] = None) -> jax.Array:
     """Batched quorum predicate.
 
     Args:
@@ -118,29 +129,39 @@ def quorum_met_batch(valid: jax.Array,
       self_idx:   int  ``[...]`` — caller's index on the peer axis, or
                   -1 when the caller is not on this peer axis.
       required:   one of REQUIRED_MODES (static).
+      axis_name:  mesh axis name when the peer axis M is sharded under
+                  ``shard_map`` — vote counts become ``psum`` ICI
+                  all-reduces (this is how the sharded engine calls
+                  it).  Sharded callers must pass ``self_idx=-1`` and
+                  fold their own vote into ``valid`` (a global index
+                  cannot be matched against a local peer slice).
 
     Returns int8 ``[...]`` of MET / UNDECIDED / NACK.
-
-    The reduction over the peer axis M is a plain masked sum — under
-    ``shard_map`` over a mesh ``('ens', 'peer')`` the same code runs
-    with ``jax.lax.psum`` over the 'peer' axis (see
-    :mod:`riak_ensemble_tpu.parallel.mesh`).
     """
     assert required in REQUIRED_MODES, required
     vm = view_mask.astype(jnp.int32)                      # [..., V, M]
-    members = vm.sum(-1)                                  # [..., V]
+    members = reduce_peers(vm, axis_name)                 # [..., V]
     active = members > 0                                  # [..., V]
-    n_valid = (vm * valid[..., None, :].astype(jnp.int32)).sum(-1)
-    n_nack = (vm * nack[..., None, :].astype(jnp.int32)).sum(-1)
+    n_valid = reduce_peers(vm * valid[..., None, :].astype(jnp.int32),
+                           axis_name)
+    n_nack = reduce_peers(vm * nack[..., None, :].astype(jnp.int32),
+                          axis_name)
 
     if required == "all":
         thresh = members
     else:
         thresh = members // 2 + 1
 
-    m = view_mask.shape[-1]
-    self_oh = jax.nn.one_hot(self_idx, m, dtype=jnp.int32)  # [..., M]
-    self_in_view = (vm * self_oh[..., None, :]).sum(-1)     # [..., V]
+    if axis_name is not None:
+        # Sharded contract enforced at the source: a global self_idx
+        # cannot be matched against a local peer slice, so the self
+        # term is hard-zeroed (callers fold self into `valid`); this
+        # also saves an all-reduce on the hot ICI path.
+        self_in_view = jnp.zeros_like(members)
+    else:
+        m = view_mask.shape[-1]
+        self_oh = jax.nn.one_hot(self_idx, m, dtype=jnp.int32)  # [..., M]
+        self_in_view = (vm * self_oh[..., None, :]).sum(-1)     # [..., V]
     if required != "other":
         heard = n_valid + self_in_view
     else:

@@ -1,0 +1,5 @@
+"""Device-mesh parallelism for the batched consensus engine."""
+
+from riak_ensemble_tpu.parallel.mesh import (  # noqa: F401
+    ShardedEngine, make_mesh,
+)

@@ -1,0 +1,132 @@
+"""Sharding the consensus engine over a ('ens', 'peer') device mesh.
+
+The reference scales by running ensembles/peers across Erlang nodes
+with disterl messaging (SURVEY §2.7).  The TPU-native layout:
+
+- **'ens' axis** — ensembles are embarrassingly parallel (independent
+  consensus groups); the E axis shards across devices with no
+  cross-device traffic (the DP analog).
+- **'peer' axis** — one ensemble's M peer replicas can live on
+  different chips; quorum vote counting, proposal-epoch broadcast, and
+  newest-object selection become ``psum``/``pmax`` collectives over the
+  'peer' mesh axis riding ICI (the TP analog; the msg.erl
+  quorum fan-out/collect, riak_ensemble_msg.erl:85-97,319-332, as an
+  all-reduce).
+
+Cross-host (DCN) deployment uses the same code: ``jax.make_mesh`` over
+multi-host device arrays gives a mesh whose 'ens' dim spans hosts —
+ensembles never need DCN collectives, and peer-axis collectives stay
+intra-slice by construction (put the 'peer' dim innermost).
+
+``ShardedEngine`` wraps the :mod:`riak_ensemble_tpu.ops.engine` kernels
+in ``shard_map`` with the peer axis sharded; inputs/outputs that carry
+a peer axis use spec ('ens', 'peer'), per-ensemble vectors use
+('ens',) and are replicated along 'peer'.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from riak_ensemble_tpu.ops import engine as eng
+
+
+def make_mesh(n_ens: int, n_peer: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Mesh of shape (ens=n_ens, peer=n_peer).
+
+    'peer' is the innermost (fastest-varying) mesh dim so peer-axis
+    collectives map to nearest-neighbor ICI links.
+    """
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    assert devs.size >= n_ens * n_peer, \
+        f"need {n_ens * n_peer} devices, have {devs.size}"
+    grid = devs[: n_ens * n_peer].reshape(n_ens, n_peer)
+    return Mesh(grid, ("ens", "peer"))
+
+
+# PartitionSpecs for each EngineState field ([E,M] / [E] / [E,V,M] / [E,M,S]).
+_STATE_SPECS = eng.EngineState(
+    epoch=P("ens", "peer"),
+    fact_seq=P("ens", "peer"),
+    leader=P("ens"),
+    view_mask=P("ens", None, "peer"),
+    obj_seq_ctr=P("ens"),
+    obj_epoch=P("ens", "peer", None),
+    obj_seq=P("ens", "peer", None),
+    obj_val=P("ens", "peer", None),
+)
+
+# kv_step_scan stacks results along a leading K axis.
+_SCAN_RESULT_SPECS = eng.KvResult(
+    committed=P(None, "ens"), get_ok=P(None, "ens"), found=P(None, "ens"),
+    value=P(None, "ens"), obj_vsn=P(None, "ens", None),
+)
+
+
+class ShardedEngine:
+    """Engine kernels shard_map'd over a ('ens', 'peer') mesh.
+
+    E must divide by mesh 'ens' size; M by mesh 'peer' size (pad views
+    with absent peers if needed — all-zero view columns are inert).
+    """
+
+    def __init__(self, mesh: Mesh) -> None:
+        self.mesh = mesh
+        ax = "peer" if mesh.shape["peer"] > 1 else None
+
+        def smap(fn, in_specs, out_specs):
+            return jax.jit(jax.shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False))
+
+        self._elect = smap(
+            lambda st, el, ca, up: eng.elect_step(st, el, ca, up,
+                                                  axis_name=ax),
+            (_STATE_SPECS, P("ens"), P("ens"), P("ens", "peer")),
+            (_STATE_SPECS, P("ens")))
+        self._kv = smap(
+            lambda st, k, sl, v, lz, up: eng.kv_step_scan(
+                st, k, sl, v, lz, up, axis_name=ax),
+            (_STATE_SPECS, P(None, "ens"), P(None, "ens"), P(None, "ens"),
+             P(None, "ens"), P("ens", "peer")),
+            (_STATE_SPECS, _SCAN_RESULT_SPECS))
+        self._full = smap(
+            lambda st, el, ca, k, sl, v, lz, up: eng.full_step(
+                st, el, ca, k, sl, v, lz, up, axis_name=ax),
+            (_STATE_SPECS, P("ens"), P("ens"), P(None, "ens"),
+             P(None, "ens"), P(None, "ens"), P(None, "ens"),
+             P("ens", "peer")),
+            (_STATE_SPECS, P("ens"), _SCAN_RESULT_SPECS))
+
+    # -- placement ---------------------------------------------------------
+
+    def shard_state(self, state: eng.EngineState) -> eng.EngineState:
+        """Place a host-built state onto the mesh with engine specs."""
+        return jax.tree.map(
+            lambda x, spec: jax.device_put(x, NamedSharding(self.mesh, spec)),
+            state, _STATE_SPECS)
+
+    def init_state(self, n_ensembles: int, n_peers: int, n_slots: int,
+                   **kw) -> eng.EngineState:
+        assert n_ensembles % self.mesh.shape["ens"] == 0
+        assert n_peers % self.mesh.shape["peer"] == 0
+        return self.shard_state(
+            eng.init_state(n_ensembles, n_peers, n_slots, **kw))
+
+    # -- steps -------------------------------------------------------------
+
+    def elect_step(self, state, elect, cand, up):
+        return self._elect(state, elect, cand, up)
+
+    def kv_step_scan(self, state, kind, slot, val, lease_ok, up):
+        """Ops are [K, E]-shaped (a scan of K rounds), matching
+        :func:`riak_ensemble_tpu.ops.engine.kv_step_scan`."""
+        return self._kv(state, kind, slot, val, lease_ok, up)
+
+    def full_step(self, state, elect, cand, kind, slot, val, lease_ok, up):
+        return self._full(state, elect, cand, kind, slot, val, lease_ok, up)

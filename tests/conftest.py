@@ -8,7 +8,9 @@ on virtual CPU devices per the driver contract.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Override (not setdefault): the driver environment pins JAX_PLATFORMS
+# to the real TPU tunnel, but the test contract is the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,3 +19,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 # Make the repo root importable regardless of pytest invocation dir.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The environment's sitecustomize registers the real-TPU plugin and
+# forces jax_platforms at interpreter start; backends initialize
+# lazily, so re-pin to CPU here (before any device use).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

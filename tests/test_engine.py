@@ -1,0 +1,267 @@
+"""Batched engine semantics: election, put/get, quorum edges, sharding.
+
+Differential anchors: the scalar quorum predicate
+(riak_ensemble_tpu.ops.quorum.quorum_met) and hand-derived protocol
+facts from the reference (peer.erl call stacks, SURVEY §3).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from riak_ensemble_tpu.ops import engine as eng
+from riak_ensemble_tpu.ops.quorum import MET, quorum_met
+from riak_ensemble_tpu.parallel.mesh import ShardedEngine, make_mesh
+
+E, M, S = 4, 5, 16
+
+
+def all_up():
+    return jnp.ones((E, M), bool)
+
+
+def elect_all(state, up=None):
+    up = all_up() if up is None else up
+    state, won = eng.elect_step(
+        state, jnp.ones((E,), bool), jnp.zeros((E,), jnp.int32), up)
+    return state, won
+
+
+def test_election_establishes_leader_and_epoch():
+    st = eng.init_state(E, M, S)
+    st, won = elect_all(st)
+    assert bool(won.all())
+    np.testing.assert_array_equal(st.leader, np.zeros(E))
+    # NextEpoch = max(epoch)+1 = 1, adopted by every up member.
+    np.testing.assert_array_equal(st.epoch, np.ones((E, M)))
+    # Re-election bumps epoch again.
+    st, won = elect_all(st)
+    assert bool(won.all())
+    np.testing.assert_array_equal(st.epoch, 2 * np.ones((E, M)))
+
+
+def test_election_fails_without_quorum():
+    st = eng.init_state(E, M, S)
+    up = jnp.asarray(np.array([[1, 1, 0, 0, 0]] * E, dtype=bool))
+    st, won = elect_all(st, up)
+    assert not bool(won.any())
+    np.testing.assert_array_equal(st.leader, -np.ones(E))
+    np.testing.assert_array_equal(st.epoch, np.zeros((E, M)))
+    # 3/5 is a majority: succeeds.
+    up = jnp.asarray(np.array([[1, 1, 1, 0, 0]] * E, dtype=bool))
+    st, won = elect_all(st, up)
+    assert bool(won.all())
+    # Down peers did not adopt the new epoch.
+    np.testing.assert_array_equal(st.epoch[:, 3:], np.zeros((E, 2)))
+
+
+def _put(st, slots, vals, up=None, lease=True):
+    up = all_up() if up is None else up
+    return eng.kv_step(
+        st, jnp.full((E,), eng.OP_PUT, jnp.int32),
+        jnp.asarray(slots, jnp.int32), jnp.asarray(vals, jnp.int32),
+        jnp.full((E,), lease, bool), up)
+
+
+def _get(st, slots, up=None, lease=True):
+    up = all_up() if up is None else up
+    return eng.kv_step(
+        st, jnp.full((E,), eng.OP_GET, jnp.int32),
+        jnp.asarray(slots, jnp.int32), jnp.zeros((E,), jnp.int32),
+        jnp.full((E,), lease, bool), up)
+
+
+def test_put_then_get_roundtrip():
+    st, _ = elect_all(eng.init_state(E, M, S))
+    st, res = _put(st, [3] * E, [10, 20, 30, 40])
+    assert bool(res.committed.all())
+    np.testing.assert_array_equal(res.obj_vsn, [[1, 1]] * E)
+    st, res = _get(st, [3] * E)
+    assert bool(res.get_ok.all()) and bool(res.found.all())
+    np.testing.assert_array_equal(res.value, [10, 20, 30, 40])
+    # Unwritten slot reads notfound.
+    st, res = _get(st, [5] * E)
+    assert bool(res.get_ok.all()) and not bool(res.found.any())
+
+
+def test_put_replicates_to_all_up_members():
+    st, _ = elect_all(eng.init_state(E, M, S))
+    st, res = _put(st, [0] * E, [7] * E)
+    np.testing.assert_array_equal(st.obj_val[:, :, 0], 7 * np.ones((E, M)))
+    np.testing.assert_array_equal(st.obj_seq[:, :, 0], np.ones((E, M)))
+
+
+def test_put_needs_quorum_of_matching_epochs():
+    st, _ = elect_all(eng.init_state(E, M, S))
+    # Only 2/5 peers reachable: no quorum, no commit, no state change.
+    up = jnp.asarray(np.array([[1, 1, 0, 0, 0]] * E, dtype=bool))
+    st2, res = _put(st, [0] * E, [7] * E, up=up)
+    assert not bool(res.committed.any())
+    np.testing.assert_array_equal(st2.obj_seq[:, :, 0], np.zeros((E, M)))
+    np.testing.assert_array_equal(st2.obj_seq_ctr, st.obj_seq_ctr)
+    # Matches the scalar oracle: 1 valid reply + self < 3 = majority(5).
+    assert quorum_met([("p1", "ok")], "p0",
+                      [["p0", "p1", "p2", "p3", "p4"]]) != MET
+
+
+def test_seq_monotonic_and_epoch_reset():
+    st, _ = elect_all(eng.init_state(E, M, S))
+    for i in range(3):
+        st, res = _put(st, [i] * E, [i] * E)
+        np.testing.assert_array_equal(res.obj_vsn[:, 1], (i + 1) * np.ones(E))
+    # New election: epoch bumps, per-epoch obj counter resets
+    # (local_commit, peer.erl:891-909).
+    st, _ = elect_all(st)
+    st, res = _put(st, [9] * E, [9] * E)
+    np.testing.assert_array_equal(res.obj_vsn, [[2, 1]] * E)
+
+
+def test_stale_epoch_read_rewrites_at_current_epoch():
+    st, _ = elect_all(eng.init_state(E, M, S))
+    st, _ = _put(st, [2] * E, [42] * E)
+    st, _ = elect_all(st)  # epoch now 2; slot 2 holds an epoch-1 obj
+    st, res = _get(st, [2] * E)
+    assert bool(res.get_ok.all()) and bool(res.found.all())
+    np.testing.assert_array_equal(res.value, 42 * np.ones(E))
+    # update_key (peer.erl:1564-1596): object rewritten at epoch 2.
+    np.testing.assert_array_equal(st.obj_epoch[:, :, 2], 2 * np.ones((E, M)))
+    # Rewrite consumed seq 1 of the new epoch.
+    np.testing.assert_array_equal(st.obj_seq_ctr, np.ones(E))
+
+
+def test_election_rejects_down_or_foreign_candidate():
+    st = eng.init_state(E, M, S)
+    # Candidate 4 is down: even with a quorum of other acks, no win.
+    up = jnp.asarray(np.array([[1, 1, 1, 1, 0]] * E, dtype=bool))
+    st2, won = eng.elect_step(
+        st, jnp.ones((E,), bool), jnp.full((E,), 4, jnp.int32), up)
+    assert not bool(won.any())
+    # Candidate outside the peer range likewise.
+    st2, won = eng.elect_step(
+        st, jnp.ones((E,), bool), jnp.full((E,), M + 3, jnp.int32),
+        all_up())
+    assert not bool(won.any())
+
+
+def test_put_invalid_slot_not_committed():
+    st, _ = elect_all(eng.init_state(E, M, S))
+    st2, res = _put(st, [S + 1] * E, [1] * E)
+    assert not bool(res.committed.any())
+    np.testing.assert_array_equal(st2.obj_seq_ctr, st.obj_seq_ctr)
+
+
+def test_rewrite_reports_committed():
+    st, _ = elect_all(eng.init_state(E, M, S))
+    st, _ = _put(st, [2] * E, [42] * E)
+    st, _ = elect_all(st)
+    st, res = _get(st, [2] * E)
+    assert bool(res.committed.all())  # the update_key rewrite landed
+    st, res = _get(st, [2] * E)
+    assert not bool(res.committed.any())  # now current: plain read
+
+
+def test_unleased_read_requires_epoch_quorum():
+    st, _ = elect_all(eng.init_state(E, M, S))
+    st, _ = _put(st, [1] * E, [5] * E)
+    st, res = _get(st, [1] * E, lease=False)
+    assert bool(res.get_ok.all())  # quorum reachable: read ok
+    up = jnp.asarray(np.array([[1, 1, 0, 0, 0]] * E, dtype=bool))
+    st, res = _get(st, [1] * E, up=up, lease=False)
+    assert not bool(res.get_ok.any())  # no quorum, no lease: fail
+
+
+def test_get_latest_obj_prefers_newest_version():
+    """A replica holding a newer version than the leader wins the
+    read (get_latest_obj max by (epoch, seq), backend.erl:132-143)."""
+    st, _ = elect_all(eng.init_state(E, M, S))
+    st, _ = _put(st, [0] * E, [1] * E)
+    # Manually age the leader's replica (simulates a lost write).
+    obj_seq = st.obj_seq.at[:, 0, 0].set(0)
+    obj_val = st.obj_val.at[:, 0, 0].set(0)
+    st = st._replace(obj_seq=obj_seq, obj_val=obj_val)
+    st, res = _get(st, [0] * E, lease=False)
+    np.testing.assert_array_equal(res.value, np.ones(E))
+
+
+def test_joint_views_require_majority_in_every_view():
+    # View A = {0,1,2}, view B = {2,3,4} (joint consensus).
+    views = [[0, 1, 2], [2, 3, 4]]
+    st = eng.init_state(E, M, S, views=views)
+    # Up = {0,1,2}: majority of A (3/3) and of B (1/3 + nothing) -> fail.
+    up = jnp.asarray(np.array([[1, 1, 1, 0, 0]] * E, dtype=bool))
+    st1, won = elect_all(st, up)
+    assert not bool(won.any())
+    # Scalar oracle agrees (candidate p0 hears p1, p2).
+    assert quorum_met([("p1", "ok"), ("p2", "ok")], "p0",
+                      [["p0", "p1", "p2"], ["p2", "p3", "p4"]]) != MET
+    # Up = {0,1,2,3}: A 3/3, B 2/3 -> majority in both.
+    up = jnp.asarray(np.array([[1, 1, 1, 1, 0]] * E, dtype=bool))
+    st2, won = elect_all(st, up)
+    assert bool(won.all())
+
+
+def test_scan_step_serializes_ops_per_ensemble():
+    st, _ = elect_all(eng.init_state(E, M, S))
+    k = 4
+    kind = jnp.full((k, E), eng.OP_PUT, jnp.int32)
+    kind = kind.at[3].set(eng.OP_GET)
+    slot = jnp.zeros((k, E), jnp.int32)
+    val = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[:, None], (k, E))
+    lease = jnp.ones((k, E), bool)
+    st, res = eng.kv_step_scan(st, kind, slot, val, lease, all_up())
+    # Last-writer-wins within the scan; the final get sees op 2's value.
+    np.testing.assert_array_equal(res.value[3], 2 * np.ones(E))
+    np.testing.assert_array_equal(res.obj_vsn[3, :, 1], 3 * np.ones(E))
+
+
+# ---------------------------------------------------------------------------
+# Sharded engine on the virtual 8-device CPU mesh
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2), (2, 4), (1, 8)])
+def test_sharded_matches_single_device(mesh_shape):
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    n_ens, n_peer = mesh_shape
+    e, m = 8, 8  # M=8 divides every peer-axis size
+    mesh = make_mesh(n_ens, n_peer)
+    se = ShardedEngine(mesh)
+    views = [list(range(5))]  # 5-member view inside an 8-wide peer axis
+
+    def run(stepper, state):
+        state, won = stepper.elect(state)
+        k = 3
+        kind = jnp.asarray(
+            np.array([[eng.OP_PUT] * e, [eng.OP_PUT] * e, [eng.OP_GET] * e]),
+            jnp.int32)
+        slot = jnp.ones((k, e), jnp.int32)
+        val = jnp.asarray(np.arange(k * e).reshape(k, e), jnp.int32)
+        lease = jnp.ones((k, e), bool)
+        up = jnp.ones((e, m), bool)
+        state, res = stepper.kv(state, kind, slot, val, lease, up)
+        return won, res
+
+    class Single:
+        def elect(self, st):
+            return eng.elect_step(st, jnp.ones((e,), bool),
+                                  jnp.zeros((e,), jnp.int32),
+                                  jnp.ones((e, m), bool))
+
+        def kv(self, st, *a):
+            return eng.kv_step_scan(st, *a)
+
+    class Sharded:
+        def elect(self, st):
+            return se.elect_step(st, jnp.ones((e,), bool),
+                                 jnp.zeros((e,), jnp.int32),
+                                 jnp.ones((e, m), bool))
+
+        def kv(self, st, *a):
+            return se.kv_step_scan(st, *a)
+
+    won1, res1 = run(Single(), eng.init_state(e, m, S, views=views))
+    won2, res2 = run(Sharded(), se.init_state(e, m, S, views=views))
+    np.testing.assert_array_equal(np.asarray(won1), np.asarray(won2))
+    for a, b in zip(res1, res2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
