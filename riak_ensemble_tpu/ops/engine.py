@@ -202,7 +202,12 @@ def elect_step(state: EngineState, elect: jax.Array, cand: jax.Array,
     member = state.view_mask.any(1)                          # [E, Ml]
     heard = up & member
     next_epoch = _pmax(jnp.where(heard, state.epoch, -1), axis_name) + 1
-    ack = heard & (state.epoch < next_epoch[:, None])
+    # Prepare acceptance is epoch < NextEpoch (peer.erl:506-519); with
+    # NextEpoch = max(heard epochs)+1 computed from the same heard set,
+    # every heard peer accepts by construction — refusal would need a
+    # concurrent higher ballot, which sequential kernel launches over
+    # consistent state rule out.
+    ack = heard
     # The candidate must itself be an up member (it leads the round);
     # a host race handing in a dead/non-member candidate must not
     # produce a leader whose replica never adopted the new epoch.
@@ -234,10 +239,10 @@ class _KvCtx(NamedTuple):
     exactly once (kv_step_scan).
     """
 
-    heard: jax.Array       # [E, Ml] up members
-    has_leader: jax.Array  # [E]
-    lead_epoch: jax.Array  # [E] proposal epoch (leader's epoch)
-    epoch_ok: jax.Array    # [E] epoch-check round reached quorum
+    heard: jax.Array        # [E, Ml] up members
+    leader_up: jax.Array    # [E] the leader itself is up (it serves ops)
+    lead_epoch: jax.Array   # [E] proposal epoch (leader's epoch)
+    epoch_ok: jax.Array     # [E] epoch-check round reached quorum
 
 
 def _kv_context(state: EngineState, up: jax.Array,
@@ -251,11 +256,18 @@ def _kv_context(state: EngineState, up: jax.Array,
     # Leader's epoch, replicated to every shard (the proposal epoch).
     lead_epoch = reduce_peers(jnp.where(is_leader, state.epoch, 0),
                               axis_name)
+    # Every op is served BY the leader (leased reads are the leader's
+    # local read, puts include the leader's local put — peer.erl:1669-
+    # 1698); a down leader serves nothing, whatever the quorum says.
+    # This is also what makes commits durable under leased reads: a
+    # committed write always includes the leader's own replica.
+    leader_up = reduce_peers((is_leader & heard).astype(jnp.int32),
+                             axis_name) > 0
     # Epoch-check acks: shared by put replication and non-leased reads.
     ack = heard & (state.epoch == lead_epoch[:, None])
     epoch_ok = (_quorum_met(ack, heard, state.view_mask, axis_name)
-                & has_leader)
-    return _KvCtx(heard=heard, has_leader=has_leader,
+                & has_leader & leader_up)
+    return _KvCtx(heard=heard, leader_up=leader_up & has_leader,
                   lead_epoch=lead_epoch, epoch_ok=epoch_ok)
 
 
@@ -264,7 +276,7 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
               axis_name: Optional[str]) -> Tuple[EngineState, KvResult]:
     """One K/V protocol round given a precomputed context."""
     s = state.obj_epoch.shape[-1]
-    heard, has_leader = ctx.heard, ctx.has_leader
+    heard, leader_up = ctx.heard, ctx.leader_up
     lead_epoch, epoch_ok = ctx.lead_epoch, ctx.epoch_ok
 
     is_put = kind == OP_PUT
@@ -277,7 +289,7 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
     rd_epoch, rd_seq, rd_val, found = _latest_at_slot(
         state, slot_oh, heard, axis_name)
 
-    get_gate = is_get & has_leader & (lease_ok | epoch_ok)
+    get_gate = is_get & leader_up & (lease_ok | epoch_ok)
     # Stale-epoch rewrite (update_key): needs the quorum either way.
     rewrite = get_gate & found & (rd_epoch != lead_epoch) & epoch_ok
     get_ok = get_gate & (~(found & (rd_epoch != lead_epoch)) | rewrite)

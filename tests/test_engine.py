@@ -161,6 +161,26 @@ def test_rewrite_reports_committed():
     assert not bool(res.committed.any())  # now current: plain read
 
 
+def test_no_commit_without_leader_replica():
+    """A put must include the leader's own replica write (put_obj always
+    does the leader-local put, peer.erl:1669-1698); otherwise a later
+    leased read could miss a committed write."""
+    st, _ = elect_all(eng.init_state(E, M, S))
+    st, _ = _put(st, [0] * E, [1] * E)  # A, replicated everywhere
+    # Leader (peer 0) down: follower quorum exists, but no commit.
+    up = jnp.asarray(np.array([[0, 1, 1, 1, 1]] * E, dtype=bool))
+    st2, res = _put(st, [0] * E, [2] * E, up=up)
+    assert not bool(res.committed.any())
+    # And no read can be served while the leader is down, leased or not.
+    st2, res = _get(st, [0] * E, up=up, lease=True)
+    assert not bool(res.get_ok.any())
+    # Leader back, minority up: leased read still sees A (value 1) —
+    # never a half-committed B.
+    up = jnp.asarray(np.array([[1, 0, 0, 0, 0]] * E, dtype=bool))
+    st3, res = _get(st, [0] * E, up=up, lease=True)
+    np.testing.assert_array_equal(res.value, np.ones(E))
+
+
 def test_unleased_read_requires_epoch_quorum():
     st, _ = elect_all(eng.init_state(E, M, S))
     st, _ = _put(st, [1] * E, [5] * E)
@@ -239,8 +259,16 @@ def test_sharded_matches_single_device(mesh_shape):
         val = jnp.asarray(np.arange(k * e).reshape(k, e), jnp.int32)
         lease = jnp.ones((k, e), bool)
         up = jnp.ones((e, m), bool)
-        state, res = stepper.kv(state, kind, slot, val, lease, up)
-        return won, res
+        state, res1 = stepper.kv(state, kind, slot, val, lease, up)
+        # Second election: epoch bump, then reads with a down peer —
+        # exercises the mixed-epoch _latest_at_slot pmax chain and the
+        # batched stale-epoch rewrite under peer sharding.
+        state, won2 = stepper.elect(state)
+        up2 = jnp.asarray(
+            np.tile(np.array([1, 0, 1, 1, 1, 1, 1, 1], bool), (e, 1)))
+        kind2 = jnp.full((k, e), eng.OP_GET, jnp.int32)
+        state, res2 = stepper.kv(state, kind2, slot, val, lease, up2)
+        return won, res1, won2, res2
 
     class Single:
         def elect(self, st):
@@ -260,8 +288,15 @@ def test_sharded_matches_single_device(mesh_shape):
         def kv(self, st, *a):
             return se.kv_step_scan(st, *a)
 
-    won1, res1 = run(Single(), eng.init_state(e, m, S, views=views))
-    won2, res2 = run(Sharded(), se.init_state(e, m, S, views=views))
-    np.testing.assert_array_equal(np.asarray(won1), np.asarray(won2))
-    for a, b in zip(res1, res2):
+    out_single = run(Single(), eng.init_state(e, m, S, views=views))
+    out_sharded = run(Sharded(), se.init_state(e, m, S, views=views))
+    for a, b in zip(jax.tree.leaves(out_single), jax.tree.leaves(out_sharded)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Sanity on content, not just equivalence: the final reads found the
+    # rewritten object at the post-re-election epoch.
+    _, _, won2, res2 = out_single
+    assert bool(np.asarray(won2).all())
+    assert bool(np.asarray(res2.get_ok).all())
+    assert bool(np.asarray(res2.found).all())
+    np.testing.assert_array_equal(np.asarray(res2.obj_vsn[..., 0]),
+                                  2 * np.ones((3, e)))
