@@ -928,7 +928,9 @@ class Peer(Actor):
         """peer.erl:952-964."""
         cur = self.directory.get_views(self.ensemble)
         vsn = (self.fact.epoch, self.fact.seq)
-        if cur and (cur[0] > vsn or self.fact.views is None):
+        # Empty views = the reference's `undefined` (a manager-started
+        # peer with no saved fact): always adopt the manager's views.
+        if cur and (cur[0] > vsn or not self.fact.views):
             self.fact = _fact_replace(self.fact, views=tuple(cur[1]))
             self.members = members_of(self.fact.views)
         else:

@@ -194,3 +194,108 @@ def make_peers(n: int, n_nodes: Optional[int] = None) -> List[PeerId]:
     """Peer ids spread over nodes (node per peer by default)."""
     n_nodes = n_nodes if n_nodes is not None else n
     return [PeerId(i, f"node{i % n_nodes}") for i in range(n)]
+
+
+class ManagedCluster:
+    """Full-stack harness: per-node Manager + routers + storage, the
+    root ensemble, gossip, and the client API — the analog of a real
+    multi-node deployment of the reference app, driven in one
+    deterministic virtual-time runtime.
+
+    Typical bring-up (mirrors the riak_ensemble README sequence):
+    ``enable(node0)`` → ``join(node1, node0)`` → expand the root
+    ensemble's members → ``create_ensemble(...)`` → client K/V ops.
+    """
+
+    def __init__(self, seed: int = 0, nodes: Sequence[str] = ("node0",),
+                 config: Optional[Config] = None,
+                 data_root: Optional[str] = None, **peer_kw) -> None:
+        from riak_ensemble_tpu.manager import Manager
+
+        self.runtime = Runtime(seed)
+        self.config = config if config is not None else fast_test_config()
+        self.data_root = data_root
+        self.peer_kw = peer_kw
+        self.managers: Dict[str, Manager] = {}
+        self.storages: Dict[str, Storage] = {}
+        for node in nodes:
+            self.add_node(node)
+
+    def add_node(self, node: str):
+        from riak_ensemble_tpu.manager import Manager
+
+        root = (f"{self.data_root}/{node}" if self.data_root else None)
+        storage = Storage(self.runtime, node, self.config, root)
+        self.storages[node] = storage
+        mgr = Manager(self.runtime, node, self.config, storage,
+                      **self.peer_kw)
+        self.managers[node] = mgr
+        return mgr
+
+    def mgr(self, node: str):
+        return self.managers[node]
+
+    def client(self, node: str):
+        from riak_ensemble_tpu.client import Client
+
+        return Client(self.runtime, node)
+
+    # -- cluster lifecycle ----------------------------------------------
+
+    def enable(self, node: str) -> None:
+        assert self.mgr(node).enable() == "ok"
+        self.wait_stable("root")
+
+    def join(self, joining: str, existing: str, timeout: float = 60.0):
+        fut = self.mgr(joining).join_async(existing, timeout)
+        result = self.runtime.await_future(fut, timeout=timeout + 5.0)
+        assert result == "ok", f"join failed: {result!r}"
+        # converged when every enabled manager lists the new member
+        ok = self.runtime.run_until(
+            lambda: all(joining in m.cluster_state.members
+                        for m in self.managers.values()
+                        if m.cluster_state.enabled), 60.0, poll=0.1)
+        assert ok, "join did not converge via gossip"
+        return result
+
+    def remove(self, from_node: str, target: str, timeout: float = 60.0):
+        fut = self.mgr(from_node).remove_async(target, timeout)
+        result = self.runtime.await_future(fut, timeout=timeout + 5.0)
+        assert result == "ok", f"remove failed: {result!r}"
+        return result
+
+    def create_ensemble(self, ensemble, peer_ids: Sequence[PeerId],
+                        mod: str = "basic", args=(),
+                        timeout: float = 30.0) -> None:
+        leader = peer_ids[0]
+        fut = self.mgr(leader.node).create_ensemble(
+            ensemble, leader, list(peer_ids), mod, args, timeout)
+        result = self.runtime.await_future(fut, timeout=timeout + 5.0)
+        assert result == "ok", f"create_ensemble failed: {result!r}"
+        # Wait until every hosting node has started its peers.
+        wanted = {(p.node, ensemble) for p in peer_ids}
+
+        def started():
+            return all(
+                any(k[0] == ensemble for k in self.managers[n].local_peers)
+                for n, _ in wanted)
+        ok = self.runtime.run_until(started, 60.0, poll=0.1)
+        assert ok, f"peers for {ensemble} not started via gossip"
+
+    def update_members(self, ensemble, changes, timeout: float = 30.0):
+        """ens_test:expand analog — update_members on the leader."""
+        lid = self.wait_leader(ensemble)
+        return sync_send_event(self.runtime, peer_name(ensemble, lid),
+                               ("update_members", tuple(changes)), timeout)
+
+    # -- introspection (shared logic with Cluster) -----------------------
+
+    leader_id = Cluster.leader_id
+    leader = Cluster.leader
+    peer = Cluster.peer
+    tree_of = Cluster.tree_of
+    wait_leader = Cluster.wait_leader
+    wait_stable = Cluster.wait_stable
+    check_quorum = Cluster.check_quorum
+    suspend_peer = Cluster.suspend_peer
+    resume_peer = Cluster.resume_peer

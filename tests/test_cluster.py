@@ -1,0 +1,200 @@
+"""Cluster-management layer: enable/join/remove, gossip convergence,
+root-ensemble ops, ensemble creation, client API routing.
+
+Covers riak_ensemble_manager/root/state semantics (SURVEY §2.4-2.5):
+activation creates the root ensemble (manager.erl:498-516), join pulls
+and adopts remote state then writes membership through the root
+ensemble (manager.erl:311-334, root.erl:123-130), gossip spreads
+cluster state with newest-vsn-wins merge (riak_ensemble_state.erl:
+171-211), state_changed starts/stops local peers (manager.erl:610-641),
+and the client API routes through the router pool to the leader
+(client.erl, router.erl).
+"""
+
+import pytest
+
+from riak_ensemble_tpu import state as statelib
+from riak_ensemble_tpu.testing import ManagedCluster
+from riak_ensemble_tpu.types import NOTFOUND, EnsembleInfo, PeerId
+
+
+# ---------------------------------------------------------------------------
+# pure cluster-state unit tests (riak_ensemble_state.erl semantics)
+
+
+def test_state_vsn_guards():
+    cs = statelib.new_state("cid")
+    cs = statelib.add_member((0, 0), "n1", cs)
+    assert cs is not None and cs.members == {"n1"}
+    # same vsn rejected (strictly-newer-wins, state.erl:213-219)
+    assert statelib.add_member((0, 0), "n2", cs) is None
+    cs2 = statelib.add_member((0, 1), "n2", cs)
+    assert cs2.members == {"n1", "n2"}
+    cs3 = statelib.del_member((1, 0), "n1", cs2)
+    assert cs3.members == {"n2"}
+
+
+def test_state_ensemble_guards():
+    cs = statelib.new_state("cid")
+    info = EnsembleInfo(vsn=(0, 0), leader=None, views=(), seq=(0, 0))
+    cs = statelib.set_ensemble("e1", info, cs)
+    assert cs is not None
+    # update_ensemble on unknown ensemble errors (state.erl:149-150)
+    assert statelib.update_ensemble((1, 0), "nope", None, (), cs) is None
+    p = PeerId(0, "n1")
+    cs2 = statelib.update_ensemble((1, 0), "e1", p, ((p,),), cs)
+    assert cs2.ensembles["e1"].leader == p
+    # stale update rejected
+    assert statelib.update_ensemble((0, 5), "e1", None, (), cs2) is None
+
+
+def test_state_merge_newest_wins():
+    a = statelib.new_state("cid")
+    a = statelib.enable(a)
+    a = statelib.add_member((0, 0), "n1", a)
+    b = statelib.add_member((0, 1), "n2", a)
+    merged = statelib.merge(a, b)
+    assert merged.members == {"n1", "n2"}
+    # foreign cluster id ignored once enabled (state.erl:172-174)
+    foreign = statelib.add_member((9, 9), "evil", statelib.new_state("x"))
+    assert statelib.merge(a, foreign).members == a.members
+
+
+# ---------------------------------------------------------------------------
+# full-stack manager tests
+
+
+def test_enable_creates_root_ensemble():
+    mc = ManagedCluster(seed=10, nodes=("node0",))
+    mc.enable("node0")
+    root_leader = mc.leader_id("root")
+    assert root_leader == PeerId("root", "node0")
+    assert mc.mgr("node0").enabled()
+    # double-enable errors (manager.erl:296-310)
+    assert mc.mgr("node0").enable() == "error"
+
+
+def test_client_kv_through_root():
+    mc = ManagedCluster(seed=11, nodes=("node0",))
+    mc.enable("node0")
+    c = mc.client("node0")
+    r = c.kover("root", "k", b"v")
+    assert r[0] == "ok"
+    r = c.kget("root", "k")
+    assert r[0] == "ok" and r[1].value == b"v"
+
+
+def test_client_unavailable_when_disabled():
+    mc = ManagedCluster(seed=12, nodes=("node0",))
+    c = mc.client("node0")
+    assert c.kget("root", "k") == ("error", "unavailable")
+
+
+def test_join_and_gossip_convergence():
+    mc = ManagedCluster(seed=13, nodes=("node0", "node1", "node2"))
+    mc.enable("node0")
+    mc.join("node1", "node0")
+    mc.join("node2", "node0")
+    assert mc.mgr("node0").cluster() == ["node0", "node1", "node2"]
+    # all managers converge on the same member set via gossip
+    for n in ("node1", "node2"):
+        assert mc.mgr(n).cluster() == ["node0", "node1", "node2"]
+
+
+def test_join_guards():
+    mc = ManagedCluster(seed=14, nodes=("node0", "node1"))
+    # joining a non-enabled cluster fails (join_allowed,
+    # manager.erl:518-532)
+    fut = mc.mgr("node1").join_async("node0", timeout=5.0)
+    result = mc.runtime.await_future(fut, timeout=10.0)
+    assert result == ("error", "remote_not_enabled")
+    # self-join rejected (manager.erl join/2 same-node clause)
+    mc.enable("node0")
+    fut = mc.mgr("node0").join_async("node0", timeout=5.0)
+    assert mc.runtime.await_future(fut, 10.0) == ("error", "same_node")
+    # two independently-enabled clusters cannot merge
+    mc.enable("node1")
+    fut = mc.mgr("node1").join_async("node0", timeout=5.0)
+    assert mc.runtime.await_future(fut, 10.0) == ("error",
+                                                  "already_enabled")
+
+
+def test_create_ensemble_starts_peers_via_gossip():
+    mc = ManagedCluster(seed=15, nodes=("node0", "node1", "node2"))
+    mc.enable("node0")
+    mc.join("node1", "node0")
+    mc.join("node2", "node0")
+
+    peers = [PeerId(i, f"node{i}") for i in range(3)]
+    mc.create_ensemble("ens1", peers)
+    leader = mc.wait_stable("ens1")
+    assert leader in peers
+
+    c = mc.client("node1")
+    assert c.kover("ens1", "key", b"val")[0] == "ok"
+    r = c.kget("ens1", "key")
+    assert r[0] == "ok" and r[1].value == b"val"
+    # reads routed from a non-member node work too
+    r2 = mc.client("node2").kget("ens1", "key")
+    assert r2[0] == "ok" and r2[1].value == b"val"
+
+
+def test_root_expand_and_remove():
+    """Grow the root ensemble across joined nodes, then remove a node
+    (replace_members flavor through the management API)."""
+    mc = ManagedCluster(seed=16, nodes=("node0", "node1", "node2"))
+    mc.enable("node0")
+    mc.join("node1", "node0")
+    mc.join("node2", "node0")
+
+    adds = [("add", PeerId("root", "node1")),
+            ("add", PeerId("root", "node2"))]
+    r = mc.update_members("root", adds)
+    assert r == "ok", r
+
+    def root_peers_started():
+        return all(
+            mc.runtime.whereis(("peer", "root", PeerId("root", n)))
+            is not None or
+            any(k[0] == "root" for k in mc.mgr(n).local_peers)
+            for n in ("node1", "node2"))
+    assert mc.runtime.run_until(root_peers_started, 60.0, poll=0.1)
+    mc.wait_stable("root")
+
+    # writes still work with the expanded root
+    c = mc.client("node0")
+    assert c.kover("root", "rk", b"rv")[0] == "ok"
+
+    # remove node2 from the cluster membership
+    mc.remove("node0", "node2")
+    assert mc.runtime.run_until(
+        lambda: "node2" not in mc.mgr("node0").cluster_state.members,
+        30.0, poll=0.1)
+
+
+def test_failover_with_managed_cluster():
+    """Leader failure under the full stack: suspend the ensemble
+    leader, client ops keep working after re-election."""
+    mc = ManagedCluster(seed=17, nodes=("node0", "node1", "node2"))
+    mc.enable("node0")
+    mc.join("node1", "node0")
+    mc.join("node2", "node0")
+    peers = [PeerId(i, f"node{i}") for i in range(3)]
+    mc.create_ensemble("ens1", peers)
+    leader = mc.wait_stable("ens1")
+
+    c = mc.client("node0")
+    assert c.kover("ens1", "k", b"v1")[0] == "ok"
+
+    mc.suspend_peer("ens1", leader)
+
+    def new_leader():
+        lid = mc.leader_id("ens1")
+        return lid is not None and lid != leader
+    assert mc.runtime.run_until(new_leader, 60.0)
+    mc.wait_stable("ens1")
+
+    def readable():
+        r = mc.client("node1").kget("ens1", "k", timeout=5.0)
+        return r[0] == "ok" and r[1].value == b"v1"
+    assert mc.runtime.run_until(readable, 60.0, poll=0.5)
