@@ -411,7 +411,16 @@ class Manager(Actor, Directory):
                     info: EnsembleInfo) -> None:
         ensemble, peer_id = key
         backend_cls = BACKENDS[info.mod]
-        probe = backend_cls(ensemble, peer_id, tuple(info.args))
+        args = tuple(info.args)
+        if not args and info.mod == "basic" and self.storage.path:
+            # The reference's basic backend derives its save file from
+            # the app-env data_root (basic_backend.erl:102-111); mirror
+            # that off the node's storage root so peer data survives
+            # stop/start cycles (membership removal + re-add).
+            import os
+            args = (os.path.dirname(os.path.dirname(self.storage.path)),)
+        info = __import__("dataclasses").replace(info, args=args)
+        probe = backend_cls(ensemble, peer_id, args)
         if not probe.ready_to_start():
             return
         if self.runtime.whereis(peer_name(ensemble, peer_id)) is not None:

@@ -49,6 +49,7 @@ from riak_ensemble_tpu.synctree import exchange as exchangelib
 from riak_ensemble_tpu.synctree.backends import DictBackend
 from riak_ensemble_tpu.types import (
     NOTFOUND, Fact, Obj, PeerId, initial_fact, latest_fact, members_of,
+    peer_order,
 )
 from riak_ensemble_tpu.worker import WorkerPool
 
@@ -176,6 +177,12 @@ class Peer(Actor):
             if watcher not in self.watchers:
                 self._notify_leader_status([watcher])
                 self.watchers.append(watcher)
+                # Watcher-death cleanup (erlang:monitor, peer.erl:1874,
+                # 1920-1925).
+                self.runtime.monitor(
+                    watcher,
+                    lambda w: self.watchers.remove(w)
+                    if w in self.watchers else None)
             return
         if kind == "stop_watching":
             if msg[1] in self.watchers:
@@ -847,7 +854,7 @@ class Peer(Actor):
         if errors:
             fut.resolve(("error", errors))
             return
-        new_view = tuple(sorted(set(view)))
+        new_view = tuple(sorted(set(view), key=peer_order))
         views2 = (new_view,) + tuple(self.views)
         new_fact = _fact_replace(
             self.fact, pending=((self.epoch, self.seq), views2))
@@ -891,8 +898,9 @@ class Peer(Actor):
     # step down / commit plumbing
 
     def _step_down(self, next_state: str = "probe") -> None:
-        """peer.erl:911-930."""
-        self._notify_leader_status(self.watchers)
+        """peer.erl:911-930.  Watchers are told the NEXT state
+        (notify_leader_status(Watchers, Next, ..), peer.erl:916)."""
+        self._notify_leader_status(self.watchers, leading=False)
         self.lease_obj.unlease()
         self._cancel_timer()
         self.workers.reset()
@@ -990,6 +998,10 @@ class Peer(Actor):
 
     def _backend_from(self, from_):
         """Normalize a wire-from or (future, _) into a backend From."""
+        if from_ is None:
+            # Fire-and-forget put (read-repair cast, peer.erl:1518-1536:
+            # From=undefined — the backend's reply is discarded).
+            return (lambda value: None, self.id)
         if isinstance(from_, tuple) and len(from_) == 2 and \
                 isinstance(from_[0], Future):
             return (from_[0], self.id)
@@ -1286,9 +1298,10 @@ class Peer(Actor):
     # ------------------------------------------------------------------
     # leadership watchers (peer.erl:212-218, 2070-2075)
 
-    def _notify_leader_status(self, watchers) -> None:
-        status = "is_leading" if self.fsm_state == "leading" else \
-            "is_not_leading"
+    def _notify_leader_status(self, watchers, leading=None) -> None:
+        if leading is None:
+            leading = self.fsm_state == "leading"
+        status = "is_leading" if leading else "is_not_leading"
         for w in list(watchers):
             if self.runtime.whereis(w) is None:
                 if w in self.watchers:

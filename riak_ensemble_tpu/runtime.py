@@ -194,6 +194,7 @@ class Runtime:
         self.actors: Dict[Any, Actor] = {}
         self.net = Network(self)
         self.trace: Optional[Callable[[str, Any], None]] = None
+        self._monitors: Dict[Any, List[Callable[[Any], None]]] = {}
 
     # -- registry ----------------------------------------------------------
 
@@ -209,6 +210,17 @@ class Runtime:
         if actor is not None:
             actor.alive = False
             actor.on_stop()
+            for fn in self._monitors.pop(name, []):
+                self.defer(lambda fn=fn: fn(name))
+
+    def monitor(self, name: Any, callback: Callable[[Any], None]) -> None:
+        """erlang:monitor analog: callback(name) fires (deferred) when
+        the named actor is stopped.  Monitoring a dead/unknown actor
+        fires immediately (the DOWN-on-monitor semantic)."""
+        if name not in self.actors:
+            self.defer(lambda: callback(name))
+            return
+        self._monitors.setdefault(name, []).append(callback)
 
     def suspend(self, name: Any) -> None:
         """Freeze an actor (erlang:suspend_process analog)."""

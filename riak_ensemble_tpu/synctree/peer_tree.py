@@ -92,6 +92,6 @@ class PeerTree(Actor):
             return
         level, bucket = self.corrupted
         if level == self.tree.height + 1:
-            self.tree.backend.delete((level, bucket))
+            self.tree._bdelete((level, bucket))
         self.tree.rehash()
         self.corrupted = None

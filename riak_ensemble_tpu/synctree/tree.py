@@ -104,7 +104,34 @@ class SyncTree:
         self.backend = backend if backend is not None else DictBackend()
         self._buffer: List[Tuple] = []
         # Reload top hash from storage (synctree.erl:174-177).
-        self.top_hash: Optional[bytes] = self.backend.fetch((0, 0), None)
+        self.top_hash: Optional[bytes] = self._bfetch((0, 0), None)
+
+    def _loc(self, loc):
+        """Namespace backend keys by tree id so many trees can share
+        one storage engine (the synctree_leveldb key layout
+        ``<<0, TreeId, Level, Bucket>>``, synctree_leveldb.erl:104-109;
+        exercised by the shared synctree_path mapping)."""
+        return loc if self.id is None else (self.id,) + loc
+
+    def _bfetch(self, loc, default=None):
+        return self.backend.fetch(self._loc(loc), default)
+
+    def _bstore(self, loc, value) -> None:
+        self.backend.store(self._loc(loc), value)
+
+    def _bexists(self, loc) -> bool:
+        return self.backend.exists(self._loc(loc))
+
+    def _bdelete(self, loc) -> None:
+        self.backend.delete(self._loc(loc))
+
+    def _bkeys(self):
+        """This tree's (level, bucket) keys, prefix-stripped."""
+        for k in self.backend.keys():
+            if self.id is None:
+                yield k
+            elif isinstance(k, tuple) and len(k) == 3 and k[0] == self.id:
+                yield k[1:]
 
     # -- basic ops ---------------------------------------------------------
 
@@ -113,7 +140,7 @@ class SyncTree:
         return int.from_bytes(digest, "big") % self.segments
 
     def _fetch(self, level: int, bucket: int) -> Dict[Any, bytes]:
-        return dict(self.backend.fetch((level, bucket), {}))
+        return dict(self._bfetch((level, bucket), {}))
 
     def get_path(self, segment: int):
         """Verified root→leaf path; returns list of ((level, bucket),
@@ -164,8 +191,8 @@ class SyncTree:
             child_hash = hash_bucket(hashes)
         updates.append(((0, 0), child_hash))
         for loc, val in updates[:-1]:
-            self.backend.store(loc, val)
-        self.backend.store((0, 0), child_hash)
+            self._bstore(loc, val)
+        self._bstore((0, 0), child_hash)
         self.top_hash = child_hash
         return None
 
@@ -208,7 +235,7 @@ class SyncTree:
         loc = (self.height + 1, segment)
         hashes = self._fetch(*loc)
         hashes.pop(key, None)
-        self.backend.store(loc, hashes)
+        self._bstore(loc, hashes)
 
     def corrupt_upper(self, key: Any, level: int = 1) -> None:
         """Corrupt an inner node on key's path (test hook for the
@@ -220,7 +247,7 @@ class SyncTree:
         if hashes:
             k = sorted(hashes, key=term_key)[0]
             hashes[k] = b"\x00" + b"\xde\xad" * 8
-            self.backend.store(loc, hashes)
+            self._bstore(loc, hashes)
 
     # -- repair ------------------------------------------------------------
 
@@ -237,14 +264,14 @@ class SyncTree:
         O(live buckets) instead of O(width^height)."""
         # Live buckets at max_depth level.
         level_buckets = sorted(
-            {b for (lvl, b) in self.backend.keys() if lvl == max_depth})
+            {b for (lvl, b) in self._bkeys() if lvl == max_depth})
         child_hashes: Dict[int, bytes] = {}
         for b in level_buckets:
             content = self._fetch(max_depth, b)
             if content:
                 child_hashes[b] = hash_bucket(content)
         for level in range(max_depth - 1, 0, -1):
-            existing = {b for (lvl, b) in self.backend.keys() if lvl == level}
+            existing = {b for (lvl, b) in self._bkeys() if lvl == level}
             parents: Dict[int, Dict[int, bytes]] = {}
             for child, h in child_hashes.items():
                 parents.setdefault(child >> self.shift, {})[child] = h
@@ -252,17 +279,17 @@ class SyncTree:
             for b in sorted(set(parents) | existing):
                 content = parents.get(b, {})
                 if content:
-                    self.backend.store((level, b), content)
+                    self._bstore((level, b), content)
                     child_hashes[b] = hash_bucket(content)
-                elif self.backend.exists((level, b)):
-                    self.backend.delete((level, b))
+                elif self._bexists((level, b)):
+                    self._bdelete((level, b))
         if child_hashes:
             assert set(child_hashes) == {0}
             self.top_hash = child_hashes[0]
-            self.backend.store((0, 0), self.top_hash)
+            self._bstore((0, 0), self.top_hash)
         else:
-            if self.backend.exists((0, 0)):
-                self.backend.delete((0, 0))
+            if self._bexists((0, 0)):
+                self._bdelete((0, 0))
             self.top_hash = None
 
     # -- verification ------------------------------------------------------

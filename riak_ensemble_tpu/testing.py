@@ -288,6 +288,59 @@ class ManagedCluster:
         return sync_send_event(self.runtime, peer_name(ensemble, lid),
                                ("update_members", tuple(changes)), timeout)
 
+    # -- ens_test.erl-style single-node harness (ens_test.erl:24-45) -----
+
+    @property
+    def node0(self) -> str:
+        return next(iter(self.managers))
+
+    def ens_start(self, n: int = 1) -> None:
+        """ens_test:start/0,1 — enable on one node, expand the root
+        ensemble to n peers (all hosted on that node: the reference's
+        central multi-peer-without-multi-node trick)."""
+        self.enable(self.node0)
+        if n > 1:
+            self.ens_expand(n)
+
+    def ens_expand(self, n: int) -> None:
+        """ens_test:expand/1 — root grows by peers {2..n, node0}."""
+        adds = [("add", PeerId(i, self.node0)) for i in range(2, n + 1)]
+        r = self.update_members("root", adds)
+        assert r == "ok", r
+        expected = [PeerId("root", self.node0)] + \
+            [PeerId(i, self.node0) for i in range(2, n + 1)]
+        self.wait_members("root", expected)
+        self.wait_stable("root")
+
+    def wait_members(self, ensemble, expected, max_time: float = 60.0):
+        """ens_test:wait_members — manager view includes expected."""
+        def ok():
+            members = self.mgr(self.node0).get_members(ensemble)
+            return all(p in members for p in expected)
+        assert self.runtime.run_until(ok, max_time, poll=0.1), \
+            f"members of {ensemble} never reached {expected}"
+
+    def kput(self, key, value, timeout: float = 5.0):
+        return self.client(self.node0).kover("root", key, value, timeout)
+
+    def kget(self, key, timeout: float = 5.0, opts=()):
+        return self.client(self.node0).kget("root", key, timeout, opts)
+
+    def read_until(self, key, max_time: float = 60.0):
+        """ens_test:read_until — retry until a non-notfound value is
+        readable; a successful read must never return notfound."""
+        c = self.client(self.node0)
+
+        def check():
+            r = c.kget("root", key, timeout=5.0)
+            if r[0] == "ok":
+                assert r[1].value is not NOTFOUND, \
+                    "read_until saw a notfound object (data loss)"
+                return True
+            return False
+        assert self.runtime.run_until(check, max_time, poll=0.1), \
+            f"key {key!r} never became readable"
+
     # -- introspection (shared logic with Cluster) -----------------------
 
     leader_id = Cluster.leader_id

@@ -162,6 +162,26 @@ class EnsembleInfo:
     args: Tuple[Any, ...] = ()
 
 
+def term_order(v: Any) -> Tuple:
+    """Total order over mixed-type terms (the Erlang term-order analog:
+    numbers < strings < everything else).  Peer ids mix integer and
+    string names (``{root, Node}`` vs ``{2, Node}``) and the usort of
+    members must be deterministic and identical on every peer."""
+    if isinstance(v, bool):
+        return (1, 0, repr(v))
+    if isinstance(v, (int, float)):
+        return (0, v, "")
+    if isinstance(v, str):
+        return (1, 0, v)
+    if isinstance(v, tuple):
+        return (2, 0, tuple(term_order(x) for x in v))
+    return (3, 0, repr(v))
+
+
+def peer_order(p: PeerId) -> Tuple:
+    return (term_order(p.name), term_order(p.node))
+
+
 def members_of(views: Views) -> Tuple[PeerId, ...]:
     """Canonical sorted union of all views (``compute_members`` =
     ``lists:usort(lists:append(Views))``,
@@ -169,7 +189,7 @@ def members_of(views: Views) -> Tuple[PeerId, ...]:
     seen = set()
     for view in views:
         seen.update(view)
-    return tuple(sorted(seen))
+    return tuple(sorted(seen, key=peer_order))
 
 
 # ---------------------------------------------------------------------------

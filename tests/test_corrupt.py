@@ -1,0 +1,136 @@
+"""corrupt_{segment,upper,follower,exchange}_test.erl parity: synctree
+corruption at segment/inner levels, on leader/follower/all peers.
+
+The system must DETECT corruption ({corrupted, Level, Bucket} on the
+verified read path, synctree.erl:302-340), step the peer down into
+repair (leading_kv tree_corrupted → step_down(repair),
+peer.erl:1276-1277), repair + re-exchange (peer_tree do_repair,
+exchange), and resume serving reads — and a read must NEVER return a
+bogus notfound for a key that was written (the invariant stated in
+test/corrupt_segment_test.erl:24-27).
+
+Corruption is injected two ways, mirroring the reference's intercepts
+(test/synctree_intercepts.erl):
+- post-hoc via ``SyncTree.corrupt``/``corrupt_upper`` (the
+  synctree:corrupt/2 deliberate-corruption hook), and
+- on the write path, wrapping the tree backend's ``store`` (the
+  m_store intercept), later restored like ``m_store_normal``.
+"""
+
+import pytest
+
+from riak_ensemble_tpu.testing import ManagedCluster
+from riak_ensemble_tpu.types import NOTFOUND, PeerId
+
+
+def _kget_never_notfound(mc, key, max_time=60.0):
+    """read_until with the data-loss assertion."""
+    mc.read_until(key, max_time)
+    r = mc.kget(key)
+    assert r[0] == "ok" and r[1].value is not NOTFOUND
+
+
+def _corrupt_store_hook(tree, victim_key):
+    """Write-path corruption (synctree_intercepts corrupt_segment):
+    flip a byte of the victim's leaf hash as it lands in storage."""
+    backend = tree.backend
+    orig_store = backend.store
+    leaf_level = tree.height + 1
+
+    def store(loc, value):
+        # loc is (level, bucket), tree-id-prefixed when the tree is
+        # namespaced — level is always the second-to-last element.
+        if isinstance(loc, tuple) and loc[-2] == leaf_level and \
+                isinstance(value, dict) and victim_key in value:
+            value = dict(value)
+            h = value[victim_key]
+            value[victim_key] = bytes([h[0] ^ 0xFF]) + h[1:]
+        orig_store(loc, value)
+
+    backend.store = store
+    return lambda: setattr(backend, "store", orig_store)
+
+
+def test_corrupt_segment_on_leader():
+    """corrupt_segment_test: leader's segment corrupted on the write
+    path; detection → repair → healed reads."""
+    mc = ManagedCluster(seed=30)
+    mc.ens_start(3)
+    leader = mc.leader_id("root")
+    tree = mc.tree_of("root", leader).tree
+
+    restore = _corrupt_store_hook(tree, "corrupt")
+    r = mc.kput("corrupt", b"test")
+    assert r[0] == "ok", r
+    restore()
+
+    _kget_never_notfound(mc, "corrupt")
+
+
+def test_corrupt_segment_posthoc():
+    """Deliberate post-write corruption of the leader's leaf entry."""
+    mc = ManagedCluster(seed=31)
+    mc.ens_start(3)
+    assert mc.kput("corrupt", b"test")[0] == "ok"
+
+    leader = mc.leader_id("root")
+    mc.tree_of("root", leader).tree.corrupt("corrupt")
+
+    _kget_never_notfound(mc, "corrupt")
+
+
+def test_corrupt_upper():
+    """corrupt_upper_test: inner-node corruption two levels above the
+    segment on a 5-peer ensemble heals."""
+    mc = ManagedCluster(seed=32)
+    mc.ens_start(5)
+    assert mc.kput("corrupt", b"test")[0] == "ok"
+
+    leader = mc.leader_id("root")
+    tree = mc.tree_of("root", leader).tree
+    tree.corrupt_upper("corrupt", level=tree.height - 1)
+
+    _kget_never_notfound(mc, "corrupt")
+
+
+def test_corrupt_follower():
+    """corrupt_follower_test: followers' segments corrupted, then the
+    (clean) leader suspended so a corrupted follower must win an
+    election — via repair/exchange — and serve the key."""
+    mc = ManagedCluster(seed=33)
+    mc.ens_start(3)
+    node = mc.node0
+    assert mc.kput("corrupt", b"test")[0] == "ok"
+    assert mc.kput("corrupt", b"test2")[0] == "ok"
+    assert mc.kget("corrupt")[0] == "ok"
+
+    leader = mc.leader_id("root")
+    members = [PeerId("root", node), PeerId(2, node), PeerId(3, node)]
+    for m in members:
+        if m != leader:
+            mc.tree_of("root", m).tree.corrupt("corrupt")
+
+    mc.suspend_peer("root", leader)
+    mc.runtime.run_for(2.0)
+    mc.resume_peer("root", leader)
+    mc.wait_stable("root")
+
+    _kget_never_notfound(mc, "corrupt", max_time=120.0)
+    r = mc.kget("corrupt")
+    assert r[1].value == b"test2"
+
+
+def test_corrupt_exchange():
+    """corrupt_exchange_test: EVERY peer's segment corrupted; trees
+    must repair (no trusted majority → all-trust path,
+    riak_ensemble_exchange.erl:128-145) and reads heal."""
+    mc = ManagedCluster(seed=34)
+    mc.ens_start(3)
+    node = mc.node0
+    assert mc.kput("corrupt", b"test")[0] == "ok"
+
+    members = [PeerId("root", node), PeerId(2, node), PeerId(3, node)]
+    for m in members:
+        mc.tree_of("root", m).tree.corrupt("corrupt")
+
+    _kget_never_notfound(mc, "corrupt", max_time=120.0)

@@ -1,0 +1,42 @@
+"""drop_write_test.erl parity: silently-dropped follower backend
+writes are healed by the read path (test/drop_write_test.erl:8-19).
+
+The intercept (riak_ensemble_basic_backend_intercepts.erl drop_put)
+acks puts of keys prefixed "drop" without storing them — on every peer
+except the one literally named "root".  After the leader (holding the
+only durable copy) is suspended and a new leader elected among the
+data-less peers, reads must heal via the quorum read + epoch-rewrite
+path once the old leader returns, and must never return notfound.
+"""
+
+import pytest
+
+from riak_ensemble_tpu import backend as backendlib
+from riak_ensemble_tpu.backend import BasicBackend
+from riak_ensemble_tpu.testing import ManagedCluster
+
+
+def test_drop_write_healed_by_read(monkeypatch):
+    orig_put = BasicBackend.put
+
+    def drop_put(self, key, obj, from_):
+        if isinstance(key, str) and key.startswith("drop") and \
+                self.peer_id.name != "root":
+            backendlib.reply(from_, obj)  # ack without storing
+        else:
+            orig_put(self, key, obj, from_)
+
+    monkeypatch.setattr(BasicBackend, "put", drop_put)
+
+    mc = ManagedCluster(seed=23)
+    mc.ens_start(5)
+
+    leader = mc.leader_id("root")
+    r = mc.kput("drop", b"test")
+    assert r[0] == "ok", r
+    assert mc.kget("drop")[0] == "ok"
+
+    mc.suspend_peer("root", leader)
+    mc.wait_stable("root")
+    mc.resume_peer("root", leader)
+    mc.read_until("drop")

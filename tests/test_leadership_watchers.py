@@ -1,0 +1,72 @@
+"""leadership_watchers.erl parity: watch_leader_status notifications
+and dead-watcher cleanup (test/leadership_watchers.erl:8-48).
+"""
+
+from riak_ensemble_tpu.peer import peer_name
+from riak_ensemble_tpu.runtime import Actor
+from riak_ensemble_tpu.testing import ManagedCluster
+
+
+class Watcher(Actor):
+    def __init__(self, runtime, name, node) -> None:
+        super().__init__(runtime, name, node)
+        self.statuses = []
+
+    def handle(self, msg):
+        self.statuses.append(msg)
+
+    def last_status(self):
+        return self.statuses[-1][0] if self.statuses else None
+
+
+def test_leadership_watchers():
+    mc = ManagedCluster(seed=26)
+    mc.ens_start(3)
+    node = mc.node0
+
+    leader = mc.leader_id("root")
+    lname = peer_name("root", leader)
+    lpeer = mc.peer("root", leader)
+    assert len(lpeer.watchers) == 0
+
+    w1 = Watcher(mc.runtime, ("watcher", 1), node)
+    mc.runtime.post(lname, ("watch_leader_status", w1.name))
+    mc.runtime.run_for(0.1)
+    assert len(lpeer.watchers) == 1
+    assert w1.last_status() == "is_leading"
+
+    # stop watching
+    mc.runtime.post(lname, ("stop_watching", w1.name))
+    mc.runtime.run_for(0.1)
+    assert len(lpeer.watchers) == 0
+
+    # watch again
+    mc.runtime.post(lname, ("watch_leader_status", w1.name))
+    mc.runtime.run_for(0.1)
+    assert len(lpeer.watchers) == 1
+    assert w1.last_status() == "is_leading"
+
+    # suspend leader; new leader elected; resumed ex-leader notifies
+    # is_not_leading
+    mc.suspend_peer("root", leader)
+    mc.wait_stable("root")
+    mc.resume_peer("root", leader)
+
+    def not_leading():
+        mc.runtime.run_for(0.05)
+        return w1.last_status() == "is_not_leading"
+    assert mc.runtime.run_until(not_leading, 60.0, poll=0.1)
+
+    # a second watcher registers; after it dies it is pruned
+    w2 = Watcher(mc.runtime, ("watcher", 2), node)
+    mc.runtime.post(lname, ("watch_leader_status", w2.name))
+    mc.runtime.run_for(0.1)
+    assert len(lpeer.watchers) == 2
+
+    mc.runtime.stop_actor(w2.name)
+
+    def pruned():
+        mc.runtime.run_for(0.05)
+        return len(lpeer.watchers) == 1
+    assert mc.runtime.run_until(pruned, 60.0, poll=0.1), \
+        "dead watcher not removed"

@@ -1,0 +1,54 @@
+"""read_tombstone_test.erl parity: the tombstone-avoidance
+optimization (test/read_tombstone_test.erl:16-47).
+
+A notfound read normally writes a tombstone in case an unseen partial
+write exists.  If the leader waits ``notfound_read_delay`` for replies
+from EVERY peer and all say notfound, the tombstone write is skipped
+(all_or_quorum required mode, msg.erl:282-317; update_key skip,
+peer.erl:1568-1584).  With a member suspended, full responses can't
+arrive and the tombstone must be written.
+"""
+
+from riak_ensemble_tpu.testing import ManagedCluster
+from riak_ensemble_tpu.types import NOTFOUND, PeerId
+
+
+def _has_tombstone(mc, member, key) -> bool:
+    """debug_local_get analog: a tombstone is an Obj wrapping NOTFOUND
+    in the backend, vs no entry at all."""
+    peer = mc.peer("root", member)
+    assert peer is not None
+    return key in peer.mod.data
+
+
+def test_tombstone_avoidance():
+    mc = ManagedCluster(seed=25)
+    mc.ens_start(3)
+    mc.config.notfound_read_delay = 3.0
+
+    node = mc.node0
+    leader = mc.leader_id("root")
+    members = [PeerId("root", node), PeerId(2, node), PeerId(3, node)]
+    followers = [m for m in members if m != leader]
+
+    # All peers respond: read returns notfound with NO tombstones.
+    r = mc.kget("test")
+    assert r[0] == "ok" and r[1].value is NOTFOUND
+    mc.runtime.run_for(1.0)
+    for m in members:
+        assert not _has_tombstone(mc, m, "test"), f"tombstone on {m}"
+
+    # One member suspended + no delay: tombstones must be written on
+    # the active peers.
+    mc.config.notfound_read_delay = 0.0
+    mc.suspend_peer("root", followers[1])
+    r = mc.kget("test2")
+    assert r[0] == "ok" and r[1].value is NOTFOUND
+    mc.resume_peer("root", followers[1])
+
+    def tombstoned():
+        mc.runtime.run_for(0.05)
+        return _has_tombstone(mc, leader, "test2") and \
+            _has_tombstone(mc, followers[0], "test2")
+    assert mc.runtime.run_until(tombstoned, 30.0, poll=0.1), \
+        "active peers missing tombstones"
