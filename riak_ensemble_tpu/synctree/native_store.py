@@ -1,0 +1,105 @@
+"""Synctree backend over the C++ treestore engine.
+
+The role ``synctree_leveldb.erl`` + eleveldb play for the reference:
+durable Merkle-bucket storage with a shared-engine registry (many
+trees, one store — synctree_leveldb.erl:52-83) and batched sequential
+writes.  Keys/values are pickled terms; the engine stores raw bytes
+(``native/treestore.cc``: CRC-framed WAL + ordered in-memory index +
+snapshot compaction).
+
+Use :func:`available` to gate tests/deployments; construction raises
+RuntimeError when the native library cannot be built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pickle
+from typing import Any, Iterable, List
+
+from riak_ensemble_tpu.utils import native
+
+
+def available() -> bool:
+    return native.load() is not None
+
+
+def _enc(term: Any) -> bytes:
+    return pickle.dumps(term, protocol=4)
+
+
+def _dec(blob: bytes) -> Any:
+    return pickle.loads(blob)
+
+
+class NativeBackend:
+    """Implements the synctree storage interface
+    (fetch/exists/store/delete/keys) over the C++ engine."""
+
+    def __init__(self, path: str) -> None:
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError("native treestore unavailable "
+                               "(g++/make missing?)")
+        self._lib = lib
+        self._handle = lib.retpu_store_open(path.encode())
+        if not self._handle:
+            raise RuntimeError(f"cannot open treestore at {path}")
+        self.path = path
+
+    # -- backend interface ------------------------------------------------
+
+    def fetch(self, key, default=None):
+        k = _enc(key)
+        n = self._lib.retpu_store_get(self._handle, k, len(k), None, 0)
+        if n < 0:
+            return default
+        buf = ctypes.create_string_buffer(n)
+        n2 = self._lib.retpu_store_get(self._handle, k, len(k), buf, n)
+        if n2 != n:  # pragma: no cover - single-threaded host
+            return default
+        return _dec(buf.raw)
+
+    def exists(self, key) -> bool:
+        k = _enc(key)
+        return self._lib.retpu_store_get(self._handle, k, len(k),
+                                         None, 0) >= 0
+
+    def store(self, key, value) -> None:
+        k, v = _enc(key), _enc(value)
+        self._lib.retpu_store_put(self._handle, k, len(k), v, len(v))
+
+    def delete(self, key) -> None:
+        k = _enc(key)
+        self._lib.retpu_store_delete(self._handle, k, len(k))
+
+    def keys(self) -> Iterable:
+        out: List[Any] = []
+        i = 0
+        while True:
+            n = self._lib.retpu_store_key_at(self._handle, i, None, 0)
+            if n < 0:
+                break
+            buf = ctypes.create_string_buffer(n)
+            if self._lib.retpu_store_key_at(self._handle, i, buf,
+                                            n) != n:  # pragma: no cover
+                break
+            out.append(_dec(buf.raw))
+            i += 1
+        return out
+
+    # -- engine management --------------------------------------------------
+
+    def sync(self) -> None:
+        self._lib.retpu_store_sync(self._handle)
+
+    def compact(self) -> None:
+        self._lib.retpu_store_compact(self._handle)
+
+    def count(self) -> int:
+        return self._lib.retpu_store_count(self._handle)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.retpu_store_close(self._handle)
+            self._handle = None

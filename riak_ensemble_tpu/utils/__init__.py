@@ -1,0 +1,1 @@
+"""Host utilities: native-library loader, monotonic clock, tracing."""

@@ -1,0 +1,51 @@
+"""Monotonic clock backing the leader lease.
+
+The C++ module (``native/clock.cc``) is the production source — the
+role of the reference's only C NIF (c_src/riak_ensemble_clock.c):
+CLOCK_BOOTTIME-preferred readings immune to wall-clock jumps and
+suspend/resume gaps, consumed by the lease check
+(riak_ensemble_lease.erl:76-88).  Falls back to Python's
+``time.clock_gettime(CLOCK_BOOTTIME)`` / ``time.monotonic_ns`` when
+the native library can't be built.
+"""
+
+from __future__ import annotations
+
+import time
+
+from riak_ensemble_tpu.utils import native
+
+
+def _py_monotonic_ns() -> int:
+    try:
+        return time.clock_gettime_ns(time.CLOCK_BOOTTIME)  # type: ignore[attr-defined]
+    except (AttributeError, OSError):
+        return time.monotonic_ns()
+
+
+def monotonic_time_ns() -> int:
+    lib = native.load()
+    if lib is not None:
+        t = lib.retpu_monotonic_time_ns()
+        if t >= 0:
+            return t
+    return _py_monotonic_ns()
+
+
+def monotonic_time_ms() -> int:
+    """riak_ensemble_clock:monotonic_time_ms/0."""
+    return monotonic_time_ns() // 1_000_000
+
+
+def monotonic_time() -> float:
+    """Seconds as float — the host runtime's clock interface (inject
+    into :class:`riak_ensemble_tpu.lease.Lease` in production; the
+    virtual runtime injects simulated time instead)."""
+    return monotonic_time_ns() / 1e9
+
+
+def is_boottime() -> bool:
+    lib = native.load()
+    if lib is not None:
+        return bool(lib.retpu_clock_is_boottime())
+    return hasattr(time, "CLOCK_BOOTTIME")

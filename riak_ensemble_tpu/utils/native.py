@@ -1,0 +1,80 @@
+"""Loader for the C++ native library (``native/``).
+
+Builds ``libretpu_native.so`` on first use via make (the image ships
+g++; no pybind11, so the ABI is plain C + ctypes) and memoizes the
+handle.  ``load()`` returns None if the toolchain is unavailable —
+callers must degrade to their documented Python fallbacks, mirroring
+how the reference degrades when its NIF fails to load
+(riak_ensemble_clock.erl:30-42 falls back by crashing the lease path;
+we degrade more gracefully).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+SONAME = os.path.join(NATIVE_DIR, "libretpu_native.so")
+
+
+def _build() -> bool:
+    try:
+        proc = subprocess.run(["make", "-C", NATIVE_DIR],
+                              capture_output=True, timeout=120)
+        return proc.returncode == 0 and os.path.exists(SONAME)
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it if needed; None if the
+    native toolchain is unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(SONAME) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(SONAME)
+        except OSError:
+            return None
+        # clock
+        lib.retpu_monotonic_time_ns.restype = ctypes.c_int64
+        lib.retpu_monotonic_time_ms.restype = ctypes.c_int64
+        lib.retpu_clock_is_boottime.restype = ctypes.c_int
+        # treestore
+        lib.retpu_store_open.restype = ctypes.c_void_p
+        lib.retpu_store_open.argtypes = [ctypes.c_char_p]
+        lib.retpu_store_close.argtypes = [ctypes.c_void_p]
+        lib.retpu_store_put.restype = ctypes.c_int
+        lib.retpu_store_put.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint32]
+        lib.retpu_store_get.restype = ctypes.c_int64
+        lib.retpu_store_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint64]
+        lib.retpu_store_delete.restype = ctypes.c_int
+        lib.retpu_store_delete.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+        lib.retpu_store_count.restype = ctypes.c_uint64
+        lib.retpu_store_count.argtypes = [ctypes.c_void_p]
+        lib.retpu_store_key_at.restype = ctypes.c_int64
+        lib.retpu_store_key_at.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64]
+        lib.retpu_store_sync.argtypes = [ctypes.c_void_p]
+        lib.retpu_store_compact.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
