@@ -1,0 +1,266 @@
+"""Linearizability checking harness — the sc.erl analog.
+
+The reference's real consistency test (``test/sc.erl``, 1071 LoC EQC
+statem) drives random concurrent kget/kover/kput_once/kupdate/kdelete
+from N workers against a live cluster, injects partitions/heals, and
+checks postconditions: every acked write is observed, reads return a
+plausible value, and acked data is never lost ("Data loss!" check,
+sc.erl:835-880; read postcondition sc.erl:112-148).
+
+This module re-creates that as a deterministic virtual-time harness:
+
+- :class:`KeyModel` — per-key set of *plausible current values*.  An
+  acked write fixes the state to its value (plus any still-in-flight
+  concurrent writes, which may legally serialize after it).  A
+  timed-out write MAY have applied (now or once its queued put reaches
+  quorum), so its value joins the plausible set.  A CAS-failed write
+  did not apply.  A successful read both validates against and
+  re-pins the plausible set — that is the linearizable-read property.
+- :class:`Workload` — N sequential workers (runtime tasks) issuing a
+  random op mix through the real router/client path, a nemesis
+  schedule (peer suspensions; node partitions healed before checking),
+  and the final quiesced read-back verifying no acked write was lost.
+
+Any violation raises :class:`Violation` with the offending history
+tail for debugging.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from riak_ensemble_tpu import router as routerlib
+from riak_ensemble_tpu.peer import do_kput_once, do_kupdate
+from riak_ensemble_tpu.runtime import Runtime
+from riak_ensemble_tpu.types import NOTFOUND, Obj
+
+_op_ids = itertools.count(1)
+
+
+class Violation(AssertionError):
+    pass
+
+
+def _val(v: Any) -> Any:
+    """Hashable value token (NOTFOUND is a singleton already)."""
+    return v
+
+
+@dataclass
+class _Inflight:
+    op_id: int
+    value: Any
+
+
+@dataclass
+class KeyModel:
+    """Plausible-current-value tracking for one key (the sc.erl
+    possible-values postcondition model)."""
+
+    key: Any
+    possible: Set[Any] = field(default_factory=lambda: {NOTFOUND})
+    inflight: Dict[int, _Inflight] = field(default_factory=dict)
+    history: List[Tuple] = field(default_factory=list)
+
+    def _inflight_values(self, exclude: Optional[int] = None) -> Set[Any]:
+        return {w.value for w in self.inflight.values()
+                if w.op_id != exclude}
+
+    def invoke_write(self, value: Any) -> int:
+        op_id = next(_op_ids)
+        self.inflight[op_id] = _Inflight(op_id, _val(value))
+        self.history.append(("invoke", op_id, value))
+        return op_id
+
+    def ack_write(self, op_id: int) -> None:
+        w = self.inflight.pop(op_id)
+        # Linearization point inside the op window: state is now w's
+        # value; in-flight concurrent writes may serialize after it.
+        self.possible = {w.value} | self._inflight_values()
+        self.history.append(("ack", op_id, w.value))
+
+    def fail_write(self, op_id: int) -> None:
+        """CAS precondition failure — op did NOT apply (sc.erl treats
+        {error,failed} CAS results as no-ops)."""
+        self.inflight.pop(op_id, None)
+        self.history.append(("failed", op_id))
+
+    def timeout_write(self, op_id: int) -> None:
+        """Outcome unknown: may have applied, may apply while its
+        epoch is still current — its value stays plausible."""
+        w = self.inflight.pop(op_id)
+        self.possible.add(w.value)
+        self.history.append(("timeout", op_id, w.value))
+
+    def ack_read(self, value: Any) -> None:
+        value = _val(value)
+        valid = self.possible | self._inflight_values()
+        if value not in valid:
+            raise Violation(
+                f"read of {self.key!r} returned {value!r}; plausible "
+                f"was {valid!r}\nhistory tail: {self.history[-12:]}")
+        if value is NOTFOUND and NOTFOUND not in self.possible and \
+                NOTFOUND not in self._inflight_values():
+            raise Violation(f"DATA LOSS on {self.key!r}: notfound read "
+                            f"but a write must be visible")
+        # A linearizable read pins the state.
+        self.possible = {value} | self._inflight_values()
+        self.history.append(("read", value))
+
+
+class Workload:
+    """Concurrent random workload + nemesis against one ensemble."""
+
+    OPS = ("kget", "kover", "kput_once", "kupdate", "kdelete")
+
+    def __init__(self, mc, ensemble: Any, n_workers: int = 3,
+                 n_keys: int = 4, ops_per_worker: int = 60,
+                 op_timeout: float = 8.0, seed: int = 0,
+                 nemesis_hold: Tuple[float, float] = (0.3, 1.5)) -> None:
+        import random
+
+        self.mc = mc
+        self.runtime: Runtime = mc.runtime
+        self.ensemble = ensemble
+        self.rng = random.Random(seed)
+        self.keys = [f"k{i}" for i in range(n_keys)]
+        self.models: Dict[Any, KeyModel] = {k: KeyModel(k)
+                                            for k in self.keys}
+        self.n_workers = n_workers
+        self.ops_per_worker = ops_per_worker
+        self.op_timeout = op_timeout
+        self.done = 0
+        self.nemesis_hold = nemesis_hold
+        self.op_counts: Dict[str, int] = {}
+        self.violations: List[Violation] = []
+
+    # -- op plumbing -------------------------------------------------------
+
+    def _sync(self, node, event):
+        return routerlib.sync_send_event_fut(
+            self.runtime, node, self.ensemble, event, self.op_timeout)
+
+    def _worker(self, widx: int):
+        nodes = list(self.mc.managers)
+        last_read: Dict[Any, Obj] = {}
+        for _ in range(self.ops_per_worker):
+            key = self.rng.choice(self.keys)
+            node = self.rng.choice(nodes)
+            op = self.rng.choice(self.OPS)
+            model = self.models[key]
+            self.op_counts[op] = self.op_counts.get(op, 0) + 1
+            try:
+                if op == "kget":
+                    result = yield self._sync(node, ("get", key, ()))
+                    if isinstance(result, tuple) and result[0] == "ok":
+                        last_read[key] = result[1]
+                        model.ack_read(result[1].value)
+                elif op == "kupdate":
+                    cur = last_read.get(key)
+                    if cur is None:
+                        continue
+                    value = f"w{widx}-{self.rng.randrange(10**6)}".encode()
+                    op_id = model.invoke_write(value)
+                    result = yield self._sync(
+                        node, ("put", key, do_kupdate, [cur, value]))
+                    self._settle_write(model, op_id, result)
+                elif op == "kput_once":
+                    value = f"p{widx}-{self.rng.randrange(10**6)}".encode()
+                    op_id = model.invoke_write(value)
+                    result = yield self._sync(
+                        node, ("put", key, do_kput_once, [value]))
+                    self._settle_write(model, op_id, result)
+                elif op == "kdelete":
+                    op_id = model.invoke_write(NOTFOUND)
+                    result = yield self._sync(
+                        node, ("overwrite", key, NOTFOUND))
+                    self._settle_write(model, op_id, result)
+                else:  # kover
+                    value = f"o{widx}-{self.rng.randrange(10**6)}".encode()
+                    op_id = model.invoke_write(value)
+                    result = yield self._sync(
+                        node, ("overwrite", key, value))
+                    self._settle_write(model, op_id, result)
+            except Violation as v:
+                self.violations.append(v)
+                break
+            # Think time stretches the workload across many nemesis
+            # windows (ops are ~ms in virtual time; without this the
+            # whole run fits between two nemesis actions).
+            yield self.runtime.sleep(self.rng.uniform(0.05, 0.3))
+        self.done += 1
+
+    @staticmethod
+    def _is_ok(result) -> bool:
+        return isinstance(result, tuple) and result[0] == "ok"
+
+    def _settle_write(self, model: KeyModel, op_id: int, result) -> None:
+        if self._is_ok(result):
+            model.ack_write(op_id)
+        elif result == "failed":
+            model.fail_write(op_id)
+        else:  # timeout / unavailable: outcome unknown
+            model.timeout_write(op_id)
+
+    # -- nemesis -----------------------------------------------------------
+
+    def _nemesis(self, duration: float, partitions: bool):
+        members = list(self.mc.mgr(self.mc.node0).get_members(
+            self.ensemble)) or []
+        nodes = sorted({m.node for m in members})
+        end = self.runtime.now + duration
+        while self.runtime.now < end and self.done < self.n_workers:
+            action = self.rng.random()
+            lo, hi = self.nemesis_hold
+            if action < 0.5 and members:
+                # freeze a random peer (suspend_process analog)
+                victim = self.rng.choice(members)
+                self.mc.suspend_peer(self.ensemble, victim)
+                yield self.runtime.sleep(self.rng.uniform(lo, hi))
+                self.mc.resume_peer(self.ensemble, victim)
+            elif partitions and len(nodes) >= 3:
+                # cut off a minority node (sc.erl partition_nodes)
+                victim = self.rng.choice(nodes)
+                rest = [n for n in nodes if n != victim]
+                self.runtime.net.partition([victim], rest)
+                yield self.runtime.sleep(self.rng.uniform(lo, 2 * hi))
+                self.runtime.net.heal()
+            yield self.runtime.sleep(self.rng.uniform(0.1, 0.5))
+        self.runtime.net.heal()
+
+    # -- run ---------------------------------------------------------------
+
+    def run(self, max_virtual: float = 600.0,
+            partitions: bool = True) -> None:
+        for w in range(self.n_workers):
+            self.runtime.spawn_task(self._worker(w), name=f"sc-worker{w}")
+        self.runtime.spawn_task(self._nemesis(max_virtual, partitions),
+                                name="sc-nemesis")
+        ok = self.runtime.run_until(
+            lambda: self.done >= self.n_workers or self.violations,
+            max_time=max_virtual, poll=0.5)
+        if self.violations:
+            raise self.violations[0]
+        if not ok:
+            raise Violation("workload did not finish in virtual budget")
+        self._final_check()
+
+    def _final_check(self) -> None:
+        """Quiesced read-back: heal everything, then every key must
+        read back a plausible value (no acked write lost)."""
+        self.runtime.net.heal()
+        self.mc.wait_stable(self.ensemble, max_time=120.0)
+        client = self.mc.client(self.mc.node0)
+        for key in self.keys:
+            model = self.models[key]
+
+            def read_ok(key=key, model=model):
+                r = client.kget(self.ensemble, key, timeout=5.0)
+                if not self._is_ok(r):
+                    return False
+                model.ack_read(r[1].value)
+                return True
+            if not self.runtime.run_until(read_ok, 60.0, poll=0.5):
+                raise Violation(f"final read of {key!r} never succeeded")

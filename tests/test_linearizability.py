@@ -1,0 +1,97 @@
+"""sc.erl analog: randomized concurrent K/V workloads with peer
+freezes and network partitions; plausible-value + no-data-loss
+postconditions (test/sc.erl get_post:112-148, prop_sc:835-880).
+"""
+
+import pytest
+
+from riak_ensemble_tpu.linearizability import KeyModel, Violation, Workload
+from riak_ensemble_tpu.testing import ManagedCluster
+from riak_ensemble_tpu.types import NOTFOUND, PeerId
+
+
+# -- model unit tests -------------------------------------------------------
+
+
+def test_model_accepts_acked_write_read():
+    m = KeyModel("k")
+    op = m.invoke_write(b"a")
+    m.ack_write(op)
+    m.ack_read(b"a")
+
+
+def test_model_rejects_stale_read():
+    m = KeyModel("k")
+    op = m.invoke_write(b"a")
+    m.ack_write(op)
+    op2 = m.invoke_write(b"b")
+    m.ack_write(op2)
+    with pytest.raises(Violation):
+        m.ack_read(b"a")  # superseded by acked b
+
+
+def test_model_rejects_lost_write():
+    m = KeyModel("k")
+    op = m.invoke_write(b"a")
+    m.ack_write(op)
+    with pytest.raises(Violation):
+        m.ack_read(NOTFOUND)  # data loss
+
+
+def test_model_concurrent_write_may_win():
+    m = KeyModel("k")
+    op1 = m.invoke_write(b"a")
+    op2 = m.invoke_write(b"b")  # concurrent
+    m.ack_write(op1)
+    m.ack_write(op2)
+    m.ack_read(b"b")
+    with pytest.raises(Violation):
+        m.ack_read(b"a")
+
+
+def test_model_timeout_write_remains_plausible():
+    m = KeyModel("k")
+    op1 = m.invoke_write(b"a")
+    m.ack_write(op1)
+    op2 = m.invoke_write(b"b")
+    m.timeout_write(op2)  # unknown outcome
+    m.ack_read(b"b")      # it may have landed
+    m.ack_read(b"b")
+    with pytest.raises(Violation):
+        m.ack_read(b"a")  # read pinned the state to b
+
+
+# -- single-node ensemble under peer freezes --------------------------------
+
+
+@pytest.mark.parametrize("seed", [101, 102])
+def test_workload_single_node_freezes(seed):
+    mc = ManagedCluster(seed=seed)
+    mc.ens_start(3)
+    w = Workload(mc, "root", n_workers=3, n_keys=3, ops_per_worker=40,
+                 seed=seed)
+    w.run(partitions=False)
+    assert sum(w.op_counts.values()) >= 120
+
+
+# -- multi-node ensemble under partitions (sc.erl partition_nodes) ----------
+
+
+@pytest.mark.parametrize("seed", [201])
+def test_workload_multinode_partitions(seed):
+    mc = ManagedCluster(seed=seed, nodes=("node0", "node1", "node2"))
+    mc.enable("node0")
+    mc.join("node1", "node0")
+    mc.join("node2", "node0")
+    peers = [PeerId(i, f"node{i}") for i in range(3)]
+    mc.create_ensemble("sc", peers)
+    mc.wait_stable("sc")
+
+    # Short op timeout + long partition holds so some ops genuinely
+    # time out with unknown outcome (the hard case for the model).
+    w = Workload(mc, "sc", n_workers=3, n_keys=3, ops_per_worker=30,
+                 op_timeout=1.0, seed=seed, nemesis_hold=(0.5, 2.5))
+    w.run(partitions=True)
+    assert sum(w.op_counts.values()) >= 90
+    outcomes = {ev[0] for m in w.models.values() for ev in m.history}
+    assert "ack" in outcomes and "read" in outcomes
