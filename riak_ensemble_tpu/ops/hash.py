@@ -1,0 +1,179 @@
+"""synctree_jax: the Merkle hash trie as a batched TPU kernel.
+
+The host :class:`~riak_ensemble_tpu.synctree.tree.SyncTree` mirrors the
+reference's per-peer trie (md5 buckets, width 16, 1M segments —
+synctree.erl:88-89,251-259) for protocol-faithful per-op updates.  This
+module is the scale path (BASELINE.md ladder #4, "1M-key Merkle
+exchange"): the whole trie as a structure-of-arrays program —
+
+- ``levels[k]``: ``[width**k, LANES]`` uint32 hash lanes, level 0 the
+  root (1 bucket), the last level the segment/leaf hashes,
+- :func:`build` — one fused bottom-up rebuild (``rehash``'s role,
+  synctree.erl:489-535) as per-level fold-reductions that XLA
+  vectorizes across every bucket at once,
+- :func:`update` — incremental batched insert: scatter new leaf hashes
+  and recompute only the touched root-ward paths (the always-up-to-date
+  write-path property, synctree.erl:44-73 — NOT a lazy full rebuild),
+- :func:`diff_levels` / :func:`exchange_cost` — the level-by-level
+  exchange descent (synctree.erl:372-417): per-level differing-bucket
+  masks, giving the O(width · height · diffs) traffic bound that the
+  streaming exchange ships over the network,
+- :func:`verify` — full integrity check: recompute every parent from
+  its children and flag mismatched buckets ({corrupted, Level, Bucket}
+  detection, synctree.erl:322-340, as a bitmap).
+
+Hash lanes are a murmur3-style mix — not md5: inside jit the hash only
+needs uniformity + avalanche (corruption/diff detection), and a 4-lane
+128-bit mix keeps the MXU-adjacent VPU busy instead of forcing a
+byte-serial digest.  The host tree keeps cryptographic md5 where the
+reference does.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: 4 x uint32 lanes = 128-bit hashes per bucket.
+LANES = 4
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+_F1 = np.uint32(0x85EBCA6B)
+_F2 = np.uint32(0xC2B2AE35)
+
+
+def _rotl(x, r: int):
+    return (x << r) | (x >> (32 - r))
+
+
+def _fmix(h):
+    """murmur3 finalizer: full avalanche per lane."""
+    h = h ^ (h >> 16)
+    h = h * _F1
+    h = h ^ (h >> 13)
+    h = h * _F2
+    return h ^ (h >> 16)
+
+
+def fold(children: jnp.ndarray) -> jnp.ndarray:
+    """Combine ``[..., width, LANES]`` child hashes into ``[..., LANES]``
+    parent hashes (the md5-over-concatenated-children role,
+    synctree.erl hash/1:255-259).
+
+    The width loop is static (width is a compile-time constant), so XLA
+    unrolls and fuses it into one pass over the level.
+    """
+    width = children.shape[-2]
+    acc = jnp.full(children.shape[:-2] + (LANES,), np.uint32(0x9E3779B9))
+    for i in range(width):
+        k = children[..., i, :] * _C1
+        k = _rotl(k, 15) * _C2
+        acc = acc ^ k
+        acc = _rotl(acc, 13) * np.uint32(5) + np.uint32(0xE6546B64)
+        # cross-lane stir so lane j depends on lane j-1
+        acc = acc ^ jnp.roll(acc, 1, axis=-1)
+    return _fmix(acc ^ np.uint32(width))
+
+
+def leaf_hash(epoch: jnp.ndarray, seq: jnp.ndarray) -> jnp.ndarray:
+    """Object-version leaf hashes: the reference's obj 'hash' IS the
+    (epoch, seq) version (``get_obj_hash`` = ``<<0, Epoch:64, Seq:64>>``,
+    peer.erl:1717-1724); mix them into the lane format.  Shapes
+    broadcast; returns ``[..., LANES]``."""
+    e = jnp.asarray(epoch, jnp.uint32)
+    s = jnp.asarray(seq, jnp.uint32)
+    base = jnp.stack([e, s, e ^ _rotl(s, 7), s ^ _rotl(e, 11)], axis=-1)
+    return _fmix(base * _C1 + jnp.arange(LANES, dtype=jnp.uint32))
+
+
+Levels = Tuple[jnp.ndarray, ...]
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def build(leaves: jnp.ndarray, width: int = 16) -> Levels:
+    """Bottom-up rebuild: ``leaves [S, LANES]`` → levels root-first
+    (root ``[1, LANES]`` ... leaves ``[S, LANES]``)."""
+    levels = [leaves]
+    cur = leaves
+    while cur.shape[0] > 1:
+        cur = fold(cur.reshape(-1, width, LANES))
+        levels.append(cur)
+    return tuple(reversed(levels))
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def update(levels: Levels, seg_ids: jnp.ndarray,
+           new_leaves: jnp.ndarray, width: int = 16) -> Levels:
+    """Incremental batched insert (the write-path hash update,
+    peer.erl:1731-1738, batched across K keys).
+
+    ``seg_ids [K]`` / ``new_leaves [K, LANES]``: scatter the leaf
+    hashes, then per level recompute only the K touched parents by
+    gathering their ``width`` children — O(K · width · height) work
+    regardless of tree size.  Duplicate parents recompute identically,
+    so the scatter is idempotent.
+    """
+    out = list(levels)
+    depth = len(levels) - 1  # leaf level index
+    out[depth] = out[depth].at[seg_ids].set(new_leaves)
+    ids = seg_ids
+    for level in range(depth - 1, -1, -1):
+        parent_ids = ids // width
+        child_base = parent_ids * width
+        # [K, width] child indices → gather [K, width, LANES]
+        gather_ids = child_base[:, None] + jnp.arange(width)[None, :]
+        children = out[level + 1][gather_ids]
+        out[level] = out[level].at[parent_ids].set(fold(children))
+        ids = parent_ids
+    return tuple(out)
+
+
+@jax.jit
+def diff_levels(a: Levels, b: Levels) -> Tuple[jnp.ndarray, ...]:
+    """Per-level differing-bucket masks between two trees — the
+    device-side form of the exchange descent (synctree.erl:386-417).
+    Mask k is True where bucket hashes differ at level k; the leaf
+    mask marks exactly the segments whose keys need repair."""
+    return tuple(jnp.any(x != y, axis=-1) for x, y in zip(a, b))
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def exchange_cost(a: Levels, b: Levels, width: int = 16) -> jnp.ndarray:
+    """Buckets that a streaming exchange would actually fetch: at each
+    level only children of differing parents are visited
+    (O(width·height·diffs), the remote-exchange traffic bound
+    exercised by synctree_remote.erl).  Returns ``[height+1]`` visit
+    counts root-ward → leaf-ward."""
+    masks = diff_levels(a, b)
+    counts = [jnp.asarray(1, jnp.int32)]  # root always compared
+    visit = masks[0]  # [1]
+    for level in range(1, len(masks)):
+        # children of differing parents are visited...
+        visited_children = jnp.repeat(visit, width)
+        counts.append(jnp.sum(visited_children.astype(jnp.int32)))
+        # ...and among those, the differing ones descend further
+        visit = visited_children & masks[level]
+    return jnp.stack(counts)
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def verify(levels: Levels, width: int = 16) -> Tuple[jnp.ndarray, ...]:
+    """Integrity sweep: recompute each parent level from its children
+    and flag mismatches — per-level corruption bitmaps (the BFS verify,
+    synctree.erl:549-571, as one fused pass)."""
+    out = []
+    for level in range(len(levels) - 1):
+        expect = fold(levels[level + 1].reshape(-1, width, LANES))
+        out.append(jnp.any(expect != levels[level], axis=-1))
+    return tuple(out)
+
+
+def segment_of(key_hash: jnp.ndarray, segments: int) -> jnp.ndarray:
+    """Key → segment (the md5-mod mapping, synctree.erl:251-253) for
+    uint32 key hashes computed host-side."""
+    return jnp.asarray(key_hash, jnp.uint32) % np.uint32(segments)

@@ -1,0 +1,134 @@
+"""Tracing & introspection.
+
+The reference has no tracing beyond compiled-out ``?OUT`` macros
+(peer.erl:63-64, msg.erl:38-39) and the get_info/tree_info
+introspection calls — SURVEY §5 marks real tracing as the reference's
+gap to fill.  This module provides:
+
+- :class:`Tracer` — structured event recorder hooked into the
+  runtime's trace callback: per-op spans (kind, ensemble, key,
+  start/end, outcome), message-delivery events, and counters; ring-
+  buffered so long runs stay bounded.
+- :func:`dump_ensemble` — per-ensemble state dump across peers
+  (fsm state, epoch/seq, leader, views, tree trust/readiness) — the
+  get_info surface (peer.erl:183-206) aggregated cluster-wide.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+_span_ids = itertools.count(1)
+
+
+@dataclass
+class Span:
+    span_id: int
+    kind: str
+    ensemble: Any
+    detail: Any
+    start: float
+    end: Optional[float] = None
+    outcome: Any = None
+
+    @property
+    def duration(self) -> Optional[float]:
+        return None if self.end is None else self.end - self.start
+
+
+@dataclass
+class Tracer:
+    """Attach with ``Tracer(runtime).install()``."""
+
+    runtime: Any
+    max_events: int = 100_000
+    events: Deque[Tuple[float, str, Any]] = field(default_factory=collections.deque)
+    counters: Dict[str, int] = field(default_factory=dict)
+    spans: Dict[int, Span] = field(default_factory=dict)
+    finished: List[Span] = field(default_factory=list)
+
+    def install(self) -> "Tracer":
+        self.runtime.trace = self._on_event
+        return self
+
+    def uninstall(self) -> None:
+        if self.runtime.trace == self._on_event:
+            self.runtime.trace = None
+
+    # -- runtime hook ------------------------------------------------------
+
+    def _on_event(self, kind: str, payload: Any) -> None:
+        self.counters[kind] = self.counters.get(kind, 0) + 1
+        self.events.append((self.runtime.now, kind, payload))
+        while len(self.events) > self.max_events:
+            self.events.popleft()
+
+    # -- spans -------------------------------------------------------------
+
+    def begin(self, kind: str, ensemble: Any, detail: Any = None) -> int:
+        sid = next(_span_ids)
+        self.spans[sid] = Span(sid, kind, ensemble, detail,
+                               self.runtime.now)
+        return sid
+
+    def finish(self, span_id: int, outcome: Any) -> Optional[Span]:
+        span = self.spans.pop(span_id, None)
+        if span is None:
+            return None
+        span.end = self.runtime.now
+        span.outcome = outcome
+        self.finished.append(span)
+        self.counters[f"span:{span.kind}"] = \
+            self.counters.get(f"span:{span.kind}", 0) + 1
+        return span
+
+    # -- reports -----------------------------------------------------------
+
+    def percentiles(self, kind: str, qs=(0.5, 0.99)) -> Dict[float, float]:
+        durations = sorted(s.duration for s in self.finished
+                           if s.kind == kind and s.duration is not None)
+        if not durations:
+            return {}
+        out = {}
+        for q in qs:
+            idx = min(len(durations) - 1, int(q * len(durations)))
+            out[q] = durations[idx]
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        by_kind: Dict[str, int] = {}
+        for s in self.finished:
+            by_kind[s.kind] = by_kind.get(s.kind, 0) + 1
+        return {"counters": dict(self.counters),
+                "finished_spans": by_kind,
+                "open_spans": len(self.spans)}
+
+
+def peer_info(peer) -> Dict[str, Any]:
+    """get_info analog (peer.erl:183-189,1905-1910)."""
+    return {
+        "id": peer.id,
+        "state": peer.fsm_state,
+        "epoch": peer.epoch,
+        "seq": peer.seq,
+        "leader": peer.leader,
+        "views": peer.views,
+        "members": peer.members,
+        "tree_trust": peer.tree_trust,
+        "tree_ready": peer.tree_ready,
+        "suspended": peer.suspended,
+    }
+
+
+def dump_ensemble(runtime, ensemble) -> List[Dict[str, Any]]:
+    """Cluster-wide state dump for one ensemble — every live peer's
+    info, leader-first."""
+    from riak_ensemble_tpu.peer import Peer
+
+    infos = [peer_info(a) for a in list(runtime.actors.values())
+             if isinstance(a, Peer) and a.ensemble == ensemble]
+    infos.sort(key=lambda i: (i["state"] != "leading", repr(i["id"])))
+    return infos

@@ -1,0 +1,63 @@
+"""App facade bring-up (riak_ensemble_app/sup analog) and the tracing
+subsystem (SURVEY §5: tracing is the reference's gap we fill).
+"""
+
+from riak_ensemble_tpu import app
+from riak_ensemble_tpu.config import fast_test_config
+from riak_ensemble_tpu.runtime import Runtime
+from riak_ensemble_tpu.types import PeerId
+from riak_ensemble_tpu.utils.trace import Tracer, dump_ensemble, peer_info
+
+
+def test_app_two_node_bringup():
+    runtime = Runtime(seed=40)
+    cfg = fast_test_config()
+    n0 = app.start(runtime, "node0", cfg)
+    n1 = app.start(runtime, "node1", cfg)
+
+    assert n0.enable() == "ok"
+    assert not n1.enabled()
+    assert n1.join("node0") == "ok"
+    assert runtime.run_until(lambda: n1.enabled(), 30.0, poll=0.1)
+
+    peers = [PeerId(0, "node0"), PeerId(1, "node1")]
+    assert n0.create_ensemble("kv", peers) == "ok"
+    assert runtime.run_until(
+        lambda: any(k[0] == "kv" for k in n1.manager.local_peers),
+        60.0, poll=0.1)
+
+    c = n0.client()
+
+    def write_ok():
+        return c.kover("kv", "k", b"v", timeout=5.0)[0] == "ok"
+    assert runtime.run_until(write_ok, 60.0, poll=0.2)
+    r = n1.client().kget("kv", "k")
+    assert r[0] == "ok" and r[1].value == b"v"
+
+
+def test_tracer_spans_and_dump():
+    runtime = Runtime(seed=41)
+    cfg = fast_test_config()
+    n0 = app.start(runtime, "node0", cfg)
+    tracer = Tracer(runtime).install()
+    assert n0.enable() == "ok"
+
+    c = n0.client()
+    sid = tracer.begin("kover", "root", "k")
+
+    def write_ok():
+        return c.kover("root", "k", b"v", timeout=5.0)[0] == "ok"
+    assert runtime.run_until(write_ok, 30.0, poll=0.2)
+    span = tracer.finish(sid, "ok")
+    assert span.duration is not None and span.duration >= 0
+    assert tracer.summary()["finished_spans"]["kover"] == 1
+    # runtime deliveries were traced
+    assert tracer.counters.get("deliver", 0) > 0
+    assert tracer.percentiles("kover")[0.5] >= 0
+
+    infos = dump_ensemble(runtime, "root")
+    assert len(infos) == 1
+    assert infos[0]["state"] == "leading"
+    assert infos[0]["id"] == PeerId("root", "node0")
+    assert peer_info(n0.manager.local_peers[("root",
+                                             PeerId("root", "node0"))])

@@ -1,0 +1,126 @@
+"""synctree_jax kernel: build/update equivalence, diff exactness,
+corruption detection, exchange cost bound (SURVEY §5 long-context
+analog; BASELINE.md ladder #4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from riak_ensemble_tpu.ops import hash as hashk
+
+W = 4          # small width for exhaustive tests
+S = W ** 3     # 64 segments
+
+
+def rand_leaves(rng, n=S):
+    return jnp.asarray(
+        rng.integers(0, 2**32, (n, hashk.LANES), dtype=np.uint32))
+
+
+def test_build_shapes():
+    rng = np.random.default_rng(0)
+    levels = hashk.build(rand_leaves(rng), width=W)
+    assert [lv.shape[0] for lv in levels] == [1, W, W * W, S]
+
+
+def test_update_matches_rebuild():
+    """Incremental update == full rebuild (the always-up-to-date
+    property must not drift from the ground truth)."""
+    rng = np.random.default_rng(1)
+    leaves = rand_leaves(rng)
+    levels = hashk.build(leaves, width=W)
+
+    seg_ids = jnp.asarray([3, 17, 17, 63])  # includes a duplicate
+    new = rand_leaves(rng, 4)
+    updated = hashk.update(levels, seg_ids, new, width=W)
+
+    ref_leaves = np.asarray(leaves).copy()
+    for i, seg in enumerate(np.asarray(seg_ids)):
+        ref_leaves[seg] = np.asarray(new)[i]
+    rebuilt = hashk.build(jnp.asarray(ref_leaves), width=W)
+
+    for lu, lr in zip(updated, rebuilt):
+        np.testing.assert_array_equal(np.asarray(lu), np.asarray(lr))
+
+
+def test_diff_exact():
+    rng = np.random.default_rng(2)
+    leaves = rand_leaves(rng)
+    a = hashk.build(leaves, width=W)
+    changed = [5, 40]
+    new = rand_leaves(rng, len(changed))
+    b = hashk.update(a, jnp.asarray(changed), new, width=W)
+
+    masks = hashk.diff_levels(a, b)
+    leaf_mask = np.asarray(masks[-1])
+    assert sorted(np.nonzero(leaf_mask)[0].tolist()) == changed
+    # root differs too
+    assert bool(np.asarray(masks[0])[0])
+
+
+def test_diff_identical_is_empty():
+    rng = np.random.default_rng(3)
+    a = hashk.build(rand_leaves(rng), width=W)
+    masks = hashk.diff_levels(a, a)
+    assert not any(bool(np.asarray(m).any()) for m in masks)
+
+
+def test_exchange_cost_bound():
+    """One differing segment: the streamed exchange visits at most
+    width buckets per level (O(width * height * diffs)), far below the
+    S-bucket full scan."""
+    rng = np.random.default_rng(4)
+    a = hashk.build(rand_leaves(rng), width=W)
+    b = hashk.update(a, jnp.asarray([11]), rand_leaves(rng, 1), width=W)
+    costs = np.asarray(hashk.exchange_cost(a, b, width=W))
+    assert costs[0] == 1
+    assert (costs[1:] <= W).all()
+    assert costs.sum() < S
+
+
+def test_verify_detects_corruption():
+    rng = np.random.default_rng(5)
+    levels = list(hashk.build(rand_leaves(rng), width=W))
+    clean = hashk.verify(tuple(levels), width=W)
+    assert not any(bool(np.asarray(m).any()) for m in clean)
+
+    # corrupt one inner bucket at level 2
+    lv2 = np.asarray(levels[2]).copy()
+    lv2[7] ^= 0xDEAD
+    levels[2] = jnp.asarray(lv2)
+    masks = hashk.verify(tuple(levels), width=W)
+    # level-1 recompute-from-children mismatches at bucket 7's parent?
+    # No: verify flags the STORED parent vs recomputed-from-children —
+    # corrupting level 2 makes (a) level-1's stored value stale at
+    # bucket 7//W and (b) level-2 recomputed-from-level-3 mismatch at
+    # bucket 7.
+    assert bool(np.asarray(masks[1])[7 // W]) or \
+        bool(np.asarray(masks[2])[7])
+
+
+def test_leaf_hash_version_sensitivity():
+    h1 = hashk.leaf_hash(jnp.asarray([1]), jnp.asarray([1]))
+    h2 = hashk.leaf_hash(jnp.asarray([1]), jnp.asarray([2]))
+    h3 = hashk.leaf_hash(jnp.asarray([2]), jnp.asarray([1]))
+    assert not np.array_equal(np.asarray(h1), np.asarray(h2))
+    assert not np.array_equal(np.asarray(h1), np.asarray(h3))
+    assert not np.array_equal(np.asarray(h2), np.asarray(h3))
+
+
+def test_million_segment_build_compiles():
+    """The production shape (1M segments, width 16 — synctree.erl
+    :88-89) builds and updates under jit."""
+    rng = np.random.default_rng(6)
+    segs = 16 ** 5
+    leaves = jnp.zeros((segs, hashk.LANES), jnp.uint32)
+    levels = hashk.build(leaves, width=16)
+    assert levels[0].shape == (1, hashk.LANES)
+    ids = jnp.asarray(rng.integers(0, segs, 256))
+    new = jnp.asarray(
+        rng.integers(0, 2**32, (256, hashk.LANES), dtype=np.uint32))
+    updated = hashk.update(levels, ids, new, width=16)
+    leaf_mask = np.asarray(
+        hashk.diff_levels(levels, updated)[-1])
+    assert set(np.nonzero(leaf_mask)[0]) == set(np.asarray(ids).tolist())
