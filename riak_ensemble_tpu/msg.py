@@ -136,6 +136,57 @@ def quorum_timeout(actor: Actor, mstate: MsgState) -> MsgState:
 
 
 # ---------------------------------------------------------------------------
+# Wire-safe cross-node sync call (the gen_server:call-over-dist
+# analog).  Messages carry only names and reqids — no Futures — so the
+# same protocol runs on the simulator and the TCP transport.
+
+_xproxy_ids = itertools.count(1)
+
+
+class _XProxy(Actor):
+    def __init__(self, runtime: Runtime, node, fut: Future,
+                 ref: int) -> None:
+        super().__init__(runtime, ("xproxy", node, next(_xproxy_ids)),
+                         node)
+        self.fut = fut
+        self.ref = ref
+
+    def handle(self, msg: Tuple) -> None:
+        if msg[0] == "xreply" and msg[1] == self.ref:
+            self.fut.resolve(msg[2])
+            self.stop()
+
+
+_xcall_refs = itertools.count(1)
+
+
+def xcall(actor: Actor, dst_name: Any, inner: Tuple,
+          timeout: float) -> Future:
+    """Sync-call `dst_name` (a peer or tree actor on any node) with
+    `inner`; resolves to the reply or ``"timeout"``.  The callee
+    handles ``("xcall", (proxy_name, ref), inner)`` and replies
+    ``("xreply", ref, value)`` to the proxy."""
+    fut = Future()
+    ref = next(_xcall_refs)
+    proxy = _XProxy(actor.runtime, actor.node, fut, ref)
+    actor.send(dst_name, ("xcall", (proxy.name, ref), inner))
+    out = actor.runtime.with_timeout(fut, timeout)
+
+    def cleanup(_v):
+        if actor.runtime.whereis(proxy.name) is not None:
+            actor.runtime.stop_actor(proxy.name)
+
+    out.add_waiter(cleanup)
+    return out
+
+
+def handle_xcall(actor: Actor, from_: Tuple, fut: Future) -> Future:
+    """Wire a local Future so its resolution answers an xcall."""
+    owner, ref = from_
+    fut.add_waiter(lambda v: actor.send(owner, ("xreply", ref, v)))
+    return fut
+
+
 # Blocking path: collector actor + future
 
 
@@ -233,7 +284,9 @@ def blocking_send_all(actor: Actor, msg: Tuple, self_id: Any, peers, views,
     if not others:
         future.resolve(("quorum_met", []))
         return future
-    name = ("collector", next(_collector_ids))
+    # Node-scoped name so cross-node replies can route back to the
+    # collector over a real transport.
+    name = ("collector", actor.node, next(_collector_ids))
     collector = _Collector(actor.runtime, name, actor.node, actor.config,
                            self_id, views, required, extra, future)
     reqid = next(_reqids)

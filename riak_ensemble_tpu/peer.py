@@ -195,6 +195,13 @@ class Peer(Actor):
             _, fut, inner = msg
             self._handle_sync(inner, fut)
             return
+        if kind == "xcall":
+            # Wire-safe remote sync call (exchange tree_pid etc.).
+            _, from_, inner = msg
+            fut = Future()
+            msglib.handle_xcall(self, from_, fut)
+            self._handle_sync(inner, fut)
+            return
         handler = getattr(self, "st_" + self.fsm_state)
         handler(msg)
 

@@ -62,48 +62,67 @@ def _cast(mgr, target_node: str, fun, timeout: float = 5.0) -> None:
                                   timeout)
 
 
+# The mutator functions are module-level + functools.partial (NOT
+# closures) so root operations stay picklable when the put event is
+# forwarded across nodes by a real transport — the analog of the
+# reference shipping {Module, Function, Cmd} MFAs (root.erl:82,104).
+
+
+def _join_fun(joining_node: str, vsn: Vsn, cs: ClusterState):
+    out = statelib.add_member(vsn, joining_node, cs)
+    return out if out is not None else "failed"
+
+
+def _remove_fun(target_node: str, vsn: Vsn, cs: ClusterState):
+    out = statelib.del_member(vsn, target_node, cs)
+    return out if out is not None else "failed"
+
+
+def _set_ensemble_fun(ensemble: Any, info: EnsembleInfo, _vsn: Vsn,
+                      cs: ClusterState):
+    out = statelib.set_ensemble(ensemble, info, cs)
+    return out if out is not None else "failed"
+
+
+def _update_ensemble_fun(ensemble: Any, leader: Optional[PeerId],
+                         views: Views, vsn: Vsn, _vsn: Vsn,
+                         cs: ClusterState):
+    out = statelib.update_ensemble(vsn, ensemble, leader, views, cs)
+    return out if out is not None else "failed"
+
+
 def join(mgr, target_node: str, joining_node: str,
          timeout: float = 60.0) -> Future:
     """Add `joining_node` to the cluster via `target_node`'s root
     ensemble (root.erl:47-55, root_call {join,..}:123-130)."""
-
-    def fun(vsn: Vsn, cs: ClusterState):
-        out = statelib.add_member(vsn, joining_node, cs)
-        return out if out is not None else "failed"
-
-    return _call(mgr, target_node, fun, timeout)
+    import functools
+    return _call(mgr, target_node,
+                 functools.partial(_join_fun, joining_node), timeout)
 
 
 def remove(mgr, target_node: str, timeout: float = 60.0) -> Future:
     """Remove `target_node`, via the local root (root.erl:57-65)."""
-
-    def fun(vsn: Vsn, cs: ClusterState):
-        out = statelib.del_member(vsn, target_node, cs)
-        return out if out is not None else "failed"
-
-    return _call(mgr, mgr.node, fun, timeout)
+    import functools
+    return _call(mgr, mgr.node,
+                 functools.partial(_remove_fun, target_node), timeout)
 
 
 def set_ensemble(mgr, ensemble: Any, info: EnsembleInfo,
                  timeout: float = 10.0) -> Future:
     """Create/overwrite an ensemble record (root.erl:38-45,139-145)."""
-
-    def fun(_vsn: Vsn, cs: ClusterState):
-        out = statelib.set_ensemble(ensemble, info, cs)
-        return out if out is not None else "failed"
-
-    return _call(mgr, mgr.node, fun, timeout)
+    import functools
+    return _call(mgr, mgr.node,
+                 functools.partial(_set_ensemble_fun, ensemble, info),
+                 timeout)
 
 
 def update_ensemble(mgr, ensemble: Any, leader: Optional[PeerId],
                     views: Views, vsn: Vsn) -> None:
     """root.erl:34-36,159-165 (cast)."""
-
-    def fun(_vsn: Vsn, cs: ClusterState):
-        out = statelib.update_ensemble(vsn, ensemble, leader, views, cs)
-        return out if out is not None else "failed"
-
-    _cast(mgr, mgr.node, fun)
+    import functools
+    _cast(mgr, mgr.node,
+          functools.partial(_update_ensemble_fun, ensemble, leader,
+                            views, vsn))
 
 
 def gossip(mgr, peer, vsn: Vsn, leader: PeerId, views: Views) -> None:

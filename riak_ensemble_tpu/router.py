@@ -121,15 +121,24 @@ class _Proxy(Actor):
 
 def sync_send_event_fut(runtime: Runtime, node: str, ensemble: Any,
                         event: Tuple, timeout: float) -> Future:
-    """Route `event` to the ensemble's leader starting from `node`;
-    returns a Future resolving to the reply or ``"timeout"``
-    (router.erl sync_send_event:71-87)."""
+    """Route `event` to the ensemble's leader starting from `node`'s
+    router pool; returns a Future resolving to the reply or
+    ``"timeout"`` (router.erl sync_send_event:71-87).
+
+    The per-request proxy lives on the CALLING process's node (a
+    networked runtime hosts one node and exposes it as ``.node``; the
+    simulator hosts all nodes, so the proxy co-locates with the target
+    pool there) and the request reaches a possibly-remote router over
+    the transport.
+    """
     fut = Future()
     ref = next(_refs)
-    proxy = _Proxy(runtime, node, fut, ref)
+    local_node = getattr(runtime, "node", node)
+    proxy = _Proxy(runtime, local_node, fut, ref)
     inner = ("sync_send_event", (proxy.name, ref), event, timeout)
     pick = runtime.rng.randrange(N_ROUTERS)
-    runtime.post(router_name(node, pick), ("ensemble_cast", ensemble, inner))
+    runtime.net_send(local_node, router_name(node, pick),
+                     ("ensemble_cast", ensemble, inner))
 
     out = runtime.with_timeout(fut, timeout)
 

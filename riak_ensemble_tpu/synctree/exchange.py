@@ -73,6 +73,11 @@ def _tree_call(peer, tree_name, request) -> Future:
 
 
 def _perform_exchange2(peer, tree_name, remote_peers: List[Any]):
+    # Remote reads are wire-safe xcalls (no Futures on the wire), so
+    # the same protocol runs on the simulator and the TCP transport; a
+    # dead/unreachable remote mid-exchange times out and fails the
+    # exchange (the reference's monitor-crash path, exchange.erl:40-52).
+    call_timeout = max(peer.config.quorum() * 10, 1.0)
     ok = yield _tree_call(peer, tree_name, ("tree_verify_upper",))
     if not ok:
         yield from _sync_tree_corrupted(peer)
@@ -83,31 +88,37 @@ def _perform_exchange2(peer, tree_name, remote_peers: List[Any]):
         if remote_addr is None:
             continue
         # Fetch the remote peer's tree name (tree_pid sync event).
-        fut = Future()
-        peer.send(remote_addr, ("peer_sync", fut, ("tree_pid",)))
-        remote_tree = yield fut
+        remote_tree = yield msglib.xcall(peer, remote_addr,
+                                         ("tree_pid",), call_timeout)
+        if remote_tree == "timeout" or remote_tree == "nack":
+            peer.runtime.post(peer.name, ("exchange_failed",))
+            return
 
-        corrupted = {"local": False, "remote": False}
+        flags = {"local": False, "remote": False, "timeout": False}
 
         def local(level, bucket):
             return _tree_call(peer, tree_name, ("tree_exchange_get",
                                                 level, bucket))
 
         def remote_get(level, bucket):
-            return _tree_call(peer, remote_tree, ("tree_exchange_get",
-                                                  level, bucket))
+            return msglib.xcall(peer, remote_tree,
+                                ("tree_exchange_get", level, bucket),
+                                call_timeout)
 
-        gen = compare_gen(height, _wrap(local, corrupted, "local"),
-                          _wrap(remote_get, corrupted, "remote"))
+        gen = compare_gen(height, _wrap(local, flags, "local"),
+                          _wrap(remote_get, flags, "remote"))
         diffs = yield from _drive(gen)
-        if corrupted["local"]:
+        if flags["timeout"]:
+            peer.runtime.post(peer.name, ("exchange_failed",))
+            return
+        if flags["local"]:
             yield from _sync_tree_corrupted(peer)
             return
-        if corrupted["remote"]:
+        if flags["remote"]:
             # Remote tree corrupt: tell it, then move on
             # (exchange.erl:102-108 throws; peer retries later).
-            peer.send(remote_addr, ("peer_sync", Future(),
-                                    ("tree_corrupted",)))
+            msglib.xcall(peer, remote_addr, ("tree_corrupted",),
+                         call_timeout)
             peer.runtime.post(peer.name, ("exchange_failed",))
             return
         for key, (a, b) in diffs:
@@ -118,15 +129,18 @@ def _perform_exchange2(peer, tree_name, remote_peers: List[Any]):
     peer.runtime.post(peer.name, ("exchange_complete",))
 
 
-def _wrap(fetch, corrupted, side):
-    """Translate the tree actor's 'corrupted' reply into Corrupted."""
+def _wrap(fetch, flags, side):
+    """Translate 'corrupted'/'timeout' replies into aborts."""
     def inner(level, bucket):
         raw = fetch(level, bucket)
         out = Future()
 
         def on(v):
             if v == "corrupted":
-                corrupted[side] = True
+                flags[side] = True
+                out.resolve(Corrupted(0, 0))
+            elif v == "timeout":
+                flags["timeout"] = True
                 out.resolve(Corrupted(0, 0))
             else:
                 out.resolve(v)

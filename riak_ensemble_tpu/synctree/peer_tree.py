@@ -20,7 +20,7 @@ from __future__ import annotations
 
 from typing import Any, Optional, Tuple
 
-from riak_ensemble_tpu.runtime import Actor, Runtime
+from riak_ensemble_tpu.runtime import Actor, Future, Runtime
 from riak_ensemble_tpu.synctree.tree import Corrupted, SyncTree
 
 
@@ -32,6 +32,17 @@ class PeerTree(Actor):
 
     def handle(self, msg: Tuple) -> None:
         kind = msg[0]
+        if kind == "xcall":
+            # Wire-safe remote call: run the inner op with a local
+            # future whose resolution replies over the transport
+            # (remote exchange reads, synctree_remote.erl role).
+            from riak_ensemble_tpu import msg as msglib
+
+            _, from_, inner = msg
+            fut = Future()
+            msglib.handle_xcall(self, from_, fut)
+            self.handle(tuple(inner) + (fut,))
+            return
         if kind == "tree_get":
             _, key, fut = msg
             result = self.tree.get(key)

@@ -1,0 +1,136 @@
+"""Real multi-process cluster: three OS processes, one node each,
+TCP transport (the DCN/host half of the distributed backend).
+
+Brings up enable → join × 2 → cross-node ensemble → client K/V routed
+across processes — the same sequence the simulator tests run, but over
+real sockets with wall-clock timers (netruntime/netnode).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NODE0_SCRIPT = """
+import asyncio
+from riak_ensemble_tpu.types import PeerId
+
+async def main(node):
+    r = await node.enable()
+    assert r == "ok", r
+    print("ENABLED", flush=True)
+    for _ in range(600):
+        if len(node.members()) >= 3:
+            break
+        await asyncio.sleep(0.1)
+    assert len(node.members()) >= 3, node.members()
+    print("MEMBERS_OK", flush=True)
+
+    # Leader hint on node1: client ops from this process must route
+    # cross-node.
+    peers = [PeerId(1, "node1"), PeerId(0, "node0"), PeerId(2, "node2")]
+    r = await node.create_ensemble("kv", peers)
+    assert r == "ok", r
+
+    r = ("error", "not_started")
+    for _ in range(300):
+        r = await node.kover("kv", "k", b"v1", timeout=3.0)
+        if r[0] == "ok":
+            break
+        await asyncio.sleep(0.2)
+    assert r[0] == "ok", r
+    r = await node.kget("kv", "k", timeout=5.0)
+    assert r[0] == "ok" and r[1].value == b"v1", r
+
+    # CAS through the same path
+    cur = r[1]
+    r = await node.kupdate("kv", "k", cur, b"v2", timeout=5.0)
+    assert r[0] == "ok", r
+    r = await node.kget("kv", "k", timeout=5.0)
+    assert r[0] == "ok" and r[1].value == b"v2", r
+
+    print("RESULT_OK", flush=True)
+    await asyncio.sleep(60)
+"""
+
+JOINER_SCRIPT = """
+import asyncio
+
+async def main(node):
+    for _ in range(600):
+        r = await node.join("node0", timeout=10.0)
+        if r == "ok":
+            break
+        await asyncio.sleep(0.3)
+    assert r == "ok", r
+    print("JOINED", flush=True)
+    await asyncio.sleep(120)
+"""
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_three_process_cluster(tmp_path):
+    ports = _free_ports(3)
+    peer_args = []
+    for i, p in enumerate(ports):
+        peer_args += ["--peer", f"node{i}=127.0.0.1:{p}"]
+
+    scripts = {}
+    for name, body in (("node0", NODE0_SCRIPT), ("node1", JOINER_SCRIPT),
+                       ("node2", JOINER_SCRIPT)):
+        path = tmp_path / f"{name}_script.py"
+        path.write_text(body)
+        scripts[name] = str(path)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # The networked host is pure-Python; keep JAX out of these procs.
+    procs = {}
+    try:
+        for i in range(3):
+            name = f"node{i}"
+            procs[name] = subprocess.Popen(
+                [sys.executable, "-m", "riak_ensemble_tpu.netnode",
+                 "--node", name, *peer_args, "--fast",
+                 "--data-root", str(tmp_path / name),
+                 "--script", scripts[name]],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=REPO)
+
+        lines = []
+        got_result = threading.Event()
+
+        def reader():
+            for line in procs["node0"].stdout:
+                lines.append(line.strip())
+                if "RESULT_OK" in line:
+                    got_result.set()
+                    return
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        ok = got_result.wait(timeout=150)
+        assert ok, f"cluster never converged; node0 said: {lines!r}"
+        assert "ENABLED" in lines and "MEMBERS_OK" in lines
+    finally:
+        for p in procs.values():
+            p.kill()
+        for p in procs.values():
+            p.wait(timeout=10)
