@@ -15,17 +15,21 @@ gossip-based cluster management) for TPU hardware:
   with native C++ components for the monotonic clock and synctree
   persistence (:mod:`riak_ensemble_tpu.utils.clock`, ``native/``).
 
-Layer map (mirrors SURVEY.md §1; reference files cited in each module):
+Layer map (mirrors SURVEY.md §1; reference files cited in each module;
+see PARITY.md for the component-by-component map):
 
 ====  =======================  ============================================
-L0    platform/runtime         config, runtime, utils.clock
-L1    persistence              storage, save, synctree backends
-L2    integrity                synctree, peer_tree, exchange
-L3    communication/quorum     msg, router, ops.quorum
+L0    platform/runtime         config, runtime, netruntime, app, utils
+L1    persistence              storage, save, synctree.backends,
+                               synctree.native_store (+ native/ C++)
+L2    integrity                synctree.tree, synctree.peer_tree,
+                               synctree.exchange, ops.hash
+L3    communication/quorum     msg, router, ops.quorum, ops.pallas_quorum
 L4    consensus core           peer, worker, lease, backend
 L5    cluster management       manager, root, state
-L6    client API               client
---    batched TPU engine       parallel.engine, ops.ballot, ops.hash
+L6    client API               client, netnode (async)
+--    batched TPU engine       ops.engine, parallel.mesh
+--    testing/verification     testing, linearizability, utils.trace
 ====  =======================  ============================================
 """
 
