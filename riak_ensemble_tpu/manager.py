@@ -187,6 +187,42 @@ class Manager(Actor, Directory):
                             mod=mod, args=tuple(args))
         return rootlib.set_ensemble(self, ensemble, info, timeout)
 
+    def check_quorum(self, ensemble, timeout: float = 5.0) -> Future:
+        """manager.erl:252-258 — resolves True iff the leader can
+        commit against a quorum right now."""
+        from riak_ensemble_tpu import router as routerlib
+        out = Future()
+        fut = routerlib.sync_send_event_fut(self.runtime, self.node,
+                                            ensemble, ("check_quorum",),
+                                            timeout)
+        fut.add_waiter(lambda r: out.resolve(r == "ok"))
+        return out
+
+    def count_quorum(self, ensemble, timeout: float = 5.0) -> Future:
+        """manager.erl:260-267 — resolves to the number of reachable
+        quorum members (0 on timeout)."""
+        from riak_ensemble_tpu import router as routerlib
+        out = Future()
+        fut = routerlib.sync_send_event_fut(self.runtime, self.node,
+                                            ensemble, ("ping_quorum",),
+                                            timeout)
+
+        def done(r):
+            if isinstance(r, tuple) and len(r) == 3:
+                out.resolve(len(r[2]))
+            else:
+                out.resolve(0)
+
+        fut.add_waiter(done)
+        return out
+
+    def get_leader_addr(self, ensemble):
+        """get_leader_pid analog (manager.erl:112-119)."""
+        leader = self.get_leader(ensemble)
+        if leader is None:
+            return None
+        return self.get_peer_addr(ensemble, leader)
+
     # ------------------------------------------------------------------
     # actor event loop
 

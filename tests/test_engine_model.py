@@ -1,0 +1,220 @@
+"""Differential test: the batched device engine vs an independent
+scalar Python model of the same protocol semantics.
+
+SURVEY §7 flags the FSM→kernel lift as the main correctness risk and
+prescribes differential testing against a scalar oracle.  This model
+is written per-ensemble/per-peer with plain loops — deliberately the
+opposite implementation shape from the vectorized kernels — and the
+test drives both through randomized interleavings of elections
+(arbitrary up-masks, bogus candidates), K/V ops (invalid slots, leased
+and unleased reads), joint views, and down-peer patterns, comparing
+every output field and the full final state.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from riak_ensemble_tpu.ops import engine as eng  # noqa: E402
+
+
+class ScalarModel:
+    """Plain-Python re-derivation of the engine semantics."""
+
+    def __init__(self, m, s, views):
+        self.m, self.s = m, s
+        self.views = [list(v) for v in views]  # lists of peer indices
+        self.epoch = [0] * m
+        self.fact_seq = [0] * m
+        self.leader = -1
+        self.ctr = 0
+        self.store = [[(0, 0, 0)] * s for _ in range(m)]  # (epoch,seq,val)
+
+    # -- quorum (msg.erl joint-view majority; no nacks distinct here) --
+
+    def _met(self, ack):
+        for view in self.views:
+            if not view:
+                continue
+            thresh = len(view) // 2 + 1
+            n_valid = sum(1 for p in view if ack[p])
+            n_nack = 0
+            if n_valid >= thresh:
+                continue
+            return False
+        return True
+
+    def members(self):
+        out = set()
+        for v in self.views:
+            out.update(v)
+        return out
+
+    # -- election ------------------------------------------------------
+
+    def elect(self, do_elect, cand, up):
+        member = self.members()
+        heard = [up[p] and p in member for p in range(self.m)]
+        heard_epochs = [self.epoch[p] for p in range(self.m) if heard[p]]
+        next_epoch = (max(heard_epochs) if heard_epochs else -1) + 1
+        ack = heard
+        won = (self._met(ack) and do_elect and cand >= 0
+               and 0 <= cand < self.m and heard[cand])
+        if won:
+            for p in range(self.m):
+                if heard[p]:
+                    self.epoch[p] = next_epoch
+                    self.fact_seq[p] = 0
+            self.leader = cand
+            self.ctr = 0
+        return won
+
+    # -- kv ------------------------------------------------------------
+
+    def _context(self, up):
+        member = self.members()
+        heard = [up[p] and p in member for p in range(self.m)]
+        has_leader = self.leader >= 0
+        lead_epoch = self.epoch[self.leader] if has_leader else 0
+        leader_up = has_leader and heard[self.leader]
+        ack = [heard[p] and self.epoch[p] == lead_epoch
+               for p in range(self.m)]
+        epoch_ok = self._met(ack) and has_leader and leader_up
+        return heard, leader_up, lead_epoch, epoch_ok
+
+    def kv(self, kind, slot, val, lease_ok, up, ctx=None):
+        heard, leader_up, lead_epoch, epoch_ok = \
+            ctx if ctx is not None else self._context(up)
+        is_put = kind == eng.OP_PUT
+        is_get = kind == eng.OP_GET
+        slot_valid = 0 <= slot < self.s
+
+        # newest among heard replicas at slot
+        cands = []
+        if slot_valid:
+            cands = [self.store[p][slot] for p in range(self.m)
+                     if heard[p] and self.store[p][slot][1] > 0]
+        if cands:
+            emax = max(c[0] for c in cands)
+            smax = max(c[1] for c in cands if c[0] == emax)
+            vmax = max(c[2] for c in cands
+                       if c[0] == emax and c[1] == smax)
+            rd_epoch, rd_seq, rd_val, found = emax, smax, vmax, True
+        else:
+            rd_epoch = rd_seq = rd_val = 0
+            found = False
+
+        get_gate = is_get and leader_up and (lease_ok or epoch_ok)
+        stale = found and rd_epoch != lead_epoch
+        rewrite = get_gate and stale and epoch_ok
+        get_ok = get_gate and ((not stale) or rewrite)
+
+        put_commit = is_put and epoch_ok and slot_valid
+        commit = put_commit or rewrite
+        if commit:
+            new_seq = self.ctr + 1
+            wval = val if is_put else rd_val
+            for p in range(self.m):
+                if heard[p]:
+                    self.store[p][slot] = (lead_epoch, new_seq, wval)
+            self.ctr = new_seq
+            out_vsn = (lead_epoch, new_seq)
+        elif get_ok:
+            out_vsn = (rd_epoch, rd_seq)
+        else:
+            out_vsn = (0, 0)
+        return {
+            "committed": commit,
+            "get_ok": get_ok,
+            "found": found and get_ok,
+            "value": rd_val if (get_ok and found) else 0,
+            "obj_vsn": out_vsn,
+        }
+
+    def kv_scan(self, kinds, slots, vals, leases, up):
+        # context is computed once per launch (ballot state invariant)
+        ctx = self._context(up)
+        return [self.kv(k, sl, v, lz, up, ctx)
+                for k, sl, v, lz in zip(kinds, slots, vals, leases)]
+
+
+def _random_views(rng, m):
+    views = [sorted(rng.choice(m, size=rng.integers(2, m + 1),
+                               replace=False).tolist())]
+    if rng.random() < 0.4:  # joint consensus
+        views.append(sorted(rng.choice(m, size=rng.integers(2, m + 1),
+                                       replace=False).tolist()))
+    return views
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_engine_matches_scalar_model(seed):
+    rng = np.random.default_rng(seed)
+    e, m, s, k = 24, 5, 8, 4
+    views_per_ens = [_random_views(rng, m) for _ in range(e)]
+
+    vm = np.zeros((e, 2, m), bool)
+    for i, views in enumerate(views_per_ens):
+        for vi, view in enumerate(views):
+            vm[i, vi, list(view)] = True
+    state = eng.init_state(e, m, s)._replace(view_mask=jnp.asarray(vm))
+    models = [ScalarModel(m, s, views_per_ens[i]) for i in range(e)]
+
+    for step in range(12):
+        up_np = rng.random((e, m)) < 0.8
+        if step == 0:
+            up_np[:] = True  # first election must succeed somewhere
+        up = jnp.asarray(up_np)
+
+        if step % 3 == 0:
+            elect_np = rng.random(e) < 0.7
+            cand_np = rng.integers(-1, m, e)
+            state, won = eng.elect_step(
+                state, jnp.asarray(elect_np),
+                jnp.asarray(cand_np, jnp.int32), up)
+            won_np = np.asarray(won)
+            for i in range(e):
+                expect = models[i].elect(bool(elect_np[i]),
+                                         int(cand_np[i]), up_np[i])
+                assert won_np[i] == expect, (seed, step, i)
+        else:
+            kinds = rng.choice([eng.OP_NOOP, eng.OP_GET, eng.OP_PUT],
+                               (k, e)).astype(np.int32)
+            slots = rng.integers(-1, s + 1, (k, e)).astype(np.int32)
+            vals = rng.integers(1, 1000, (k, e)).astype(np.int32)
+            leases = rng.random((k, e)) < 0.5
+            state, res = eng.kv_step_scan(
+                state, jnp.asarray(kinds), jnp.asarray(slots),
+                jnp.asarray(vals), jnp.asarray(leases), up)
+            committed = np.asarray(res.committed)
+            get_ok = np.asarray(res.get_ok)
+            found = np.asarray(res.found)
+            value = np.asarray(res.value)
+            vsn = np.asarray(res.obj_vsn)
+            for i in range(e):
+                exp = models[i].kv_scan(kinds[:, i], slots[:, i],
+                                        vals[:, i], leases[:, i],
+                                        up_np[i])
+                for j in range(k):
+                    tag = (seed, step, i, j)
+                    assert committed[j, i] == exp[j]["committed"], tag
+                    assert get_ok[j, i] == exp[j]["get_ok"], tag
+                    assert found[j, i] == exp[j]["found"], tag
+                    assert value[j, i] == exp[j]["value"], tag
+                    assert tuple(vsn[j, i]) == exp[j]["obj_vsn"], tag
+
+    # Full final state must agree replica-for-replica.
+    oe = np.asarray(state.obj_epoch)
+    osq = np.asarray(state.obj_seq)
+    ov = np.asarray(state.obj_val)
+    ep = np.asarray(state.epoch)
+    ld = np.asarray(state.leader)
+    for i in range(e):
+        assert ld[i] == models[i].leader
+        for p in range(m):
+            assert ep[i, p] == models[i].epoch[p], (i, p)
+            for sl in range(s):
+                assert (oe[i, p, sl], osq[i, p, sl], ov[i, p, sl]) == \
+                    models[i].store[p][sl], (i, p, sl)
