@@ -376,6 +376,73 @@ def kv_step_scan(state: EngineState, kind: jax.Array, slot: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# Membership reconfiguration kernel (joint consensus, ladder #5)
+
+
+@functools.partial(jax.jit, static_argnames=("axis_name",))
+def reconfig_step(state: EngineState, propose: jax.Array,
+                  new_view: jax.Array, up: jax.Array,
+                  axis_name: Optional[str] = None
+                  ) -> Tuple[EngineState, jax.Array, jax.Array]:
+    """Batched joint-consensus membership change.
+
+    The reference's update_members → transition dance (peer.erl:655-672,
+    751-774): a proposed view is CONSED onto the views list, quorums
+    must hold in EVERY view while joint (msg.erl:377-418 recursion —
+    here view slot 1 keeps the old view), and once the joint
+    configuration has committed, views collapse to the new one alone.
+    One call does one phase per ensemble, batched over E:
+
+    - ensembles with ``propose`` and a single active view: install the
+      joint configuration (new view into slot 0, old into slot 1) if a
+      commit quorum holds in the OLD view (try_commit gate);
+    - ensembles already joint (both view slots active): collapse to
+      slot 0 alone if a commit quorum holds in BOTH views
+      (should_transition/transition, :751-774).
+
+    propose  [E] bool; new_view [E, Ml] bool; up [E, Ml] bool.
+    Returns (state', installed [E], collapsed [E]).  Leaders whose
+    commit gate fails keep their current views (the host steps them
+    down / retries, as the reference does on failed try_commit).
+    """
+    member_now = state.view_mask.any(1)                      # [E, Ml]
+    heard = up & member_now
+    is_joint = state.view_mask[:, 1, :].any(-1)              # [E]
+    has_leader = state.leader >= 0
+
+    # Commit gate in the CURRENT configuration (epoch-matching acks).
+    gidx = _global_peer_idx(state.epoch.shape[1], axis_name)
+    is_leader = gidx[None, :] == state.leader[:, None]
+    lead_epoch = reduce_peers(jnp.where(is_leader, state.epoch, 0),
+                              axis_name)
+    ack = heard & (state.epoch == lead_epoch[:, None])
+    commit_ok = (_quorum_met(ack, heard, state.view_mask, axis_name)
+                 & has_leader)
+
+    valid_new = new_view.any(-1) | ~propose
+    install = propose & ~is_joint & commit_ok & valid_new & new_view.any(-1)
+    collapse = is_joint & commit_ok & ~propose
+
+    old_v0 = state.view_mask[:, 0, :]
+    # install: slot0=new, slot1=old;  collapse: slot0 stays, slot1=0
+    v0 = jnp.where(install[:, None], new_view, old_v0)
+    v1 = jnp.where(install[:, None], old_v0,
+                   jnp.where(collapse[:, None], False,
+                             state.view_mask[:, 1, :]))
+    view_mask = jnp.stack([v0, v1], axis=1)
+    if state.view_mask.shape[1] > 2:
+        view_mask = jnp.concatenate(
+            [view_mask, state.view_mask[:, 2:, :]], axis=1)
+    # fact seq advances on a committed view change (try_commit
+    # increments; we fold install/collapse into one seq bump on the
+    # member replicas that heard it).
+    bump = (install | collapse)[:, None] & heard
+    fact_seq = jnp.where(bump, state.fact_seq + 1, state.fact_seq)
+    return (state._replace(view_mask=view_mask, fact_seq=fact_seq),
+            install, collapse)
+
+
+# ---------------------------------------------------------------------------
 # Fused full step (election + K ops) — the "training step" analog
 
 

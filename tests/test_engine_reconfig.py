@@ -1,0 +1,112 @@
+"""Device-side joint-consensus reconfiguration (BASELINE ladder #5:
+replace_members analog at engine scale).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from riak_ensemble_tpu.ops import engine as eng  # noqa: E402
+
+
+def _elected(e=8, m=5, s=8):
+    state = eng.init_state(e, m, s)
+    up = jnp.ones((e, m), bool)
+    state, won = eng.elect_step(state, jnp.ones((e,), bool),
+                                jnp.zeros((e,), jnp.int32), up)
+    assert bool(np.asarray(won).all())
+    return state, up
+
+
+def test_install_then_collapse():
+    e, m = 8, 5
+    state, up = _elected(e, m)
+    # replace members 3,4 with nobody: shrink view to {0,1,2}
+    new_view = jnp.asarray(np.tile([True, True, True, False, False],
+                                   (e, 1)))
+    state, installed, collapsed = eng.reconfig_step(
+        state, jnp.ones((e,), bool), new_view, up)
+    assert bool(np.asarray(installed).all())
+    assert not bool(np.asarray(collapsed).any())
+    vm = np.asarray(state.view_mask)
+    assert vm[:, 0, :3].all() and not vm[:, 0, 3:].any()
+    assert vm[:, 1, :].all()  # old full view retained (joint)
+
+    # While joint, puts need majority in BOTH views.
+    kind = jnp.full((e,), eng.OP_PUT, jnp.int32)
+    slot = jnp.zeros((e,), jnp.int32)
+    val = jnp.full((e,), 7, jnp.int32)
+    lease = jnp.ones((e,), bool)
+    # Drop peers 1,2: old view still has 3/5, but new view only 1/3 →
+    # joint quorum must FAIL.
+    up_partial = jnp.asarray(np.tile([True, False, False, True, True],
+                                     (e, 1)))
+    _, res = eng.kv_step(state, kind, slot, val, lease, up_partial)
+    assert not bool(np.asarray(res.committed).any())
+    # All up: commits work while joint.
+    state, res = eng.kv_step(state, kind, slot, val, lease, up)
+    assert bool(np.asarray(res.committed).all())
+
+    # Collapse to the new view.
+    state, installed, collapsed = eng.reconfig_step(
+        state, jnp.zeros((e,), bool), new_view, up)
+    assert bool(np.asarray(collapsed).all())
+    vm = np.asarray(state.view_mask)
+    assert not vm[:, 1, :].any()
+    # Now quorum is 2-of-3 over {0,1,2}: peers 3,4 down is fine.
+    up_new = jnp.asarray(np.tile([True, True, True, False, False],
+                                 (e, 1)))
+    state, res = eng.kv_step(state, kind, slot, val, lease, up_new)
+    assert bool(np.asarray(res.committed).all())
+
+
+def test_install_requires_commit_quorum():
+    e, m = 4, 5
+    state, up = _elected(e, m)
+    new_view = jnp.asarray(np.tile([True, True, True, False, False],
+                                   (e, 1)))
+    # Majority down: the try_commit gate fails, no install.
+    up_minor = jnp.asarray(np.tile([True, True, False, False, False],
+                                   (e, 1)))
+    state2, installed, _ = eng.reconfig_step(
+        state, jnp.ones((e,), bool), new_view, up_minor)
+    assert not bool(np.asarray(installed).any())
+    np.testing.assert_array_equal(np.asarray(state2.view_mask),
+                                  np.asarray(state.view_mask))
+
+
+def test_churn_cycle_at_scale():
+    """10k ensembles through install→collapse cycles with rolling
+    member replacement — the reconfig-under-churn scenario."""
+    e, m = 10_000, 5
+    state, up = _elected(e, m, s=4)
+    rng = np.random.default_rng(0)
+    kind = jnp.full((e,), eng.OP_PUT, jnp.int32)
+    slot = jnp.zeros((e,), jnp.int32)
+    lease = jnp.ones((e,), bool)
+    for round_i in range(3):
+        keep = np.ones((e, m), bool)
+        drop = rng.integers(0, m, e)
+        keep[np.arange(e), drop] = False  # rotate one member out
+        new_view = jnp.asarray(keep)
+        state, installed, _ = eng.reconfig_step(
+            state, jnp.ones((e,), bool), new_view, up)
+        assert bool(np.asarray(installed).all()), round_i
+        # write while joint
+        state, res = eng.kv_step(state, kind, slot,
+                                 jnp.full((e,), round_i + 1, jnp.int32),
+                                 lease, up)
+        assert bool(np.asarray(res.committed).all()), round_i
+        state, _, collapsed = eng.reconfig_step(
+            state, jnp.zeros((e,), bool), new_view, up)
+        assert bool(np.asarray(collapsed).all()), round_i
+        # restore full membership for the next cycle
+        full = jnp.asarray(np.ones((e, m), bool))
+        state, installed, _ = eng.reconfig_step(
+            state, jnp.ones((e,), bool), full, up)
+        assert bool(np.asarray(installed).all()), round_i
+        state, _, collapsed = eng.reconfig_step(
+            state, jnp.zeros((e,), bool), full, up)
+        assert bool(np.asarray(collapsed).all()), round_i
