@@ -1,0 +1,246 @@
+"""Host↔engine bridge: many ensembles served through the batched
+device engine.
+
+The scalar actor stack (:mod:`riak_ensemble_tpu.peer`) is the protocol
+oracle and the fully-general path (dynamic membership, synctree,
+gossip).  This module is the scale path the north star describes: a
+host *service* that multiplexes thousands of engine-backed ensembles —
+
+- client ops (kget/kput/kdelete) queue per ensemble and flush as one
+  ``full_step`` launch per tick: ``[K, E]`` op matrices, one device
+  dispatch for every queued op of every ensemble (the batched analog
+  of E leader processes × worker pools);
+- the host side keeps what consensus doesn't need on-device: the
+  key→slot assignment per ensemble, the payload store (device arrays
+  carry int32 handles; real bytes live host-side keyed by handle —
+  engine.py's object-store contract), per-ensemble leases (monotonic
+  clock), and the failure detector (an ``up`` mask per ensemble);
+- leaderless or leader-down ensembles get an election folded into the
+  SAME launch (``full_step``'s elect inputs) — the thundering-herd
+  re-election after failures is one kernel call, not E timers.
+
+Results come back to client futures after each flush (one d2h per
+flush, amortized over every op in the batch).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from riak_ensemble_tpu.config import Config
+from riak_ensemble_tpu.ops import engine as eng
+from riak_ensemble_tpu.runtime import Future, Runtime, Timer
+from riak_ensemble_tpu.types import NOTFOUND
+
+_handles = itertools.count(1)
+
+
+@dataclass
+class _PendingOp:
+    kind: int
+    slot: int
+    handle: int
+    fut: Future
+
+
+class BatchedEnsembleService:
+    """N engine-backed ensembles behind a put/get API.
+
+    ``n_slots`` bounds live keys per ensemble (slots are recycled when
+    keys are deleted).  ``tick`` is the flush cadence: lower = lower
+    latency, higher = bigger batches.
+    """
+
+    def __init__(self, runtime: Runtime, n_ens: int, n_peers: int,
+                 n_slots: int = 128, tick: float = 0.005,
+                 max_ops_per_tick: int = 64,
+                 config: Optional[Config] = None) -> None:
+        import jax.numpy as jnp
+
+        self.runtime = runtime
+        self.config = config if config is not None else Config()
+        self.n_ens, self.n_peers, self.n_slots = n_ens, n_peers, n_slots
+        self.tick = tick
+        self.max_k = max_ops_per_tick
+        self.state = eng.init_state(n_ens, n_peers, n_slots)
+        #: host failure detector input (set_peer_up)
+        self.up = np.ones((n_ens, n_peers), dtype=bool)
+        #: per-ensemble key→slot and free slots
+        self.key_slot: List[Dict[Any, int]] = [dict() for _ in range(n_ens)]
+        self.free_slots: List[List[int]] = [
+            list(range(n_slots)) for _ in range(n_ens)]
+        #: payload store: handle -> value (device carries handles)
+        self.values: Dict[int, Any] = {}
+        self.queues: List[List[_PendingOp]] = [[] for _ in range(n_ens)]
+        #: leader leases, host-side: ensemble -> expiry (runtime.now)
+        self.lease_until = np.zeros((n_ens,), dtype=float)
+        self.flushes = 0
+        self.ops_served = 0
+        self._timer: Optional[Timer] = None
+        self._jnp = jnp
+        self._schedule()
+
+    # -- client API --------------------------------------------------------
+
+    def kput(self, ens: int, key: Any, value: Any) -> Future:
+        """Quorum-replicated write; resolves ('ok', handle_vsn) or
+        'failed' (no slot / no quorum this flush)."""
+        fut = Future()
+        slot = self._slot_for(ens, key, allocate=True)
+        if slot is None:
+            fut.resolve("failed")
+            return fut
+        handle = next(_handles) & 0x7FFFFFFF
+        self.values[handle] = value
+        self.queues[ens].append(_PendingOp(eng.OP_PUT, slot, handle, fut))
+        return fut
+
+    def kget(self, ens: int, key: Any) -> Future:
+        """Linearizable read; resolves ('ok', value|NOTFOUND) or
+        'failed'."""
+        fut = Future()
+        slot = self._slot_for(ens, key, allocate=False)
+        if slot is None:
+            fut.resolve(("ok", NOTFOUND))
+            return fut
+        self.queues[ens].append(_PendingOp(eng.OP_GET, slot, 0, fut))
+        return fut
+
+    def kdelete(self, ens: int, key: Any) -> Future:
+        """Tombstone write (slot recycled once committed)."""
+        fut = Future()
+        slot = self._slot_for(ens, key, allocate=False)
+        if slot is None:
+            fut.resolve(("ok", NOTFOUND))
+            return fut
+        handle = 0  # 0 = tombstone handle
+        op = _PendingOp(eng.OP_PUT, slot, handle, fut)
+        self.queues[ens].append(op)
+
+        def recycle(result):
+            if isinstance(result, tuple) and result[0] == "ok":
+                self.key_slot[ens].pop(key, None)
+                self.free_slots[ens].append(slot)
+        fut.add_waiter(recycle)
+        return fut
+
+    def set_peer_up(self, ens: int, peer: int, up: bool) -> None:
+        """Failure-detector input (the host's nodedown/suspend signal)."""
+        self.up[ens, peer] = up
+
+    def stop(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    # -- internals ---------------------------------------------------------
+
+    def _slot_for(self, ens: int, key: Any, allocate: bool) -> Optional[int]:
+        slot = self.key_slot[ens].get(key)
+        if slot is not None or not allocate:
+            return slot
+        if not self.free_slots[ens]:
+            return None
+        slot = self.free_slots[ens].pop()
+        self.key_slot[ens][key] = slot
+        return slot
+
+    def _schedule(self) -> None:
+        self._timer = self.runtime.schedule(self.tick, self._on_tick)
+
+    def _on_tick(self) -> None:
+        try:
+            self.flush()
+        finally:
+            self._schedule()
+
+    def _election_inputs(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Elect wherever there is no leader or the leader is down;
+        candidate = lowest-index up member (the randomized-timeout
+        winner in the reference; the host picks deterministically)."""
+        leader = np.asarray(self.state.leader)
+        leader_up = np.zeros((self.n_ens,), dtype=bool)
+        has = leader >= 0
+        leader_up[has] = self.up[np.nonzero(has)[0], leader[has]]
+        member = np.asarray(self.state.view_mask).any(1)
+        cand_ok = self.up & member
+        any_up = cand_ok.any(1)
+        cand = np.where(any_up, cand_ok.argmax(1), -1).astype(np.int32)
+        elect = (~has | ~leader_up) & any_up
+        return elect, cand
+
+    def flush(self) -> int:
+        """One device launch for everything queued; returns ops served."""
+        jnp = self._jnp
+        k = min(self.max_k, max((len(q) for q in self.queues), default=0))
+        elect, cand = self._election_inputs()
+        if k == 0 and not elect.any():
+            return 0
+
+        kind = np.zeros((k, self.n_ens), dtype=np.int32)
+        slot = np.zeros((k, self.n_ens), dtype=np.int32)
+        val = np.zeros((k, self.n_ens), dtype=np.int32)
+        taken: List[List[_PendingOp]] = []
+        now = self.runtime.now
+        lease_ok = self.lease_until > now
+        for e in range(self.n_ens):
+            ops = self.queues[e][:k]
+            self.queues[e] = self.queues[e][k:]
+            taken.append(ops)
+            for j, op in enumerate(ops):
+                kind[j, e] = op.kind
+                slot[j, e] = op.slot
+                val[j, e] = op.handle
+
+        state, won, res = eng.full_step(
+            self.state, jnp.asarray(elect), jnp.asarray(cand),
+            jnp.asarray(kind), jnp.asarray(slot), jnp.asarray(val),
+            jnp.asarray(np.broadcast_to(lease_ok, (max(k, 1),
+                                                   self.n_ens))[:k]
+                        if k else np.zeros((0, self.n_ens), bool)),
+            jnp.asarray(self.up))
+        self.state = state
+
+        # one d2h per flush
+        won_np = np.asarray(won)
+        committed = np.asarray(res.committed) if k else None
+        get_ok = np.asarray(res.get_ok) if k else None
+        found = np.asarray(res.found) if k else None
+        value = np.asarray(res.value) if k else None
+        vsn = np.asarray(res.obj_vsn) if k else None
+
+        # a successful election (or any committed activity) renews the
+        # lease for this ensemble's leader (leader_tick renewal analog)
+        self.lease_until[won_np] = now + self.config.lease()
+        served = 0
+        for e in range(self.n_ens):
+            any_commit = False
+            for j, op in enumerate(taken[e]):
+                served += 1
+                if op.kind == eng.OP_PUT:
+                    if committed[j, e]:
+                        any_commit = True
+                        op.fut.resolve(("ok", (int(vsn[j, e, 0]),
+                                               int(vsn[j, e, 1]))))
+                    else:
+                        self.values.pop(op.handle, None)
+                        op.fut.resolve("failed")
+                else:
+                    if get_ok[j, e]:
+                        if found[j, e] and value[j, e] != 0:
+                            op.fut.resolve(
+                                ("ok", self.values.get(int(value[j, e]),
+                                                       NOTFOUND)))
+                        else:
+                            op.fut.resolve(("ok", NOTFOUND))
+                    else:
+                        op.fut.resolve("failed")
+            if any_commit:
+                self.lease_until[e] = now + self.config.lease()
+        self.flushes += 1
+        self.ops_served += served
+        return served

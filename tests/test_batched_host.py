@@ -1,0 +1,112 @@
+"""Host↔engine bridge: engine-backed ensembles behind the service API,
+with host-side failure detection driving batched elections.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from riak_ensemble_tpu.config import fast_test_config  # noqa: E402
+from riak_ensemble_tpu.parallel.batched_host import (  # noqa: E402
+    BatchedEnsembleService,
+)
+from riak_ensemble_tpu.runtime import Runtime  # noqa: E402
+from riak_ensemble_tpu.types import NOTFOUND  # noqa: E402
+
+
+def make_service(n_ens=64, n_peers=5, n_slots=16):
+    runtime = Runtime(seed=50)
+    svc = BatchedEnsembleService(runtime, n_ens, n_peers, n_slots,
+                                 tick=0.005, config=fast_test_config())
+    return runtime, svc
+
+
+def settle(runtime, fut, timeout=5.0):
+    return runtime.await_future(fut, timeout)
+
+
+def test_put_get_roundtrip_across_ensembles():
+    runtime, svc = make_service()
+    futs = [(e, svc.kput(e, "k", f"v{e}".encode()))
+            for e in range(svc.n_ens)]
+    for e, fut in futs:
+        r = settle(runtime, fut)
+        assert r[0] == "ok", (e, r)
+    for e in range(svc.n_ens):
+        r = settle(runtime, svc.kget(e, "k"))
+        assert r == ("ok", f"v{e}".encode())
+    # unknown key
+    assert settle(runtime, svc.kget(0, "nope")) == ("ok", NOTFOUND)
+    assert svc.flushes >= 1
+
+
+def test_delete_recycles_slot():
+    runtime, svc = make_service(n_ens=1, n_slots=2)
+    assert settle(runtime, svc.kput(0, "a", b"1"))[0] == "ok"
+    assert settle(runtime, svc.kput(0, "b", b"2"))[0] == "ok"
+    # full: next new key fails
+    assert settle(runtime, svc.kput(0, "c", b"3")) == "failed"
+    assert settle(runtime, svc.kdelete(0, "a"))[0] == "ok"
+    assert settle(runtime, svc.kput(0, "c", b"3"))[0] == "ok"
+    assert settle(runtime, svc.kget(0, "a")) == ("ok", NOTFOUND)
+    assert settle(runtime, svc.kget(0, "c")) == ("ok", b"3")
+
+
+def test_leader_failure_reelection():
+    runtime, svc = make_service(n_ens=8)
+    for e in range(8):
+        assert settle(runtime, svc.kput(e, "k", b"v"))[0] == "ok"
+    leaders = np.asarray(svc.state.leader).copy()
+    assert (leaders >= 0).all()
+
+    # kill every leader replica (host failure detector)
+    for e in range(8):
+        svc.set_peer_up(e, int(leaders[e]), False)
+    # expire leases so reads can't ride the old lease
+    svc.lease_until[:] = 0.0
+    runtime.run_for(0.1)  # a few ticks: elections fold into flushes
+
+    new_leaders = np.asarray(svc.state.leader)
+    assert (new_leaders != leaders).all(), "no re-election"
+    for e in range(8):
+        r = settle(runtime, svc.kget(e, "k"))
+        assert r == ("ok", b"v"), (e, r)
+    # writes work under the new leaders too
+    for e in range(8):
+        assert settle(runtime, svc.kput(e, "k", b"v2"))[0] == "ok"
+
+
+def test_no_quorum_no_service():
+    runtime, svc = make_service(n_ens=4, n_peers=5)
+    for e in range(4):
+        assert settle(runtime, svc.kput(e, "k", b"v"))[0] == "ok"
+    # majority down
+    for e in range(4):
+        for p in (0, 1, 2):
+            svc.set_peer_up(e, p, False)
+    svc.lease_until[:] = 0.0
+    for e in range(4):
+        assert settle(runtime, svc.kput(e, "k", b"x")) == "failed"
+        assert settle(runtime, svc.kget(e, "k")) == "failed"
+    # heal: service resumes (election re-folds in)
+    for e in range(4):
+        for p in (0, 1, 2):
+            svc.set_peer_up(e, p, True)
+    runtime.run_for(0.1)
+    for e in range(4):
+        r = settle(runtime, svc.kget(e, "k"))
+        assert r == ("ok", b"v"), (e, r)
+
+
+def test_batching_amortizes_flushes():
+    runtime, svc = make_service(n_ens=32)
+    futs = []
+    for e in range(32):
+        for i in range(8):
+            futs.append(svc.kput(e, f"k{i}", b"x"))
+    runtime.run_for(0.2)
+    assert all(f.done and f.value[0] == "ok" for f in futs)
+    # 8 ops per ensemble served in ~= 8/k flush rounds, not 256 calls
+    assert svc.flushes < 50
+    assert svc.ops_served == 256
