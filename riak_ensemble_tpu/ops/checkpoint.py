@@ -1,0 +1,45 @@
+"""Checkpoint/restore for the batched engine state.
+
+The reference checkpoints per-peer facts through the coalescing storage
+manager (maybe_save_fact, peer.erl:2201-2228; SURVEY §5) and recovers
+by reloading + probing.  The device engine's equivalent: snapshot the
+whole ``EngineState`` — E ensembles' ballots and replicated stores in
+one pytree — via orbax (the TPU-native checkpointer), and restore it
+into a fresh process.  A restored state is immediately serveable: the
+ballot arrays ARE the facts, so there is no probe phase (the batched
+analog of reload_fact + local_commit).
+
+Orbax handles sharded arrays transparently, so the same two calls
+checkpoint a mesh-sharded state from a multi-host job.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from riak_ensemble_tpu.ops.engine import EngineState
+
+
+def save(path: str, state: EngineState) -> None:
+    """Write a checkpoint (atomic directory swap, orbax semantics)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, state._asdict(), force=True)
+
+
+def load(path: str, template: Optional[EngineState] = None) -> EngineState:
+    """Restore a checkpoint.  ``template`` (an ``init_state`` of the
+    same shapes) restores with matching shardings/dtypes; without it,
+    arrays come back with saved metadata."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    if template is not None:
+        restored = ckptr.restore(path, item=template._asdict())
+    else:
+        restored = ckptr.restore(path)
+    return EngineState(**restored)
