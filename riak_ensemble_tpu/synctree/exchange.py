@@ -26,7 +26,9 @@ from typing import Any, List
 
 from riak_ensemble_tpu import msg as msglib
 from riak_ensemble_tpu.runtime import Future
-from riak_ensemble_tpu.synctree.tree import NONE, Corrupted, compare_gen
+from riak_ensemble_tpu.synctree.tree import (
+    NONE, Corrupted, compare_gen_streamed,
+)
 
 
 def start_exchange(peer, tree_name, peers, views, trusted: bool) -> None:
@@ -96,17 +98,20 @@ def _perform_exchange2(peer, tree_name, remote_peers: List[Any]):
 
         flags = {"local": False, "remote": False, "timeout": False}
 
-        def local(level, bucket):
-            return _tree_call(peer, tree_name, ("tree_exchange_get",
-                                                level, bucket))
+        # Level-batched (streamed) fetches: one local call and ONE
+        # remote round trip per level (compare_gen_streamed).
+        def local_many(pairs):
+            return _tree_call(peer, tree_name,
+                              ("tree_exchange_get_many", tuple(pairs)))
 
-        def remote_get(level, bucket):
+        def remote_many(pairs):
             return msglib.xcall(peer, remote_tree,
-                                ("tree_exchange_get", level, bucket),
+                                ("tree_exchange_get_many", tuple(pairs)),
                                 call_timeout)
 
-        gen = compare_gen(height, _wrap(local, flags, "local"),
-                          _wrap(remote_get, flags, "remote"))
+        gen = compare_gen_streamed(
+            height, _wrap_many(local_many, flags, "local"),
+            _wrap_many(remote_many, flags, "remote"))
         diffs = yield from _drive(gen)
         if flags["timeout"]:
             peer.runtime.post(peer.name, ("exchange_failed",))
@@ -129,21 +134,26 @@ def _perform_exchange2(peer, tree_name, remote_peers: List[Any]):
     peer.runtime.post(peer.name, ("exchange_complete",))
 
 
-def _wrap(fetch, flags, side):
-    """Translate 'corrupted'/'timeout' replies into aborts."""
-    def inner(level, bucket):
-        raw = fetch(level, bucket)
+def _wrap_many(fetch_many, flags, side):
+    """Translate per-entry 'corrupted' and whole-call 'timeout'
+    replies of a batched fetch into Corrupted aborts."""
+    def inner(pairs):
+        raw = fetch_many(pairs)
         out = Future()
 
         def on(v):
-            if v == "corrupted":
-                flags[side] = True
-                out.resolve(Corrupted(0, 0))
-            elif v == "timeout":
+            if v == "timeout":
                 flags["timeout"] = True
-                out.resolve(Corrupted(0, 0))
-            else:
-                out.resolve(v)
+                out.resolve([Corrupted(0, 0)] * len(pairs))
+                return
+            entries = []
+            for item in v:
+                if item == "corrupted":
+                    flags[side] = True
+                    entries.append(Corrupted(0, 0))
+                else:
+                    entries.append(item)
+            out.resolve(entries)
 
         raw.add_waiter(on)
         return out

@@ -59,6 +59,20 @@ class PeerTree(Actor):
                 fut.resolve("corrupted")
             else:
                 fut.resolve("ok")
+        elif kind == "tree_exchange_get_many":
+            # Level-batched exchange fetch (the start_exchange_level
+            # streaming hook): one message covers a whole level's
+            # buckets.
+            _, pairs, fut = msg
+            out = []
+            for level, bucket in pairs:
+                result = self.tree.exchange_get(level, bucket)
+                if isinstance(result, Corrupted):
+                    self.corrupted = (result.level, result.bucket)
+                    out.append("corrupted")
+                else:
+                    out.append(result)
+            fut.resolve(out)
         elif kind == "tree_exchange_get":
             _, level, bucket, fut = msg
             result = self.tree.exchange_get(level, bucket)

@@ -368,6 +368,50 @@ def compare_gen(height: int, local: Callable, remote: Callable
     return acc
 
 
+def compare_gen_streamed(height: int, local_many: Callable,
+                         remote_many: Callable) -> Generator:
+    """Level-batched diff: ONE fetch per side per level, covering every
+    bucket the descent needs — the reference's streaming exchange
+    (``start_exchange_level`` prefetch hook, exercised by
+    synctree_remote.erl:24-38).  Cuts a WAN exchange from
+    O(width·height·diffs) round trips to O(height).
+
+    ``local_many/remote_many(pairs)`` take a list of (level, bucket)
+    and return a Future resolving to a list of bucket dicts (or
+    Corrupted entries).  Raises Corrupted on either side's corruption.
+    """
+    final = height + 1
+    level = 1
+    diff: List[int] = [0]
+    acc: List = []
+    # level 0: the root hashes
+    a0 = yield local_many([(0, 0)])
+    b0 = yield remote_many([(0, 0)])
+    for v in (a0[0], b0[0]):
+        if isinstance(v, Corrupted):
+            raise v
+    if not orddict_delta(a0[0], b0[0]):
+        return acc
+    while diff and level <= final:
+        pairs = [(level, b) for b in diff]
+        a_buckets = yield local_many(pairs)
+        b_buckets = yield remote_many(pairs)
+        next_diff: List[int] = []
+        for a, b in zip(a_buckets, b_buckets):
+            if isinstance(a, Corrupted):
+                raise a
+            if isinstance(b, Corrupted):
+                raise b
+            delta = orddict_delta(a, b)
+            if level == final:
+                acc.extend(delta)
+            else:
+                next_diff.extend(bk for bk, _ in delta)
+        diff = next_diff
+        level += 1
+    return acc
+
+
 def local_compare(t1: SyncTree, t2: SyncTree) -> List:
     """Synchronous compare of two in-process trees
     (synctree.erl:361-369)."""

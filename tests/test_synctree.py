@@ -11,7 +11,8 @@ import pytest
 from riak_ensemble_tpu.runtime import Future, Runtime
 from riak_ensemble_tpu.synctree.backends import DictBackend, FileBackend
 from riak_ensemble_tpu.synctree.tree import (
-    NONE, Corrupted, SyncTree, compare_gen, local_compare,
+    NONE, Corrupted, SyncTree, compare_gen, compare_gen_streamed,
+    local_compare,
 )
 
 
@@ -134,6 +135,58 @@ def test_remote_exchange_message_counts():
     assert sorted(key_diff) == expected_diff(num, diff)
     # cost bound: each level visits at most the differing buckets
     assert stats["msgs"] <= (local_tree.height + 2) * max(diff, 1) * 2
+
+
+def test_streamed_exchange_round_trips():
+    """The level-batched exchange (start_exchange_level streaming)
+    makes O(height) remote ROUND TRIPS however many buckets differ."""
+    num, diff = 200, 60
+    local_tree = build(num)
+    remote_tree = build(num - diff)
+    stats = {"remote_calls": 0}
+
+    def many_of(tree, count=False):
+        def fetch_many(pairs):
+            if count:
+                stats["remote_calls"] += 1
+            fut = Future()
+            fut.resolve([tree.exchange_get(lv, b) for lv, b in pairs])
+            return fut
+        return fetch_many
+
+    gen = compare_gen_streamed(local_tree.height, many_of(local_tree),
+                               many_of(remote_tree, count=True))
+    try:
+        fut = next(gen)
+        while True:
+            fut = gen.send(fut.value)
+    except StopIteration as stop:
+        key_diff = stop.value
+    assert sorted(key_diff) == expected_diff(num, diff)
+    # root + one batch per descended level
+    assert stats["remote_calls"] <= local_tree.height + 2
+
+
+def test_streamed_matches_unbatched():
+    for n1, n2 in ((50, 40), (30, 30), (1, 0)):
+        t1, t2 = build(n1), build(n2)
+
+        def many_of(tree):
+            def fetch_many(pairs):
+                fut = Future()
+                fut.resolve([tree.exchange_get(lv, b)
+                             for lv, b in pairs])
+                return fut
+            return fetch_many
+
+        gen = compare_gen_streamed(t1.height, many_of(t1), many_of(t2))
+        try:
+            fut = next(gen)
+            while True:
+                fut = gen.send(fut.value)
+        except StopIteration as stop:
+            streamed = sorted(stop.value)
+        assert streamed == sorted(local_compare(t1, t2))
 
 
 # -- synctree_path_test.erl: shared M:1 tree --------------------------------
