@@ -27,7 +27,7 @@ from typing import Any, Optional, Sequence
 from riak_ensemble_tpu.client import Client
 from riak_ensemble_tpu.config import Config
 from riak_ensemble_tpu.manager import Manager
-from riak_ensemble_tpu.runtime import Future, Runtime
+from riak_ensemble_tpu.runtime import Runtime
 from riak_ensemble_tpu.storage import Storage
 from riak_ensemble_tpu.types import PeerId
 

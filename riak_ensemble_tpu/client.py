@@ -13,7 +13,7 @@ only — client.erl:22-24).
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any
 
 from riak_ensemble_tpu import router as routerlib
 from riak_ensemble_tpu.manager import manager_name

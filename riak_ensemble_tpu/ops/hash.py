@@ -32,7 +32,7 @@ reference does.
 from __future__ import annotations
 
 import functools
-from typing import List, Sequence, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -26,7 +26,7 @@ a peer axis use spec ('ens', 'peer'), per-ensemble vectors use
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import numpy as np

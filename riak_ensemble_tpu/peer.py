@@ -34,7 +34,6 @@ Key mechanics mirrored from the reference:
 
 from __future__ import annotations
 
-import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from riak_ensemble_tpu import msg as msglib

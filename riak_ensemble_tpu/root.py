@@ -22,7 +22,7 @@ manager actor, which serializes naturally.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, Optional
 
 from riak_ensemble_tpu import router as routerlib
 from riak_ensemble_tpu import state as statelib

@@ -20,7 +20,7 @@ Unknown leader → immediate ``timeout`` result (router.erl fail_cast /
 from __future__ import annotations
 
 import itertools
-from typing import Any, Optional, Tuple
+from typing import Any, Tuple
 
 from riak_ensemble_tpu.runtime import Actor, Future, Runtime
 

@@ -13,7 +13,7 @@ from __future__ import annotations
 import os
 import pickle
 import zlib
-from typing import Any, Dict, Iterable, Optional, Tuple
+from typing import Any, Dict, Iterable, Tuple
 
 
 class DictBackend:

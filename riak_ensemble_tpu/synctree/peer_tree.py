@@ -18,7 +18,7 @@ Message protocol (all sync ops carry a reply Future):
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Optional, Tuple
 
 from riak_ensemble_tpu.runtime import Actor, Future, Runtime
 from riak_ensemble_tpu.synctree.tree import Corrupted, SyncTree

@@ -17,7 +17,7 @@ Fault-injection surface (SURVEY §4 parity):
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence
 
 from riak_ensemble_tpu import peer as peerlib
 from riak_ensemble_tpu.config import Config, fast_test_config
