@@ -407,7 +407,12 @@ def reconfig_step(state: EngineState, propose: jax.Array,
     """
     member_now = state.view_mask.any(1)                      # [E, Ml]
     heard = up & member_now
-    is_joint = state.view_mask[:, 1, :].any(-1)              # [E]
+    # Peer-axis predicates must be global under sharding (a shard only
+    # sees its local peer slice).
+    is_joint = reduce_peers(
+        state.view_mask[:, 1, :].astype(jnp.int32), axis_name) > 0  # [E]
+    new_nonempty = reduce_peers(new_view.astype(jnp.int32),
+                                axis_name) > 0               # [E]
     has_leader = state.leader >= 0
 
     # Commit gate in the CURRENT configuration (epoch-matching acks).
@@ -419,8 +424,7 @@ def reconfig_step(state: EngineState, propose: jax.Array,
     commit_ok = (_quorum_met(ack, heard, state.view_mask, axis_name)
                  & has_leader)
 
-    valid_new = new_view.any(-1) | ~propose
-    install = propose & ~is_joint & commit_ok & valid_new & new_view.any(-1)
+    install = propose & ~is_joint & commit_ok & new_nonempty
     collapse = is_joint & commit_ok & ~propose
 
     old_v0 = state.view_mask[:, 0, :]

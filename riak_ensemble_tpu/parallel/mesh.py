@@ -102,6 +102,11 @@ class ShardedEngine:
              P(None, "ens"), P(None, "ens"), P(None, "ens"),
              P("ens", "peer")),
             (_STATE_SPECS, P("ens"), _SCAN_RESULT_SPECS))
+        self._reconfig = smap(
+            lambda st, pr, nv, up: eng.reconfig_step(st, pr, nv, up,
+                                                     axis_name=ax),
+            (_STATE_SPECS, P("ens"), P("ens", "peer"), P("ens", "peer")),
+            (_STATE_SPECS, P("ens"), P("ens")))
 
     # -- placement ---------------------------------------------------------
 
@@ -130,3 +135,8 @@ class ShardedEngine:
 
     def full_step(self, state, elect, cand, kind, slot, val, lease_ok, up):
         return self._full(state, elect, cand, kind, slot, val, lease_ok, up)
+
+    def reconfig_step(self, state, propose, new_view, up):
+        """Joint-consensus membership change over the mesh
+        (:func:`riak_ensemble_tpu.ops.engine.reconfig_step`)."""
+        return self._reconfig(state, propose, new_view, up)

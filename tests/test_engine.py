@@ -300,3 +300,41 @@ def test_sharded_matches_single_device(mesh_shape):
     assert bool(np.asarray(res2.found).all())
     np.testing.assert_array_equal(np.asarray(res2.obj_vsn[..., 0]),
                                   2 * np.ones((3, e)))
+
+
+@pytest.mark.parametrize("mesh_shape", [(4, 2), (8, 1)])
+def test_sharded_reconfig_matches_single_device(mesh_shape):
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    n_ens, n_peer = mesh_shape
+    e, m = 8, 8
+    mesh = make_mesh(n_ens, n_peer)
+    se = ShardedEngine(mesh)
+    views = [list(range(5))]
+    up = jnp.ones((e, m), bool)
+    new_view = jnp.asarray(
+        np.tile(np.array([1, 1, 1, 0, 0, 0, 0, 0], bool), (e, 1)))
+    propose = jnp.ones((e,), bool)
+    hold = jnp.zeros((e,), bool)
+
+    def run(elect_fn, reconfig_fn, state):
+        state, won = elect_fn(state, jnp.ones((e,), bool),
+                              jnp.zeros((e,), jnp.int32), up)
+        state, inst, _ = reconfig_fn(state, propose, new_view, up)
+        state, _, coll = reconfig_fn(state, hold, new_view, up)
+        return won, inst, coll, state
+
+    out_single = run(eng.elect_step, eng.reconfig_step,
+                     eng.init_state(e, m, S, views=views))
+    out_sharded = run(se.elect_step, se.reconfig_step,
+                      se.init_state(e, m, S, views=views))
+    for a, b in zip(jax.tree.leaves(out_single),
+                    jax.tree.leaves(out_sharded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    won, inst, coll, state = out_single
+    assert bool(np.asarray(won).all())
+    assert bool(np.asarray(inst).all())
+    assert bool(np.asarray(coll).all())
+    vm = np.asarray(state.view_mask)
+    assert vm[:, 0, :3].all() and not vm[:, 0, 3:].any()
+    assert not vm[:, 1, :].any()
