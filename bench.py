@@ -78,12 +78,116 @@ def run(n_ens: int, n_peers: int, n_slots: int, k: int,
     return n_ens * k * iters / elapsed
 
 
+def run_merkle(seconds: float, smoke: bool) -> dict:
+    """BASELINE ladder #4: incremental updates into a 1M-segment
+    Merkle tree (the always-up-to-date write-path hashing)."""
+    import jax
+    import jax.numpy as jnp
+
+    from riak_ensemble_tpu.ops import hash as hashk
+
+    segs = 16 ** 3 if smoke else 16 ** 5
+    batch = 256 if smoke else 4096
+    rng = np.random.default_rng(0)
+    leaves = jnp.zeros((segs, hashk.LANES), jnp.uint32)
+    levels = hashk.build(leaves, width=16)
+    ids = jnp.asarray(rng.integers(0, segs, batch))
+    new = jnp.asarray(rng.integers(0, 2 ** 32, (batch, hashk.LANES),
+                                   dtype=np.uint32))
+    levels = hashk.update(levels, ids, new, width=16)
+    jax.block_until_ready(levels)
+
+    t0 = time.perf_counter()
+    ncal = 3
+    for _ in range(ncal):
+        levels = hashk.update(levels, ids, new, width=16)
+        jax.block_until_ready(levels)
+    step_est = (time.perf_counter() - t0) / ncal
+    iters = max(10, int(seconds / step_est))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        levels = hashk.update(levels, ids, new, width=16)
+    jax.block_until_ready(levels)
+    elapsed = time.perf_counter() - t0
+    rate = batch * iters / elapsed
+    return {
+        "metric": f"merkle_key_updates_per_sec_{segs}_segments",
+        "value": round(rate, 1),
+        "unit": "updates/sec",
+        "vs_baseline": round(rate / 1_000_000.0, 3),
+    }
+
+
+def run_reconfig(seconds: float, smoke: bool) -> dict:
+    """BASELINE ladder #5: joint-consensus reconfig cycles under churn
+    (install joint views + collapse), batched over all ensembles."""
+    import jax
+    import jax.numpy as jnp
+
+    from riak_ensemble_tpu.ops import engine as eng
+
+    n_ens, m = (64, 5) if smoke else (10_000, 5)
+    state = eng.init_state(n_ens, m, 8)
+    up = jnp.ones((n_ens, m), bool)
+    state, won = eng.elect_step(state, jnp.ones((n_ens,), bool),
+                                jnp.zeros((n_ens,), jnp.int32), up)
+    rng = np.random.default_rng(0)
+    keep = np.ones((n_ens, m), bool)
+    keep[np.arange(n_ens), rng.integers(0, m, n_ens)] = False
+    shrink = jnp.asarray(keep)
+    full = jnp.ones((n_ens, m), bool)
+    yes = jnp.ones((n_ens,), bool)
+    no = jnp.zeros((n_ens,), bool)
+
+    def cycle(st):
+        st, _, _ = eng.reconfig_step(st, yes, shrink, up)
+        st, _, _ = eng.reconfig_step(st, no, shrink, up)
+        st, _, _ = eng.reconfig_step(st, yes, full, up)
+        st, _, _ = eng.reconfig_step(st, no, full, up)
+        return st
+
+    state = cycle(state)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    ncal = 3
+    for _ in range(ncal):
+        state = cycle(state)
+        jax.block_until_ready(state)
+    step_est = (time.perf_counter() - t0) / ncal
+    iters = max(5, int(seconds / step_est))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = cycle(state)
+    jax.block_until_ready(state)
+    elapsed = time.perf_counter() - t0
+    assert bool(np.asarray(won).all())
+    # 2 full membership changes (4 reconfig phases) per cycle per ens
+    rate = 2 * n_ens * iters / elapsed
+    return {
+        "metric": f"membership_changes_per_sec_{n_ens}_ens",
+        "value": round(rate, 1),
+        "unit": "changes/sec",
+        "vs_baseline": round(rate / 1_000_000.0, 3),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes for a CPU sanity run")
     ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--scenario", default="kv",
+                    choices=("kv", "merkle", "reconfig"),
+                    help="kv = headline (driver default); merkle / "
+                         "reconfig = BASELINE.md ladder #4 / #5")
     args = ap.parse_args()
+
+    if args.scenario == "merkle":
+        print(json.dumps(run_merkle(args.seconds, args.smoke)))
+        return
+    if args.scenario == "reconfig":
+        print(json.dumps(run_reconfig(args.seconds, args.smoke)))
+        return
 
     if args.smoke:
         ops_per_sec = run(n_ens=64, n_peers=5, n_slots=32, k=4,

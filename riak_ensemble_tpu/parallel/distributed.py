@@ -1,0 +1,56 @@
+"""Multi-host bring-up for the sharded engine.
+
+The reference scales across machines with Erlang distribution; the
+engine scales across TPU hosts with ``jax.distributed`` + a global
+mesh.  The layout contract (see :mod:`riak_ensemble_tpu.parallel.mesh`)
+is what keeps traffic on the right fabric:
+
+- the **'ens' axis spans hosts** — ensembles are independent, so the
+  data-parallel axis needs no cross-device collectives at all (DCN
+  carries only the host-level client/membership traffic, via
+  :mod:`riak_ensemble_tpu.netruntime`);
+- the **'peer' axis stays intra-slice** ('peer' is the innermost mesh
+  dim), so every quorum psum/pmax rides ICI.
+
+Single-process multi-device (including the driver's
+``xla_force_host_platform_device_count`` CPU mesh) needs no
+initialization — ``global_mesh`` just shapes whatever devices exist.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+from riak_ensemble_tpu.parallel.mesh import Mesh, ShardedEngine, make_mesh
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """``jax.distributed.initialize`` wrapper.  On TPU pods with
+    standard env plumbing (megascale/GKE), call with no args; explicit
+    args cover bare-metal DCN clusters.  No-op if already initialized
+    or single-process."""
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+    except RuntimeError:
+        pass  # already initialized
+
+
+def global_mesh(n_peer: int = 1,
+                devices: Optional[Sequence] = None) -> Mesh:
+    """Mesh over ALL processes' devices: ens = total_devices / n_peer,
+    peer innermost.  Call after :func:`initialize` on every process."""
+    devs = list(devices if devices is not None else jax.devices())
+    assert len(devs) % n_peer == 0, (len(devs), n_peer)
+    return make_mesh(len(devs) // n_peer, n_peer, devices=devs)
+
+
+def sharded_engine(n_peer: int = 1) -> ShardedEngine:
+    """One-call engine over every device of the (multi-host) job."""
+    return ShardedEngine(global_mesh(n_peer))

@@ -338,3 +338,18 @@ def test_sharded_reconfig_matches_single_device(mesh_shape):
     vm = np.asarray(state.view_mask)
     assert vm[:, 0, :3].all() and not vm[:, 0, 3:].any()
     assert not vm[:, 1, :].any()
+
+
+def test_distributed_helpers_on_virtual_mesh():
+    from riak_ensemble_tpu.parallel import distributed as dist
+
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    se = dist.sharded_engine(n_peer=2)
+    assert se.mesh.shape == {"ens": jax.device_count() // 2, "peer": 2}
+    e, m = 8, 4
+    state = se.init_state(e, m, 8, views=[list(range(m))])
+    up = jnp.ones((e, m), bool)
+    state, won = se.elect_step(state, jnp.ones((e,), bool),
+                               jnp.zeros((e,), jnp.int32), up)
+    assert bool(np.asarray(won).all())
