@@ -57,11 +57,26 @@ class _Inflight:
 @dataclass
 class KeyModel:
     """Plausible-current-value tracking for one key (the sc.erl
-    possible-values postcondition model)."""
+    possible-values postcondition model).
+
+    Three tiers of uncertainty:
+    - ``possible`` — values the key may hold given every *completed*
+      op (acked writes pin it, plus still-in-flight concurrent writes
+      that may serialize after the pin);
+    - ``inflight`` — invoked-but-unresponded writes;
+    - ``maybe`` — writes that TIMED OUT.  An op with no response has
+      no linearization upper bound: it may take effect at ANY later
+      point (e.g. a delete queued behind a suspended leader applies
+      after that peer wins a later election — observed under the
+      freeze/partition nemesis), so these stay plausible indefinitely.
+      True data loss is still caught whenever the key has no
+      unresolved timeout that could explain the observation.
+    """
 
     key: Any
     possible: Set[Any] = field(default_factory=lambda: {NOTFOUND})
     inflight: Dict[int, _Inflight] = field(default_factory=dict)
+    maybe: Set[Any] = field(default_factory=set)
     history: List[Tuple] = field(default_factory=list)
 
     def _inflight_values(self, exclude: Optional[int] = None) -> Set[Any]:
@@ -88,24 +103,24 @@ class KeyModel:
         self.history.append(("failed", op_id))
 
     def timeout_write(self, op_id: int) -> None:
-        """Outcome unknown: may have applied, may apply while its
-        epoch is still current — its value stays plausible."""
+        """Outcome unknown: may have applied, or may apply at any
+        later point — stays plausible until observed."""
         w = self.inflight.pop(op_id)
-        self.possible.add(w.value)
+        self.maybe.add(w.value)
         self.history.append(("timeout", op_id, w.value))
 
     def ack_read(self, value: Any) -> None:
         value = _val(value)
-        valid = self.possible | self._inflight_values()
+        valid = self.possible | self._inflight_values() | self.maybe
         if value not in valid:
             raise Violation(
                 f"read of {self.key!r} returned {value!r}; plausible "
                 f"was {valid!r}\nhistory tail: {self.history[-12:]}")
-        if value is NOTFOUND and NOTFOUND not in self.possible and \
-                NOTFOUND not in self._inflight_values():
+        if value is NOTFOUND and NOTFOUND not in valid:
             raise Violation(f"DATA LOSS on {self.key!r}: notfound read "
                             f"but a write must be visible")
-        # A linearizable read pins the state.
+        # A linearizable read pins the state (timed-out writes may
+        # still land later, so `maybe` persists).
         self.possible = {value} | self._inflight_values()
         self.history.append(("read", value))
 

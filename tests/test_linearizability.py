@@ -61,6 +61,25 @@ def test_model_timeout_write_remains_plausible():
         m.ack_read(b"a")  # read pinned the state to b
 
 
+def test_model_timeout_op_may_land_late():
+    """An op with NO response has no linearization upper bound: a
+    timed-out delete may apply after a later acked write (e.g. queued
+    behind a suspended peer that later re-wins the leadership)."""
+    from riak_ensemble_tpu.types import NOTFOUND
+
+    m = KeyModel("k")
+    op1 = m.invoke_write(b"a")
+    m.ack_write(op1)
+    opd = m.invoke_write(NOTFOUND)  # delete
+    m.timeout_write(opd)            # client gave up; outcome unknown
+    op2 = m.invoke_write(b"b")
+    m.ack_write(op2)
+    m.ack_read(NOTFOUND)            # late delete landed after b: legal
+    # but a value never written is still a violation
+    with pytest.raises(Violation):
+        m.ack_read(b"never-written")
+
+
 # -- single-node ensemble under peer freezes --------------------------------
 
 
