@@ -214,6 +214,7 @@ class _Collector(Actor):
         self.replies: List[Tuple[Any, Any]] = []
         self.reqid: Optional[int] = None
         self.phase = "collect"  # or "collect_all"
+        self.lazy = False
         self.idle_timer: Optional[Timer] = None
         self.all_timer: Optional[Timer] = None
 
@@ -239,7 +240,21 @@ class _Collector(Actor):
             if reqid != self.reqid:
                 return
             self.replies.append((peer, value))
-            self._check()
+            if not self.lazy:
+                self._check()
+        elif kind == "ask":
+            # Lazy mode: the caller starts waiting NOW (the reference's
+            # {waiting, From, Ref} → check_enough handshake,
+            # msg.erl:232-234,258-280).  Everything heard so far counts
+            # — this is how ping_quorum/count_quorum see more than a
+            # bare majority.
+            met = quorum_met(self.replies, self.self_id, self.views,
+                             self.required, extra=self.extra)
+            if met == MET:
+                valid, _ = find_valid(self.replies)
+                self._finish(("quorum_met", valid))
+            else:
+                self._finish(("timeout", list(self.replies)))
         elif kind == "idle_timeout":
             if self.phase == "collect":
                 self._finish(("timeout", list(self.replies)))
@@ -294,3 +309,25 @@ def blocking_send_all(actor: Actor, msg: Tuple, self_id: Any, peers, views,
     _fan_out(collector, name, msg, reqid, peers, self_id)
     collector._arm_idle()
     return future
+
+
+def lazy_send_all(actor: Actor, msg: Tuple, self_id: Any, peers, views,
+                  required: str = "quorum"):
+    """Fan out but keep collecting until asked: the caller later posts
+    ``("ask",)`` to the returned collector name and the future resolves
+    against everything heard by then (the reference's parent-waiting
+    collector protocol, used by ping_quorum).  Returns
+    (future, collector_name_or_None)."""
+    future = Future()
+    others = [(p, a) for p, a in peers if p != self_id]
+    if not others:
+        future.resolve(("quorum_met", []))
+        return future, None
+    name = ("collector", actor.node, next(_collector_ids))
+    collector = _Collector(actor.runtime, name, actor.node, actor.config,
+                           self_id, views, required, None, future)
+    collector.lazy = True
+    reqid = next(_reqids)
+    collector.reqid = reqid
+    _fan_out(collector, name, msg, reqid, peers, self_id)
+    return future, name

@@ -881,17 +881,24 @@ class Peer(Actor):
             self._step_down("probe")
 
     def _do_ping_quorum(self, fut: Future) -> None:
-        """leading(ping_quorum,..), peer.erl:681-703."""
+        """leading(ping_quorum,..), peer.erl:681-703: replicate a fact
+        bump, let replies accumulate for 1s (lazy collector — everyone
+        reachable gets counted, not just the first majority), then
+        report who answered."""
         new_fact = _fact_replace(self.fact, seq=self.fact.seq + 1)
         self._local_commit(new_fact)
-        qfut = self._blocking_send_all(("commit", new_fact))
+        qfut, cname = msglib.lazy_send_all(
+            self, ("commit", new_fact), self.id,
+            self.get_peers(self.members), self.views)
         extra = [(self.id, "ok")] if self.id in self.members else []
         tree_ready = self.tree_ready
         leader_id = self.id
 
         def waiter():
             yield self.runtime.sleep(1.0)
-            outcome = yield self.runtime.with_timeout(qfut, 0.001,
+            if cname is not None:
+                self.runtime.post(cname, ("ask",))
+            outcome = yield self.runtime.with_timeout(qfut, 0.5,
                                                       ("timeout", []))
             if outcome[0] == "quorum_met":
                 fut.resolve((leader_id, tree_ready, extra + outcome[1]))

@@ -74,6 +74,63 @@ async def main(node):
 """
 
 
+NODE0_KILL_SCRIPT = """
+import asyncio
+from riak_ensemble_tpu.types import PeerId
+
+async def main(node):
+    assert (await node.enable()) == "ok"
+    for _ in range(600):
+        if len(node.members()) >= 3:
+            break
+        await asyncio.sleep(0.1)
+    peers = [PeerId(1, "node1"), PeerId(0, "node0"), PeerId(2, "node2")]
+    assert (await node.create_ensemble("kv", peers)) == "ok"
+    r = ("error", "x")
+    for _ in range(300):
+        r = await node.kover("kv", "k", b"v1", timeout=3.0)
+        if r[0] == "ok":
+            break
+        await asyncio.sleep(0.2)
+    assert r[0] == "ok", r
+    print("WROTE_V1", flush=True)
+
+    # wait for the driver to kill node1 (leader hint), then keep
+    # serving: a new leader must emerge from node0/node2
+    await asyncio.sleep(3.0)
+    r = ("error", "x")
+    for _ in range(600):
+        r = await node.kover("kv", "k", b"v2", timeout=2.0)
+        if r[0] == "ok":
+            break
+        await asyncio.sleep(0.2)
+    assert r[0] == "ok", r
+    r = await node.kget("kv", "k", timeout=5.0)
+    assert r[0] == "ok" and r[1].value == b"v2", r
+    print("SURVIVED_KILL", flush=True)
+
+    # node1 restarts from its data root; wait until the full ensemble
+    # is healthy again (all three replicas answering = count 3)
+    for _ in range(600):
+        n = await node.runtime.await_future(
+            node.manager.count_quorum("kv", timeout=2.0), 4.0)
+        if n >= 3:
+            break
+        await asyncio.sleep(0.3)
+    assert n >= 3, n
+    print("RESULT_OK", flush=True)
+    await asyncio.sleep(60)
+"""
+
+IDLE_SCRIPT = """
+import asyncio
+
+async def main(node):
+    print("UP", flush=True)
+    await asyncio.sleep(300)
+"""
+
+
 def _free_ports(n):
     socks, ports = [], []
     for _ in range(n):
@@ -129,6 +186,76 @@ def test_three_process_cluster(tmp_path):
         ok = got_result.wait(timeout=150)
         assert ok, f"cluster never converged; node0 said: {lines!r}"
         assert "ENABLED" in lines and "MEMBERS_OK" in lines
+    finally:
+        for p in procs.values():
+            p.kill()
+        for p in procs.values():
+            p.wait(timeout=10)
+
+
+def test_process_kill_and_restart(tmp_path):
+    """Kill the leader's OS process mid-run: the survivors re-elect
+    and keep serving; the restarted process reloads its persisted
+    state (facts + cluster state) and rejoins the ensemble."""
+    ports = _free_ports(3)
+    peer_args = []
+    for i, p in enumerate(ports):
+        peer_args += ["--peer", f"node{i}=127.0.0.1:{p}"]
+
+    scripts = {}
+    for name, body in (("node0", NODE0_KILL_SCRIPT),
+                       ("node1", JOINER_SCRIPT),
+                       ("node2", JOINER_SCRIPT),
+                       ("node1r", IDLE_SCRIPT)):
+        path = tmp_path / f"{name}_script.py"
+        path.write_text(body)
+        scripts[name] = str(path)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def spawn(name, script):
+        return subprocess.Popen(
+            [sys.executable, "-m", "riak_ensemble_tpu.netnode",
+             "--node", name, *peer_args, "--fast",
+             "--data-root", str(tmp_path / name), "--script", script],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=REPO)
+
+    procs = {}
+    try:
+        procs["node0"] = spawn("node0", scripts["node0"])
+        procs["node1"] = spawn("node1", scripts["node1"])
+        procs["node2"] = spawn("node2", scripts["node2"])
+
+        lines = []
+        marks = {"WROTE_V1": threading.Event(),
+                 "SURVIVED_KILL": threading.Event(),
+                 "RESULT_OK": threading.Event()}
+
+        def reader():
+            for line in procs["node0"].stdout:
+                lines.append(line.strip())
+                for mark, ev in marks.items():
+                    if mark in line:
+                        ev.set()
+                if "RESULT_OK" in line:
+                    return
+
+        threading.Thread(target=reader, daemon=True).start()
+
+        assert marks["WROTE_V1"].wait(90), f"no first write: {lines!r}"
+        # kill the leader-hint node's process
+        procs["node1"].kill()
+        procs["node1"].wait(timeout=10)
+
+        assert marks["SURVIVED_KILL"].wait(90), \
+            f"no service after kill: {lines!r}"
+
+        # restart node1 from its persisted data root
+        procs["node1_restarted"] = spawn("node1", scripts["node1r"])
+        assert marks["RESULT_OK"].wait(120), \
+            f"restarted node never rejoined: {lines!r}"
     finally:
         for p in procs.values():
             p.kill()
