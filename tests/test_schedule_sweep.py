@@ -1,0 +1,40 @@
+"""Interleaving exploration: the PULSE/race-detection analog.
+
+The reference hooks Quviq PULSE to explore message interleavings
+(pulse_replace_module, peer.erl:56-57; SURVEY §5).  Our deterministic
+seeded runtime provides the same lever: every seed is a different —
+but reproducible — total order of message deliveries and timer
+firings, and widening the latency band widens the reordering window.
+This sweep runs the core failover scenario across many schedules; any
+failing seed is a reproducible race.
+"""
+
+import pytest
+
+from riak_ensemble_tpu.testing import Cluster, make_peers
+
+
+@pytest.mark.parametrize("seed", range(60, 76))
+def test_failover_under_schedule_fuzzing(seed):
+    c = Cluster(seed=seed)
+    # Widen the delivery window with the seed: up to 20x the default
+    # latency spread, letting commits/probes/votes interleave wildly.
+    c.runtime.net.max_latency = 5e-4 * (1 + (seed % 4) * 6)
+    peers = make_peers(3)
+    c.create_ensemble("ens", peers)
+    leader = c.wait_stable("ens")
+
+    c.kput_ok("ens", "k", b"v1")
+    c.suspend_peer("ens", leader)
+
+    def new_leader():
+        lid = c.leader_id("ens")
+        return lid is not None and lid != leader
+    assert c.runtime.run_until(new_leader, 60.0), f"seed {seed}"
+    c.wait_stable("ens")
+    assert c.kget_value("ens", "k") == b"v1"
+
+    c.resume_peer("ens", leader)
+    c.runtime.run_for(2.0)
+    c.kput_ok("ens", "k", b"v2")
+    assert c.kget_value("ens", "k") == b"v2"
