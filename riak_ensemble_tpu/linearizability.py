@@ -133,7 +133,8 @@ class Workload:
     def __init__(self, mc, ensemble: Any, n_workers: int = 3,
                  n_keys: int = 4, ops_per_worker: int = 60,
                  op_timeout: float = 8.0, seed: int = 0,
-                 nemesis_hold: Tuple[float, float] = (0.3, 1.5)) -> None:
+                 nemesis_hold: Tuple[float, float] = (0.3, 1.5),
+                 member_churn: bool = False) -> None:
         import random
 
         self.mc = mc
@@ -148,6 +149,7 @@ class Workload:
         self.op_timeout = op_timeout
         self.done = 0
         self.nemesis_hold = nemesis_hold
+        self.member_churn = member_churn
         self.op_counts: Dict[str, int] = {}
         self.violations: List[Violation] = []
 
@@ -221,15 +223,36 @@ class Workload:
 
     # -- nemesis -----------------------------------------------------------
 
+    def _member_churn(self, spare):
+        """One add→remove membership cycle through the real
+        update_members path, concurrent with the workload (the
+        replace_members-under-load scenario)."""
+        from riak_ensemble_tpu import router as routerlib
+
+        for changes in ((("add", spare),), (("del", spare),)):
+            r = yield routerlib.sync_send_event_fut(
+                self.runtime, spare.node, self.ensemble,
+                ("update_members", changes), 10.0)
+            # a failed/raced change is fine — the next cycle retries;
+            # what must hold is the workload's consistency
+            yield self.runtime.sleep(self.rng.uniform(0.5, 1.5))
+
     def _nemesis(self, duration: float, partitions: bool):
         members = list(self.mc.mgr(self.mc.node0).get_members(
             self.ensemble)) or []
         nodes = sorted({m.node for m in members})
+        spare_n = 0
         end = self.runtime.now + duration
         while self.runtime.now < end and self.done < self.n_workers:
             action = self.rng.random()
             lo, hi = self.nemesis_hold
-            if action < 0.5 and members:
+            if self.member_churn and action < 0.25 and nodes:
+                from riak_ensemble_tpu.types import PeerId
+
+                spare = PeerId(1000 + spare_n, self.rng.choice(nodes))
+                spare_n += 1
+                yield from self._member_churn(spare)
+            elif action < 0.5 and members:
                 # freeze a random peer (suspend_process analog)
                 victim = self.rng.choice(members)
                 self.mc.suspend_peer(self.ensemble, victim)

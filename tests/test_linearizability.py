@@ -96,6 +96,26 @@ def test_workload_single_node_freezes(seed):
 # -- multi-node ensemble under partitions (sc.erl partition_nodes) ----------
 
 
+@pytest.mark.parametrize("seed", [501, 502])
+def test_workload_with_membership_churn(seed):
+    """replace_members-under-load: concurrent add→remove membership
+    cycles through the real update_members path while workers run and
+    peers freeze/partition."""
+    mc = ManagedCluster(seed=seed, nodes=("node0", "node1", "node2"))
+    mc.enable("node0")
+    mc.join("node1", "node0")
+    mc.join("node2", "node0")
+    peers = [PeerId(i, f"node{i}") for i in range(3)]
+    mc.create_ensemble("sc", peers)
+    mc.wait_stable("sc")
+
+    w = Workload(mc, "sc", n_workers=3, n_keys=3, ops_per_worker=30,
+                 op_timeout=1.5, seed=seed, nemesis_hold=(0.3, 1.5),
+                 member_churn=True)
+    w.run(partitions=True)
+    assert sum(w.op_counts.values()) >= 90
+
+
 @pytest.mark.parametrize("seed", [201])
 def test_workload_multinode_partitions(seed):
     mc = ManagedCluster(seed=seed, nodes=("node0", "node1", "node2"))
