@@ -96,3 +96,26 @@ def test_kmodify_and_delete():
     r = c.ksafe_delete("ens", "d", cur)
     assert r[0] == "ok"
     assert c.kget("ens", "d")[1].value is NOTFOUND
+
+
+def test_multi_worker_pool():
+    """peer_workers > 1: distinct keys proceed via hash-partitioned
+    workers; same-key ops stay serialized (async/3 routing,
+    peer.erl:1220-1225)."""
+    from riak_ensemble_tpu.config import fast_test_config
+
+    cfg = fast_test_config()
+    cfg.peer_workers = 4
+    c = Cluster(seed=8, config=cfg)
+    c.create_ensemble("ens", make_peers(3))
+    c.wait_stable("ens")
+    for i in range(12):
+        c.kput_ok("ens", f"k{i}", f"v{i}".encode())
+    for i in range(12):
+        assert c.kget_value("ens", f"k{i}") == f"v{i}".encode()
+    # same-key CAS sequence stays correct
+    r = c.kput_once("ens", "cas", b"a")
+    assert r[0] == "ok"
+    cur = c.kget("ens", "cas")[1]
+    assert c.kupdate("ens", "cas", cur, b"b")[0] == "ok"
+    assert c.kupdate("ens", "cas", cur, b"c") == "failed"
