@@ -13,11 +13,12 @@ tree, adopting remote hashes that are missing locally or strictly newer
 ``exchange_complete`` / ``exchange_failed`` / tree_corrupted back to
 the peer FSM.
 
-Runs as a runtime Task; remote tree reads are ``('tree_exchange_get',
-level, bucket, fut)`` messages to the remote peer's tree actor (the
-reference fetches the remote tree pid first — ``tree_pid`` sync event,
-exchange.erl:71-72 — and we do the same so the M:N tree mapping keeps
-working).
+Runs as a runtime Task; remote tree reads are wire-safe level-batched
+xcalls (``tree_exchange_get_many`` — one round trip per level, the
+start_exchange_level streaming of synctree_remote.erl) to the remote
+peer's tree actor.  The remote tree name is fetched first via a
+``tree_pid`` sync call (exchange.erl:71-72) so the M:N shared-tree
+mapping keeps working.
 """
 
 from __future__ import annotations
