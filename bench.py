@@ -1,13 +1,27 @@
-"""Headline benchmark: linearizable K/V throughput on the batched engine.
+"""Headline benchmark: the END-TO-END service, plus the raw kernel.
 
-Scenario 3 of the BASELINE.md ladder: 10k ensembles x 5 peers driving
-mixed kput/kget through the quorum-replicated data path (one election,
-then steady-state leased operation).  The reference publishes no
-numbers (BASELINE.md); the driver north-star target is >= 1M
-linearizable ops/sec on TPU, which is the ``vs_baseline`` denominator.
+Scenario 3 of the BASELINE.md ladder: 10k ensembles x 5 peers of mixed
+kput/kget.  Two numbers, measured in this order (a d2h transfer
+permanently degrades dispatch on the tunneled chip, so the no-d2h
+kernel loop runs first):
+
+1. ``engine_kernel_rounds_per_sec`` — raw ``kv_step_scan`` launches,
+   device math only (ballots, quorum reduce, store, Merkle maintenance;
+   no host bridge).  An honest kernel number, not a service claim.
+2. ``service_linearizable_kv_ops_per_sec`` — the HEADLINE:
+   ``BatchedEnsembleService.execute`` end to end (election fold-in,
+   host lease check/renewal, device launch, result transfer, corruption
+   watch), with client-observed per-batch commit latency recorded —
+   p50/p99 reported against the BASELINE.md targets (>= 1M ops/s,
+   p99 < 5 ms).
+
+The reference publishes no numbers (BASELINE.md); the driver north-star
+target of 1M linearizable ops/sec is the ``vs_baseline`` denominator.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "ops/sec", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "ops/sec", "vs_baseline": N,
+   "p50_commit_latency_ms": ..., "p99_commit_latency_ms": ...,
+   "engine_kernel_rounds_per_sec": ...}
 
 ``--smoke`` shrinks shapes for a CPU sanity run.
 """
@@ -20,6 +34,56 @@ import sys
 import time
 
 import numpy as np
+
+
+def run_service(n_ens: int, n_peers: int, n_slots: int, k: int,
+                seconds: float) -> dict:
+    """End-to-end service throughput + client-observed commit latency.
+
+    Closed loop: each iteration submits a [K, E] batch of mixed
+    put/get through ``BatchedEnsembleService.execute`` and blocks on
+    the results (the resolve step every queued client future would
+    ride).  Per-batch wall time IS each op's commit latency: ops
+    enqueue at batch start and resolve when the batch returns.
+    """
+    from riak_ensemble_tpu.ops import engine as eng
+    from riak_ensemble_tpu.parallel.batched_host import (
+        BatchedEnsembleService, WallRuntime,
+    )
+
+    svc = BatchedEnsembleService(WallRuntime(), n_ens, n_peers, n_slots,
+                                 tick=None, max_ops_per_tick=k)
+    rng = np.random.default_rng(0)
+    kind = rng.choice([eng.OP_PUT, eng.OP_GET], (k, n_ens)).astype(np.int32)
+    slot = rng.integers(0, n_slots, (k, n_ens)).astype(np.int32)
+    val = rng.integers(1, 1 << 20, (k, n_ens)).astype(np.int32)
+
+    # Warm up: compile + first elections fold into the launch.
+    svc.execute(kind, slot, val)
+    svc.execute(kind, slot, val)
+
+    lat = []
+    ops = 0
+    t_end = time.perf_counter() + seconds
+    t_start = time.perf_counter()
+    while time.perf_counter() < t_end:
+        t0 = time.perf_counter()
+        committed, get_ok, found, value = svc.execute(kind, slot, val)
+        lat.append(time.perf_counter() - t0)
+        ops += k * n_ens
+    elapsed = time.perf_counter() - t_start
+
+    # Correctness on the final batch: every op acked.
+    ok = committed | get_ok
+    assert ok.all(), "service bench: ops failed"
+    assert (np.asarray(svc.state.leader) >= 0).all()
+    lat_ms = np.asarray(lat) * 1000.0
+    return {
+        "ops_per_sec": ops / elapsed,
+        "p50_ms": float(np.percentile(lat_ms, 50)),
+        "p99_ms": float(np.percentile(lat_ms, 99)),
+        "batches": len(lat),
+    }
 
 
 def run(n_ens: int, n_peers: int, n_slots: int, k: int,
@@ -190,18 +254,25 @@ def main() -> None:
         return
 
     if args.smoke:
-        ops_per_sec = run(n_ens=64, n_peers=5, n_slots=32, k=4,
-                          seconds=min(args.seconds, 1.0))
+        shapes = dict(n_ens=64, n_peers=5, n_slots=32, k=4)
+        secs = min(args.seconds, 1.0)
     else:
-        ops_per_sec = run(n_ens=10_000, n_peers=5, n_slots=128, k=64,
-                          seconds=args.seconds)
+        shapes = dict(n_ens=10_000, n_peers=5, n_slots=128, k=64)
+        secs = args.seconds
+    # Kernel first: it must run before any d2h (see module docstring).
+    kernel_rounds = run(seconds=secs, **shapes)
+    svc = run_service(seconds=secs, **shapes)
 
     baseline = 1_000_000.0  # north-star target (BASELINE.md)
     print(json.dumps({
-        "metric": "linearizable_kv_ops_per_sec_10k_ens_5_peers",
-        "value": round(ops_per_sec, 1),
+        "metric": "service_linearizable_kv_ops_per_sec_10k_ens_5_peers",
+        "value": round(svc["ops_per_sec"], 1),
         "unit": "ops/sec",
-        "vs_baseline": round(ops_per_sec / baseline, 3),
+        "vs_baseline": round(svc["ops_per_sec"] / baseline, 3),
+        "p50_commit_latency_ms": round(svc["p50_ms"], 3),
+        "p99_commit_latency_ms": round(svc["p99_ms"], 3),
+        "latency_batches": svc["batches"],
+        "engine_kernel_rounds_per_sec": round(kernel_rounds, 1),
     }))
 
 

@@ -16,13 +16,34 @@ transitions are jitted array kernels:
   the quorum epoch-check read (``check_epoch`` round, :1493-1516), the
   quorum replicated write (``put_obj``: local put + blocking_send_all
   {put,...} + wait_for_quorum, peer.erl:1669-1698), the quorum
-  latest-object read (``get_latest_obj``, :1623-1662) and the
-  stale-epoch rewrite (``update_key``, :1564-1596) — the
+  latest-object read (``get_latest_obj``, :1623-1662), the
+  stale-epoch rewrite (``update_key``, :1564-1596), async read repair
+  of lagging replicas (``maybe_repair``, :1518-1536) and the
+  notfound tombstone-avoidance dance (``all_or_quorum`` +
+  notfound_read_delay, msg.erl:282-317, peer.erl:1568-1584) — the
   "thundering herd" of first-touch rewrites after an election is
   batched across all ensembles in one kernel step (SURVEY §7).
 - :func:`kv_step_scan` — K sequential ops per ensemble per launch via
   ``lax.scan`` (amortizes dispatch; per-key serialization analog of the
   key-hashed worker pool, peer.erl:1220-1225).
+
+**Integrity is on the data path** (the synctree tree-is-truth design,
+``src/synctree.erl:44-73``): every replica carries a Merkle trie over
+its slot store — ``tree_leaf`` (per-slot object hashes) plus
+``tree_node`` (the upper levels, root last).  Every committed write
+updates the leaf AND recomputes its root-ward path in the same kernel
+(the always-up-to-date write-path property — ``put_obj`` →
+``update_hash``/``send_update_hash``, peer.erl:1669-1715); every read
+verifies the accessed slot's path root-ward (``get_path``/
+``verify_hash``, synctree.erl:302-340) and checks the object against
+its leaf (``valid_obj_hash``, peer.erl:1726), excluding failed
+replicas from the read quorum (the hash extra-check of
+``get_latest_obj``, :1646-1649) and surfacing them in
+``KvResult.tree_corrupt`` for the host.  Read repair then heals
+divergent or corrupted replicas in the same round.  Bulk kernels —
+:func:`verify_trees`, :func:`rebuild_trees`, :func:`exchange_step` —
+give the host the full repair/exchange surface
+(``riak_ensemble_exchange``, ``riak_ensemble_peer_tree:do_repair``).
 
 Peer-axis reductions go through :func:`quorum.reduce_peers` / :func:`_pmax`, which
 lower to ``jax.lax.psum``/``pmax`` over a mesh axis when ``axis_name``
@@ -48,6 +69,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from riak_ensemble_tpu.ops import hash as hashk
 from riak_ensemble_tpu.ops import quorum as quorum_lib
 from riak_ensemble_tpu.ops.quorum import (
     quorum_met_batch, reduce_peers, views_to_mask,
@@ -58,14 +80,23 @@ OP_NOOP = 0
 OP_GET = 1
 OP_PUT = 2
 
+#: Merkle trie fan-out (the reference's width-16 trie, synctree.erl:88).
+TREE_WIDTH = 16
+
 
 class EngineState(NamedTuple):
-    """Ballot + replicated-store state for E ensembles x M peers.
+    """Ballot + replicated-store + integrity state for E ensembles x M
+    peers.
 
     Leading axes: E (ensemble) shardable over mesh axis 'ens', M (peer)
     shardable over mesh axis 'peer'.  With sharded M, each shard holds
     its local peer slice; ``leader``/``obj_seq_ctr`` are replicated
     along 'peer'.
+
+    ``tree_leaf``/``tree_node`` are each replica's synctree: leaf k is
+    the hash of the replica's object at slot k; ``tree_node`` holds the
+    upper levels flattened leafward→root (sizes from
+    :func:`tree_sizes`).  Maintained synchronously by the K/V kernels.
     """
 
     epoch: jax.Array        # [E, M] int32  per-peer current epoch
@@ -76,20 +107,142 @@ class EngineState(NamedTuple):
     obj_epoch: jax.Array    # [E, M, S] int32  replica store: obj epochs
     obj_seq: jax.Array      # [E, M, S] int32  replica store: obj seqs
     obj_val: jax.Array      # [E, M, S] int32  replica store: payloads
+    tree_leaf: jax.Array    # [E, M, S, LANES] uint32  Merkle leaf hashes
+    tree_node: jax.Array    # [E, M, U, LANES] uint32  upper levels, flat
 
 
 class KvResult(NamedTuple):
-    committed: jax.Array   # [E] bool  put (or rewrite) reached quorum
-    get_ok: jax.Array      # [E] bool  read served (lease or epoch quorum)
-    found: jax.Array       # [E] bool  read found an object
-    value: jax.Array       # [E] int32 read payload (0 if not found)
-    obj_vsn: jax.Array     # [E, 2] int32 (epoch, seq) of the read/put obj
+    committed: jax.Array    # [E] bool  put/rewrite/tombstone reached quorum
+    get_ok: jax.Array       # [E] bool  read served (lease or epoch quorum)
+    found: jax.Array        # [E] bool  read found an object
+    value: jax.Array        # [E] int32 read payload (0 if not found)
+    obj_vsn: jax.Array      # [E, 2] int32 (epoch, seq) of the read/put obj
+    quorum_ok: jax.Array    # [E] bool  leader up + epoch quorum this round
+    tree_corrupt: jax.Array  # [E, M] bool replica failed the integrity gate
+
+
+# ---------------------------------------------------------------------------
+# Merkle trie layout + path kernels (the synctree on the data path)
+
+
+@functools.lru_cache(maxsize=None)
+def tree_sizes(n_slots: int) -> Tuple[int, ...]:
+    """Upper-level sizes leafward→root for an ``n_slots``-leaf trie
+    (width 16; short levels padded with zero hashes)."""
+    sizes = []
+    n = n_slots
+    while n > 1:
+        n = -(-n // TREE_WIDTH)
+        sizes.append(n)
+    if not sizes:
+        sizes = [1]
+    return tuple(sizes)
+
+
+@functools.lru_cache(maxsize=None)
+def _tree_offsets(n_slots: int) -> Tuple[Tuple[int, ...], int]:
+    sizes = tree_sizes(n_slots)
+    offs, total = [], 0
+    for n in sizes:
+        offs.append(total)
+        total += n
+    return tuple(offs), total
+
+
+def _fold_blocks(x: jax.Array) -> jax.Array:
+    """Fold ``[..., n, LANES]`` into ``[..., ceil(n/16), LANES]`` parent
+    hashes, zero-padding the last (short) block."""
+    n = x.shape[-2]
+    nb = -(-n // TREE_WIDTH)
+    pad = nb * TREE_WIDTH - n
+    if pad:
+        zeros = jnp.zeros(x.shape[:-2] + (pad, hashk.LANES), jnp.uint32)
+        x = jnp.concatenate([x, zeros], axis=-2)
+    return hashk.fold(x.reshape(x.shape[:-2] + (nb, TREE_WIDTH,
+                                                hashk.LANES)))
+
+
+def build_uppers(leaves: jax.Array) -> jax.Array:
+    """Bottom-up rebuild of the upper levels from ``[..., S, LANES]``
+    leaves → flat ``[..., U, LANES]`` (the ``rehash`` role,
+    synctree.erl:489-535, one fused pass)."""
+    outs = []
+    cur = leaves
+    for _ in tree_sizes(leaves.shape[-2]):
+        cur = _fold_blocks(cur)
+        outs.append(cur)
+    return jnp.concatenate(outs, axis=-2) if len(outs) > 1 else outs[0]
+
+
+def _gather_children(arr: jax.Array, parent_idx: jax.Array,
+                     n: int) -> jax.Array:
+    """Gather the 16 children of ``parent_idx [E]`` from a per-replica
+    level array ``arr [E, Ml, n, LANES]`` → ``[E, Ml, 16, LANES]``
+    (zero-padded beyond ``n``, matching :func:`_fold_blocks`)."""
+    idx = (parent_idx[:, None] * TREE_WIDTH
+           + jnp.arange(TREE_WIDTH, dtype=jnp.int32)[None, :])   # [E, 16]
+    valid = idx < n
+    idxc = jnp.clip(idx, 0, n - 1)
+    g = jnp.take_along_axis(arr, idxc[:, None, :, None], axis=2)
+    return jnp.where(valid[:, None, :, None], g, jnp.uint32(0))
+
+
+def _verify_path(tree_leaf: jax.Array, tree_node: jax.Array,
+                 slot: jax.Array) -> jax.Array:
+    """Root-ward path verification for one slot per ensemble: recompute
+    each stored parent on the path from its stored children and compare
+    (``get_path``/``verify_hash``, synctree.erl:302-340).  Returns
+    ``[E, Ml]`` bool — replica's tree corrupted on this path."""
+    s = tree_leaf.shape[-2]
+    offs, _ = _tree_offsets(s)
+    sizes = tree_sizes(s)
+    bad = jnp.zeros(tree_leaf.shape[:2], bool)
+    child_arr, child_n, idx = tree_leaf, s, slot
+    for off, n in zip(offs, sizes):
+        pidx = idx // TREE_WIDTH
+        expect = hashk.fold(_gather_children(child_arr, pidx, child_n))
+        level = jax.lax.slice_in_dim(tree_node, off, off + n, axis=2)
+        stored = jnp.take_along_axis(
+            level, pidx[:, None, None, None], axis=2)[..., 0, :]
+        bad = bad | (expect != stored).any(-1)
+        child_arr, child_n, idx = level, n, pidx
+    return bad
+
+
+def _write_path(tree_leaf: jax.Array, tree_node: jax.Array,
+                slot: jax.Array, new_leaf: jax.Array,
+                mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Set slot's leaf to ``new_leaf [E, LANES]`` on replicas in
+    ``mask [E, Ml]`` and recompute their root-ward path — the
+    synchronous write-path hash update (``update_hash`` +
+    ``update_path``, peer.erl:1731-1738, synctree.erl:201-209).
+    Non-writing replicas' nodes are untouched (a recompute would
+    silently alter a corrupted-but-unwritten tree)."""
+    s = tree_leaf.shape[-2]
+    offs, _ = _tree_offsets(s)
+    sizes = tree_sizes(s)
+    sel = (jnp.arange(s, dtype=jnp.int32)[None, :] == slot[:, None])
+    upd = mask[:, :, None, None] & sel[:, None, :, None]
+    tree_leaf = jnp.where(upd, new_leaf[:, None, None, :], tree_leaf)
+    child_arr, child_n, idx = tree_leaf, s, slot
+    node = tree_node
+    for off, n in zip(offs, sizes):
+        pidx = idx // TREE_WIDTH
+        parent = hashk.fold(_gather_children(child_arr, pidx, child_n))
+        psel = (jnp.arange(n, dtype=jnp.int32)[None, :] == pidx[:, None])
+        pupd = mask[:, :, None, None] & psel[:, None, :, None]
+        level = jax.lax.slice_in_dim(node, off, off + n, axis=2)
+        level = jnp.where(pupd, parent[:, :, None, :], level)
+        node = jax.lax.dynamic_update_slice_in_dim(node, level, off, axis=2)
+        child_arr, child_n, idx = level, n, pidx
+    return tree_leaf, node
 
 
 def init_state(n_ensembles: int, n_peers: int, n_slots: int,
                n_views: int = 2,
                views: Optional[Sequence[Sequence[int]]] = None) -> EngineState:
-    """Fresh state: no leader, epoch 0, empty stores.
+    """Fresh state: no leader, epoch 0, empty stores, trees built over
+    the empty stores (every leaf = hash of the absent object).
 
     ``views`` is a list of views (each a list of global peer indices)
     applied to every ensemble; default one view of all peers.
@@ -101,6 +254,10 @@ def init_state(n_ensembles: int, n_peers: int, n_slots: int,
     else:
         assert len(views) <= v
         vm = views_to_mask(views, v, m)
+    zero = jnp.zeros((), jnp.int32)
+    empty_leaf = hashk.obj_leaf_hash(zero, zero, zero)           # [LANES]
+    leaves = jnp.broadcast_to(empty_leaf, (s, hashk.LANES))
+    uppers = build_uppers(leaves)                                # [U, LANES]
     return EngineState(
         epoch=jnp.zeros((e, m), jnp.int32),
         fact_seq=jnp.zeros((e, m), jnp.int32),
@@ -110,6 +267,9 @@ def init_state(n_ensembles: int, n_peers: int, n_slots: int,
         obj_epoch=jnp.zeros((e, m, s), jnp.int32),
         obj_seq=jnp.zeros((e, m, s), jnp.int32),
         obj_val=jnp.zeros((e, m, s), jnp.int32),
+        tree_leaf=jnp.broadcast_to(leaves, (e, m, s, hashk.LANES)),
+        tree_node=jnp.broadcast_to(uppers,
+                                   (e, m) + uppers.shape),
     )
 
 
@@ -149,21 +309,19 @@ def _quorum_met(ack: jax.Array, heard: jax.Array, view_mask: jax.Array,
     return res == quorum_lib.MET
 
 
-def _latest_at_slot(state: EngineState, slot_oh: jax.Array,
-                    heard: jax.Array, axis_name: Optional[str]
-                    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+def _latest_among(pe: jax.Array, ps: jax.Array, pv: jax.Array,
+                  ok: jax.Array, axis_name: Optional[str]
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Batched ``get_latest_obj`` (peer.erl:1623-1662): the newest
-    (epoch, seq) object at a slot among the heard member replicas, via
-    a three-stage masked max-reduce over the peer axis.
+    (epoch, seq) object among the replicas in ``ok`` (already filtered
+    for reachability AND hash validity — the extra-check of
+    :1646-1649), via a three-stage masked max-reduce over the peer
+    axis.  pe/ps/pv/ok are ``[E, Ml]``.
 
     Returns (epoch [E], seq [E], val [E], found [E]).
     """
-    sel = slot_oh[:, None, :]                                # [E, 1, S]
-    pe = (state.obj_epoch * sel).sum(-1)                     # [E, Ml]
-    ps = (state.obj_seq * sel).sum(-1)
-    pv = (state.obj_val * sel).sum(-1)
     exists = ps > 0                                          # seq>=1 once written
-    h = heard & exists
+    h = ok & exists
     neg = jnp.int32(-1)
     emax = _pmax(jnp.where(h, pe, neg), axis_name)           # [E]
     smax = _pmax(jnp.where(h & (pe == emax[:, None]), ps, neg), axis_name)
@@ -235,7 +393,7 @@ class _KvCtx(NamedTuple):
 
     Everything here depends only on ballot state (epoch/leader/views)
     and the ``up`` mask — none of which a K/V round mutates — so a
-    scan of K rounds computes it (and its ~4 peer-axis collectives)
+    scan of K rounds computes it (and its ~5 peer-axis collectives)
     exactly once (kv_step_scan).
     """
 
@@ -243,6 +401,7 @@ class _KvCtx(NamedTuple):
     leader_up: jax.Array    # [E] the leader itself is up (it serves ops)
     lead_epoch: jax.Array   # [E] proposal epoch (leader's epoch)
     epoch_ok: jax.Array     # [E] epoch-check round reached quorum
+    n_member: jax.Array     # [E] global member count (for all_or_quorum)
 
 
 def _kv_context(state: EngineState, up: jax.Array,
@@ -267,8 +426,10 @@ def _kv_context(state: EngineState, up: jax.Array,
     ack = heard & (state.epoch == lead_epoch[:, None])
     epoch_ok = (_quorum_met(ack, heard, state.view_mask, axis_name)
                 & has_leader & leader_up)
+    n_member = reduce_peers(member.astype(jnp.int32), axis_name)
     return _KvCtx(heard=heard, leader_up=leader_up & has_leader,
-                  lead_epoch=lead_epoch, epoch_ok=epoch_ok)
+                  lead_epoch=lead_epoch, epoch_ok=epoch_ok,
+                  n_member=n_member)
 
 
 def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
@@ -281,43 +442,112 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
 
     is_put = kind == OP_PUT
     is_get = kind == OP_GET
+    active = is_put | is_get
     slot_valid = (slot >= 0) & (slot < s)
+    slot_c = jnp.clip(slot, 0, s - 1)
 
-    # Read: newest object among heard replicas.
-    slot_oh = (jnp.arange(s, dtype=jnp.int32)[None, :]
-               == slot[:, None]).astype(jnp.int32)
-    rd_epoch, rd_seq, rd_val, found = _latest_at_slot(
-        state, slot_oh, heard, axis_name)
+    # Per-replica object at the slot (one-hot row is zero for invalid
+    # slots, reading the absent object).
+    slot_oh = ((jnp.arange(s, dtype=jnp.int32)[None, :] == slot_c[:, None])
+               & slot_valid[:, None]).astype(jnp.int32)
+    sel = slot_oh[:, None, :]                                # [E, 1, S]
+    pe = (state.obj_epoch * sel).sum(-1)                     # [E, Ml]
+    ps = (state.obj_seq * sel).sum(-1)
+    pv = (state.obj_val * sel).sum(-1)
+
+    # Integrity gate (tree-is-truth, synctree.erl:44-73): the object
+    # must match its leaf, and the slot's root-ward path must verify.
+    leaf = jnp.take_along_axis(
+        state.tree_leaf, slot_c[:, None, None, None], axis=2)[..., 0, :]
+    leaf_ok = (leaf == hashk.obj_leaf_hash(pe, ps, pv)).all(-1)  # [E, Ml]
+    path_bad = _verify_path(state.tree_leaf, state.tree_node, slot_c)
+    replica_ok = heard & leaf_ok & ~path_bad
+    tree_corrupt = ((path_bad | ~leaf_ok) & heard
+                    & (active & slot_valid)[:, None])
+
+    # Read: newest object among valid replicas (hash extra-check).
+    # ``obj_found`` is "some object exists" — possibly a tombstone
+    # (val == 0, the device notfound-object); ``found`` is the
+    # client-visible hit.  Tombstones carry full version discipline
+    # (they win/lose by (epoch, seq) and replicate like any object)
+    # but read back as notfound, exactly like the reference's notfound
+    # obj (peer.erl:1568-1584).
+    rd_epoch, rd_seq, rd_val, obj_found = _latest_among(
+        pe, ps, pv, replica_ok, axis_name)
+    found = obj_found & (rd_val != 0)
+    n_ok = reduce_peers(replica_ok.astype(jnp.int32), axis_name)
+    all_ok = n_ok == ctx.n_member                            # [E]
 
     get_gate = is_get & leader_up & (lease_ok | epoch_ok)
+    stale = obj_found & (rd_epoch != lead_epoch)
     # Stale-epoch rewrite (update_key): needs the quorum either way.
-    rewrite = get_gate & found & (rd_epoch != lead_epoch) & epoch_ok
-    get_ok = get_gate & (~(found & (rd_epoch != lead_epoch)) | rewrite)
+    # A stale tombstone is rewritten at the current epoch too.
+    rewrite = get_gate & stale & epoch_ok
+    # Notfound with NO object anywhere: when every member replica
+    # answered (valid) notfound, serve it without writing
+    # (all_or_quorum full-response fast path, peer.erl:1568-1584);
+    # otherwise a notfound tombstone must commit at the current epoch
+    # so a stale straggler write cannot later win (update_key with
+    # notfound, :1564-1596).  The tombstone additionally needs a
+    # QUORUM of hash-valid notfound answers (non-valid heard replicas
+    # count as nacks) — the reference's update_key read round fails on
+    # the hash extra-check rather than erasing data the integrity gate
+    # excluded; without this, corrupting the leaves of every holder
+    # would let a single GET tombstone over a committed object.
+    # Out-of-range slots never held data: plain notfound.
+    nf = get_gate & ~obj_found
+    nf_quorum = _quorum_met(replica_ok, heard, state.view_mask, axis_name)
+    nf_write = nf & slot_valid & ~all_ok & epoch_ok & nf_quorum
+    get_ok = ((get_gate & obj_found & (~stale | rewrite))
+              | (nf & (all_ok | ~slot_valid | nf_write)))
 
-    # Write path (shared by put and rewrite).
+    # Commit path (shared by put, rewrite and notfound tombstone).
     new_seq = state.obj_seq_ctr + 1                          # [E]
     put_commit = is_put & epoch_ok & slot_valid
-    commit = put_commit | rewrite
-    wval = jnp.where(is_put, val, rd_val)                    # [E]
-    do_write = commit[:, None] & heard                       # [E, Ml]
+    commit = put_commit | rewrite | nf_write
+    wval = jnp.where(is_put, val, jnp.where(rewrite, rd_val, 0))
+
+    # Read repair (maybe_repair, peer.erl:1518-1536): a successful
+    # current-epoch read heals reachable replicas that lag the winning
+    # version or failed the integrity gate (re-writing the slot also
+    # recomputes their hash path, healing tree corruption).
+    plain_read = get_ok & obj_found & ~rewrite
+    divergent = heard & ((pe != rd_epoch[:, None]) | (ps != rd_seq[:, None])
+                         | ~leaf_ok | path_bad)
+    repair = plain_read[:, None] & divergent                 # [E, Ml]
+
+    w_epoch = jnp.where(commit, lead_epoch, rd_epoch)        # [E]
+    w_seq = jnp.where(commit, new_seq, rd_seq)
+    w_val = jnp.where(commit, wval, rd_val)
+    do_write = (commit[:, None] & heard) | repair            # [E, Ml]
+
     wmask = (do_write[:, :, None] & (slot_oh[:, None, :] > 0))
-    obj_epoch = jnp.where(wmask, lead_epoch[:, None, None], state.obj_epoch)
-    obj_seq = jnp.where(wmask, new_seq[:, None, None], state.obj_seq)
-    obj_val = jnp.where(wmask, wval[:, None, None], state.obj_val)
+    obj_epoch = jnp.where(wmask, w_epoch[:, None, None], state.obj_epoch)
+    obj_seq = jnp.where(wmask, w_seq[:, None, None], state.obj_seq)
+    obj_val = jnp.where(wmask, w_val[:, None, None], state.obj_val)
     obj_seq_ctr = jnp.where(commit, new_seq, state.obj_seq_ctr)
 
+    # Synchronous tree maintenance: leaf + root-ward path, same round.
+    new_leaf = hashk.obj_leaf_hash(w_epoch, w_seq, w_val)    # [E, LANES]
+    tree_leaf, tree_node = _write_path(
+        state.tree_leaf, state.tree_node, slot_c, new_leaf, do_write)
+
     out_epoch = jnp.where(commit, lead_epoch,
-                          jnp.where(get_ok, rd_epoch, 0))
-    out_seq = jnp.where(commit, new_seq, jnp.where(get_ok, rd_seq, 0))
+                          jnp.where(get_ok & found, rd_epoch, 0))
+    out_seq = jnp.where(commit, new_seq,
+                        jnp.where(get_ok & found, rd_seq, 0))
     res = KvResult(
         committed=commit,
         get_ok=get_ok,
         found=found & get_ok,
         value=jnp.where(get_ok & found, rd_val, 0),
         obj_vsn=jnp.stack([out_epoch, out_seq], -1),
+        quorum_ok=epoch_ok,
+        tree_corrupt=tree_corrupt,
     )
     new_state = state._replace(obj_epoch=obj_epoch, obj_seq=obj_seq,
-                               obj_val=obj_val, obj_seq_ctr=obj_seq_ctr)
+                               obj_val=obj_val, obj_seq_ctr=obj_seq_ctr,
+                               tree_leaf=tree_leaf, tree_node=tree_node)
     return new_state, res
 
 
@@ -337,13 +567,19 @@ def kv_step(state: EngineState, kind: jax.Array, slot: jax.Array,
       replicas whose epoch matches ack (valid_request, peer.erl
       :869-871 — stale-epoch followers nack); on majority in every
       view, all heard member replicas apply the write (put_obj,
-      :1669-1698) and the counter advances (obj_sequence, :1776-1791).
+      :1669-1698), their tree leaf + hash path update in the same
+      round (update_hash/send_update_hash, :1700-1715), and the
+      counter advances (obj_sequence, :1776-1791).
     - GET: if lease_ok, leased local read; else the quorum epoch-check
-      round gates it (:1460-1468).  The value returned is the newest
-      version among heard replicas (get_latest_obj, :1623-1662); if
-      that version's epoch is stale, it is rewritten at the current
-      epoch through the same quorum machinery (update_key,
-      :1564-1596) — batched across ensembles.
+      round gates it (:1460-1468).  Replicas failing the integrity
+      gate (leaf/path hash mismatch) are excluded; the value returned
+      is the newest version among the remaining replicas
+      (get_latest_obj + hash extra-check, :1623-1662); a stale-epoch
+      winner is rewritten at the current epoch through the quorum
+      machinery (update_key, :1564-1596); a current-epoch read heals
+      lagging/corrupt replicas (maybe_repair, :1518-1536); a notfound
+      with unreachable members commits a tombstone (all_or_quorum,
+      :1568-1584) — all batched across ensembles.
     """
     ctx = _kv_context(state, up, axis_name)
     return _kv_round(state, ctx, kind, slot, val, lease_ok, axis_name)
@@ -373,6 +609,132 @@ def kv_step_scan(state: EngineState, kind: jax.Array, slot: jax.Array,
         return st2, r
 
     return jax.lax.scan(body, state, (kind, slot, val, lease_ok))
+
+
+# ---------------------------------------------------------------------------
+# Integrity maintenance kernels (exchange / repair, §2.3)
+
+
+@functools.partial(jax.jit, static_argnames=("axis_name",))
+def verify_trees(state: EngineState, axis_name: Optional[str] = None
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Full integrity sweep per replica (the BFS ``verify``,
+    synctree.erl:549-571, one fused pass): recompute every upper level
+    from the stored leaves and every leaf from the stored object.
+
+    Returns ``(node_bad [E, Ml], leaf_bad [E, Ml])`` — upper-tree
+    corruption vs object/leaf divergence.
+    """
+    del axis_name  # per-replica local; no collectives needed
+    expect_upper = build_uppers(state.tree_leaf)
+    node_bad = (expect_upper != state.tree_node).any(-1).any(-1)
+    expect_leaf = hashk.obj_leaf_hash(state.obj_epoch, state.obj_seq,
+                                      state.obj_val)
+    leaf_bad = (expect_leaf != state.tree_leaf).any(-1).any(-1)
+    return node_bad, leaf_bad
+
+
+@jax.jit
+def rebuild_trees(state: EngineState, mask: jax.Array) -> EngineState:
+    """Rebuild replicas' trees from their object stores (the repair =
+    segment delete + full rehash, riak_ensemble_peer_tree.erl:264-277).
+    ``mask [E, Ml]`` selects replicas; others untouched."""
+    leaves = hashk.obj_leaf_hash(state.obj_epoch, state.obj_seq,
+                                 state.obj_val)
+    tree_leaf = jnp.where(mask[:, :, None, None], leaves, state.tree_leaf)
+    tree_node = jnp.where(mask[:, :, None, None], build_uppers(tree_leaf),
+                          state.tree_node)
+    return state._replace(tree_leaf=tree_leaf, tree_node=tree_node)
+
+
+def _pmax2(x: jax.Array, axis_name: Optional[str]) -> jax.Array:
+    """Max over the peer axis (axis 1) of [E, Ml, S] → [E, S]."""
+    m = x.max(1)
+    if axis_name is not None:
+        m = jax.lax.pmax(m, axis_name)
+    return m
+
+
+@functools.partial(jax.jit, static_argnames=("axis_name",))
+def exchange_step(state: EngineState, run: jax.Array, up: jax.Array,
+                  axis_name: Optional[str] = None
+                  ) -> Tuple[EngineState, jax.Array, jax.Array]:
+    """Whole-store anti-entropy in one kernel — the tree exchange
+    (riak_ensemble_exchange.erl:67-98) redesigned for the batch axis.
+
+    The reference walks differing tree buckets level by level and
+    adopts remote-newer objects per key.  On device the whole slot
+    axis is one masked max-reduce: for every slot, the newest
+    hash-valid object among reachable replicas wins
+    (``valid_obj_hash(B, A)`` gate, exchange.erl:91-96), every
+    reachable replica adopts it, and adopting replicas rebuild their
+    trees.  Gated per ensemble on ``run`` AND a reachable majority
+    (trust_majority, exchange.erl:109-126).
+
+    Returns ``(state', diverged [E, Ml], synced [E])`` — which replicas
+    held divergent/invalid data, and which ensembles completed.
+    """
+    member = state.view_mask.any(1)
+    heard = up & member
+    met = _quorum_met(heard, heard, state.view_mask, axis_name)
+    adopt = run & met                                        # [E]
+
+    # Source validity is the OBJECT hash (the leaf — valid_obj_hash
+    # compares obj hashes, exchange.erl:91-96).  A replica whose upper
+    # tree is corrupt still has trustworthy objects (its leaves vouch
+    # for them); its tree gets rebuilt below, matching the reference's
+    # repair-by-rehash-from-data (peer_tree.erl:264-277) rather than
+    # data discard.
+    expect_leaf = hashk.obj_leaf_hash(state.obj_epoch, state.obj_seq,
+                                      state.obj_val)
+    leaf_ok = (expect_leaf == state.tree_leaf).all(-1)       # [E, Ml, S]
+    node_ok = (build_uppers(state.tree_leaf)
+               == state.tree_node).all(-1).all(-1)           # [E, Ml]
+    h = heard[:, :, None] & leaf_ok & (state.obj_seq > 0)
+
+    neg = jnp.int32(-1)
+    emax = _pmax2(jnp.where(h, state.obj_epoch, neg), axis_name)  # [E, S]
+    smax = _pmax2(jnp.where(h & (state.obj_epoch == emax[:, None, :]),
+                            state.obj_seq, neg), axis_name)
+    on_max = (h & (state.obj_epoch == emax[:, None, :])
+              & (state.obj_seq == smax[:, None, :]))
+    vmax = _pmax2(jnp.where(on_max, state.obj_val,
+                            jnp.iinfo(jnp.int32).min), axis_name)
+    found = smax > 0                                         # [E, S]
+    w_epoch = jnp.where(found, emax, 0)
+    w_seq = jnp.where(found, smax, 0)
+    w_val = jnp.where(found, vmax, 0)
+
+    # Adopt ONLY where a hash-valid winner exists: a slot with no
+    # valid holder (e.g. every copy's leaf is damaged) is left for
+    # host-driven repair — exchange must never erase data it cannot
+    # replace.
+    tgt = (adopt[:, None, None] & heard[:, :, None]
+           & found[:, None, :])                              # [E, Ml, S]
+    mismatch = ((state.obj_epoch != w_epoch[:, None, :])
+                | (state.obj_seq != w_seq[:, None, :])
+                | (state.obj_val != w_val[:, None, :]))
+    diverged = ((mismatch | ~leaf_ok)
+                & adopt[:, None, None] & heard[:, :, None]).any(-1) | \
+        (~node_ok & adopt[:, None] & heard)
+    obj_epoch = jnp.where(tgt, w_epoch[:, None, :], state.obj_epoch)
+    obj_seq = jnp.where(tgt, w_seq[:, None, :], state.obj_seq)
+    obj_val = jnp.where(tgt, w_val[:, None, :], state.obj_val)
+
+    # Refresh leaves for adopted slots only: a damaged leaf at a
+    # no-winner slot must stay mismatched (rehashing it would bless
+    # the corrupt object as valid).  Upper levels rebuild from the
+    # resulting leaves, healing tree corruption (repair-by-rehash).
+    leaves = hashk.obj_leaf_hash(obj_epoch, obj_seq, obj_val)
+    rebuild = adopt[:, None] & heard                         # [E, Ml]
+    fix_leaf = tgt | (leaf_ok & rebuild[:, :, None])
+    tree_leaf = jnp.where(fix_leaf[..., None], leaves, state.tree_leaf)
+    tree_node = jnp.where(rebuild[:, :, None, None],
+                          build_uppers(tree_leaf), state.tree_node)
+    new_state = state._replace(obj_epoch=obj_epoch, obj_seq=obj_seq,
+                               obj_val=obj_val, tree_leaf=tree_leaf,
+                               tree_node=tree_node)
+    return new_state, diverged, adopt
 
 
 # ---------------------------------------------------------------------------

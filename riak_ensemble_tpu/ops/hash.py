@@ -91,6 +91,25 @@ def leaf_hash(epoch: jnp.ndarray, seq: jnp.ndarray) -> jnp.ndarray:
     return _fmix(base * _C1 + jnp.arange(LANES, dtype=jnp.uint32))
 
 
+def obj_leaf_hash(epoch: jnp.ndarray, seq: jnp.ndarray,
+                  val: jnp.ndarray) -> jnp.ndarray:
+    """Object leaf hash covering version AND payload handle.
+
+    The reference's obj hash is version-only (``<<0, Epoch:64, Seq:64>>``,
+    peer.erl:1717-1724; payload corruption is the backend CRC's job).
+    The device store holds the payload handle right next to the version,
+    so covering it is free and strictly stronger: a replica whose
+    ``obj_val`` lane was damaged out-of-band fails the tree check too.
+    Shapes broadcast; returns ``[..., LANES]`` uint32.
+    """
+    e = jnp.asarray(epoch, jnp.uint32)
+    s = jnp.asarray(seq, jnp.uint32)
+    v = jnp.asarray(val, jnp.uint32)
+    base = jnp.stack([e ^ _rotl(v, 5), s ^ _rotl(v, 9),
+                      e ^ _rotl(s, 7), s ^ _rotl(e, 11)], axis=-1)
+    return _fmix(base * _C1 + jnp.arange(LANES, dtype=jnp.uint32))
+
+
 Levels = Tuple[jnp.ndarray, ...]
 
 

@@ -25,10 +25,14 @@ flush, amortized over every op in the batch).
 
 from __future__ import annotations
 
+import functools
 import itertools
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from riak_ensemble_tpu.config import Config
@@ -37,6 +41,60 @@ from riak_ensemble_tpu.runtime import Future, Runtime, Timer
 from riak_ensemble_tpu.types import NOTFOUND
 
 _handles = itertools.count(1)
+
+
+@functools.partial(jax.jit, static_argnames=("want_vsn",))
+def _pack_results(won, res: eng.KvResult, want_vsn: bool):
+    """Flatten a launch's results into ONE int32 vector on device.
+
+    The host needs ~7 result arrays per launch; fetching them
+    separately costs a device round trip each — ruinous over a
+    tunneled/remote device link.  One fused pack + one transfer
+    instead.  Layout: [won E | quorum_ok E | corrupt E*M |
+    committed K*E | get_ok K*E | found K*E | value K*E |
+    (vsn_epoch K*E | vsn_seq K*E)].
+    """
+    parts = [
+        won.astype(jnp.int32),
+        res.quorum_ok.any(0).astype(jnp.int32),
+        res.tree_corrupt.any(0).astype(jnp.int32).ravel(),
+        res.committed.astype(jnp.int32).ravel(),
+        res.get_ok.astype(jnp.int32).ravel(),
+        res.found.astype(jnp.int32).ravel(),
+        res.value.ravel(),
+    ]
+    if want_vsn:
+        parts += [res.obj_vsn[..., 0].ravel(), res.obj_vsn[..., 1].ravel()]
+    return jnp.concatenate(parts)
+
+
+class _LocalEngine:
+    """Default engine adapter: the module kernels, single-process jit
+    (data-parallel over whatever devices XLA picks).  A
+    :class:`~riak_ensemble_tpu.parallel.mesh.ShardedEngine` instance
+    slots in here to run the same service over a ('ens', 'peer') mesh.
+    """
+
+    init_state = staticmethod(eng.init_state)
+    full_step = staticmethod(eng.full_step)
+    rebuild_trees = staticmethod(eng.rebuild_trees)
+    exchange_step = staticmethod(eng.exchange_step)
+
+
+class WallRuntime:
+    """Minimal real-time runtime for driving the service outside the
+    simulator (bench / production): ``now`` is the monotonic clock.
+    It has no event loop, so it only supports caller-driven services —
+    construct the service with ``tick=None`` and call ``flush()``."""
+
+    @property
+    def now(self) -> float:
+        return time.monotonic()
+
+    def schedule(self, delay: float, fn) -> Timer:
+        raise RuntimeError(
+            "WallRuntime has no event loop; use tick=None and drive "
+            "flush() from the caller")
 
 
 @dataclass
@@ -52,13 +110,15 @@ class BatchedEnsembleService:
 
     ``n_slots`` bounds live keys per ensemble (slots are recycled when
     keys are deleted).  ``tick`` is the flush cadence: lower = lower
-    latency, higher = bigger batches.
+    latency, higher = bigger batches; ``tick=None`` disables the timer
+    entirely — the caller drives ``flush()`` (bench / WallRuntime mode).
     """
 
     def __init__(self, runtime: Runtime, n_ens: int, n_peers: int,
-                 n_slots: int = 128, tick: float = 0.005,
+                 n_slots: int = 128, tick: Optional[float] = 0.005,
                  max_ops_per_tick: int = 64,
-                 config: Optional[Config] = None) -> None:
+                 config: Optional[Config] = None,
+                 engine: Optional[Any] = None) -> None:
         import jax.numpy as jnp
 
         self.runtime = runtime
@@ -66,9 +126,15 @@ class BatchedEnsembleService:
         self.n_ens, self.n_peers, self.n_slots = n_ens, n_peers, n_slots
         self.tick = tick
         self.max_k = max_ops_per_tick
-        self.state = eng.init_state(n_ens, n_peers, n_slots)
+        self.engine = engine if engine is not None else _LocalEngine()
+        self.state = self.engine.init_state(n_ens, n_peers, n_slots)
         #: host failure detector input (set_peer_up)
         self.up = np.ones((n_ens, n_peers), dtype=bool)
+        #: host mirrors of device ballot state (leader changes only via
+        #: elections THIS host requested, membership only via reconfigs
+        #: it issued) — election planning costs zero device round trips
+        self.leader_np = np.full((n_ens,), -1, dtype=np.int32)
+        self.member_np = np.ones((n_ens, n_peers), dtype=bool)
         #: per-ensemble key→slot and free slots
         self.key_slot: List[Dict[Any, int]] = [dict() for _ in range(n_ens)]
         self.free_slots: List[List[int]] = [
@@ -80,6 +146,12 @@ class BatchedEnsembleService:
         self.lease_until = np.zeros((n_ens,), dtype=float)
         self.flushes = 0
         self.ops_served = 0
+        #: integrity-gate detections (replica flagged corrupt in a round)
+        self.corruptions = 0
+        #: replicas the post-detection exchange actually healed (in-round
+        #: read repair usually heals the accessed slot first, so this
+        #: counts only residual divergence the sweep fixed)
+        self.repairs = 0
         self._timer: Optional[Timer] = None
         self._jnp = jnp
         self._schedule()
@@ -150,6 +222,8 @@ class BatchedEnsembleService:
         return slot
 
     def _schedule(self) -> None:
+        if self.tick is None:
+            return
         self._timer = self.runtime.schedule(self.tick, self._on_tick)
 
     def _on_tick(self) -> None:
@@ -161,32 +235,133 @@ class BatchedEnsembleService:
     def _election_inputs(self) -> Tuple[np.ndarray, np.ndarray]:
         """Elect wherever there is no leader or the leader is down;
         candidate = lowest-index up member (the randomized-timeout
-        winner in the reference; the host picks deterministically)."""
-        leader = np.asarray(self.state.leader)
+        winner in the reference; the host picks deterministically).
+        Runs entirely on the host mirrors — no device round trip."""
+        leader = self.leader_np
         leader_up = np.zeros((self.n_ens,), dtype=bool)
         has = leader >= 0
         leader_up[has] = self.up[np.nonzero(has)[0], leader[has]]
-        member = np.asarray(self.state.view_mask).any(1)
-        cand_ok = self.up & member
+        cand_ok = self.up & self.member_np
         any_up = cand_ok.any(1)
         cand = np.where(any_up, cand_ok.argmax(1), -1).astype(np.int32)
         elect = (~has | ~leader_up) & any_up
         return elect, cand
 
+    def _launch(self, kind: np.ndarray, slot: np.ndarray,
+                val: np.ndarray, k: int, want_vsn: bool):
+        """One ``full_step`` launch + host bookkeeping shared by
+        :meth:`flush` (future-based) and :meth:`execute` (bulk):
+        elections folded in, lease check/renewal, corruption-driven
+        exchange.  Returns np result arrays (vsn None unless asked —
+        it is the largest transfer and bulk callers rarely need it).
+        """
+        jnp = self._jnp
+        elect, cand = self._election_inputs()
+        now = self.runtime.now
+        lease_ok = self.lease_until > now
+
+        state, won, res = self.engine.full_step(
+            self.state, jnp.asarray(elect), jnp.asarray(cand),
+            jnp.asarray(kind), jnp.asarray(slot), jnp.asarray(val),
+            jnp.asarray(np.broadcast_to(lease_ok, (max(k, 1),
+                                                   self.n_ens))[:k]
+                        if k else np.zeros((0, self.n_ens), bool)),
+            jnp.asarray(self.up))
+        self.state = state
+
+        # ONE device->host transfer per launch: results pack into a
+        # single int32 vector on device (each separate fetch is a full
+        # round trip over a tunneled device link).
+        e, m = self.n_ens, self.n_peers
+        flat = np.asarray(_pack_results(won, res, want_vsn))
+        off = 0
+
+        def take(n, shape=None):
+            nonlocal off
+            out = flat[off:off + n]
+            off += n
+            return out.reshape(shape) if shape else out
+
+        won_np = take(e).astype(bool)
+        quorum_ok = take(e).astype(bool)
+        corrupt_np = take(e * m, (e, m)).astype(bool)
+        corrupt = corrupt_np if k else None
+        if k:
+            committed = take(k * e, (k, e)).astype(bool)
+            get_ok = take(k * e, (k, e)).astype(bool)
+            found = take(k * e, (k, e)).astype(bool)
+            value = take(k * e, (k, e))
+            vsn = None
+            if want_vsn:
+                vsn = np.stack([take(k * e, (k, e)), take(k * e, (k, e))],
+                               axis=-1)
+        else:
+            committed = get_ok = found = value = vsn = None
+
+        # Host mirror: a won election installed our candidate.
+        self.leader_np = np.where(won_np, cand, self.leader_np)
+
+        # Lease renewal: a won election, or any round in which the
+        # leader confirmed its epoch with a quorum — the leader_tick
+        # renewal (peer.erl:1092-1095), which covers read-only leaders
+        # (reads ride the epoch-check round), not just committers.
+        renew = won_np | quorum_ok
+        self.lease_until[renew] = now + self.config.lease()
+
+        # Device-detected integrity failures -> anti-entropy exchange
+        # for the affected ensembles (the tree_corrupted -> repair ->
+        # exchange flow, peer.erl:1276-1277 + riak_ensemble_exchange):
+        # divergent slots re-adopt the newest hash-valid copy and the
+        # replicas' trees are rebuilt; unreplaceable (all-copies-bad)
+        # slots stay flagged rather than being blessed.
+        if corrupt is not None and corrupt.any():
+            self.corruptions += int(corrupt.sum())
+            run = corrupt.any(1)
+            self.state, diverged, synced = self.engine.exchange_step(
+                self.state, jnp.asarray(run), jnp.asarray(self.up))
+            self.repairs += int(
+                np.asarray(diverged)[np.asarray(synced)].sum())
+        self.flushes += 1
+        return committed, get_ok, found, value, vsn
+
+    def execute(self, kind: np.ndarray, slot: np.ndarray,
+                val: np.ndarray) -> Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray, np.ndarray]:
+        """Bulk array API: run ``[K, E]`` op matrices through the
+        service in one launch and return ``(committed, get_ok, found,
+        value)`` as ``[K, E]`` arrays.
+
+        This is the TPU-native client surface for array-shaped
+        workloads: callers address slots directly and carry int32
+        payloads inline on the device (no per-op Python objects, no
+        host handle store) — the scalar kput/kget API remains for
+        keyed/arbitrary-payload use.  Payload 0 is RESERVED as the
+        tombstone (a put of 0 is a delete: it commits, and subsequent
+        gets return found=False) — puts of live values must use
+        1..2^31-1.  Same semantics as queued ops: elections fold in,
+        leases check/renew, corruption triggers exchange.
+        """
+        kind = np.asarray(kind, np.int32)
+        val = np.asarray(val, np.int32)
+        if ((kind == eng.OP_PUT) & (val < 0)).any():
+            raise ValueError("negative put payloads are not encodable "
+                             "(int32 handles; 0 = tombstone/delete)")
+        k = int(kind.shape[0])
+        committed, get_ok, found, value, _ = self._launch(
+            kind, np.asarray(slot, np.int32), val, k, want_vsn=False)
+        self.ops_served += int((np.asarray(kind) != eng.OP_NOOP).sum())
+        return committed, get_ok, found, value
+
     def flush(self) -> int:
         """One device launch for everything queued; returns ops served."""
-        jnp = self._jnp
         k = min(self.max_k, max((len(q) for q in self.queues), default=0))
-        elect, cand = self._election_inputs()
-        if k == 0 and not elect.any():
+        if k == 0 and not self._election_inputs()[0].any():
             return 0
 
         kind = np.zeros((k, self.n_ens), dtype=np.int32)
         slot = np.zeros((k, self.n_ens), dtype=np.int32)
         val = np.zeros((k, self.n_ens), dtype=np.int32)
         taken: List[List[_PendingOp]] = []
-        now = self.runtime.now
-        lease_ok = self.lease_until > now
         for e in range(self.n_ens):
             ops = self.queues[e][:k]
             self.queues[e] = self.queues[e][k:]
@@ -196,34 +371,15 @@ class BatchedEnsembleService:
                 slot[j, e] = op.slot
                 val[j, e] = op.handle
 
-        state, won, res = eng.full_step(
-            self.state, jnp.asarray(elect), jnp.asarray(cand),
-            jnp.asarray(kind), jnp.asarray(slot), jnp.asarray(val),
-            jnp.asarray(np.broadcast_to(lease_ok, (max(k, 1),
-                                                   self.n_ens))[:k]
-                        if k else np.zeros((0, self.n_ens), bool)),
-            jnp.asarray(self.up))
-        self.state = state
+        committed, get_ok, found, value, vsn = self._launch(
+            kind, slot, val, k, want_vsn=True)
 
-        # one d2h per flush
-        won_np = np.asarray(won)
-        committed = np.asarray(res.committed) if k else None
-        get_ok = np.asarray(res.get_ok) if k else None
-        found = np.asarray(res.found) if k else None
-        value = np.asarray(res.value) if k else None
-        vsn = np.asarray(res.obj_vsn) if k else None
-
-        # a successful election (or any committed activity) renews the
-        # lease for this ensemble's leader (leader_tick renewal analog)
-        self.lease_until[won_np] = now + self.config.lease()
         served = 0
         for e in range(self.n_ens):
-            any_commit = False
             for j, op in enumerate(taken[e]):
                 served += 1
                 if op.kind == eng.OP_PUT:
                     if committed[j, e]:
-                        any_commit = True
                         op.fut.resolve(("ok", (int(vsn[j, e, 0]),
                                                int(vsn[j, e, 1]))))
                     else:
@@ -239,8 +395,5 @@ class BatchedEnsembleService:
                             op.fut.resolve(("ok", NOTFOUND))
                     else:
                         op.fut.resolve("failed")
-            if any_commit:
-                self.lease_until[e] = now + self.config.lease()
-        self.flushes += 1
         self.ops_served += served
         return served

@@ -49,7 +49,8 @@ def make_mesh(n_ens: int, n_peer: int = 1,
     return Mesh(grid, ("ens", "peer"))
 
 
-# PartitionSpecs for each EngineState field ([E,M] / [E] / [E,V,M] / [E,M,S]).
+# PartitionSpecs for each EngineState field ([E,M] / [E] / [E,V,M] /
+# [E,M,S] / [E,M,S,LANES] / [E,M,U,LANES]).
 _STATE_SPECS = eng.EngineState(
     epoch=P("ens", "peer"),
     fact_seq=P("ens", "peer"),
@@ -59,12 +60,15 @@ _STATE_SPECS = eng.EngineState(
     obj_epoch=P("ens", "peer", None),
     obj_seq=P("ens", "peer", None),
     obj_val=P("ens", "peer", None),
+    tree_leaf=P("ens", "peer", None, None),
+    tree_node=P("ens", "peer", None, None),
 )
 
 # kv_step_scan stacks results along a leading K axis.
 _SCAN_RESULT_SPECS = eng.KvResult(
     committed=P(None, "ens"), get_ok=P(None, "ens"), found=P(None, "ens"),
     value=P(None, "ens"), obj_vsn=P(None, "ens", None),
+    quorum_ok=P(None, "ens"), tree_corrupt=P(None, "ens", "peer"),
 )
 
 
@@ -107,6 +111,19 @@ class ShardedEngine:
                                                      axis_name=ax),
             (_STATE_SPECS, P("ens"), P("ens", "peer"), P("ens", "peer")),
             (_STATE_SPECS, P("ens"), P("ens")))
+        self._exchange = smap(
+            lambda st, run, up: eng.exchange_step(st, run, up,
+                                                  axis_name=ax),
+            (_STATE_SPECS, P("ens"), P("ens", "peer")),
+            (_STATE_SPECS, P("ens", "peer"), P("ens")))
+        self._verify = smap(
+            lambda st: eng.verify_trees(st, axis_name=ax),
+            (_STATE_SPECS,),
+            (P("ens", "peer"), P("ens", "peer")))
+        self._rebuild = smap(
+            eng.rebuild_trees,
+            (_STATE_SPECS, P("ens", "peer")),
+            _STATE_SPECS)
 
     # -- placement ---------------------------------------------------------
 
@@ -140,3 +157,18 @@ class ShardedEngine:
         """Joint-consensus membership change over the mesh
         (:func:`riak_ensemble_tpu.ops.engine.reconfig_step`)."""
         return self._reconfig(state, propose, new_view, up)
+
+    def exchange_step(self, state, run, up):
+        """Anti-entropy sweep over the mesh
+        (:func:`riak_ensemble_tpu.ops.engine.exchange_step`)."""
+        return self._exchange(state, run, up)
+
+    def verify_trees(self, state):
+        """Integrity sweep over the mesh
+        (:func:`riak_ensemble_tpu.ops.engine.verify_trees`)."""
+        return self._verify(state)
+
+    def rebuild_trees(self, state, mask):
+        """Tree rebuild over the mesh
+        (:func:`riak_ensemble_tpu.ops.engine.rebuild_trees`)."""
+        return self._rebuild(state, mask)

@@ -101,28 +101,49 @@ class ScalarModel:
             smax = max(c[1] for c in cands if c[0] == emax)
             vmax = max(c[2] for c in cands
                        if c[0] == emax and c[1] == smax)
-            rd_epoch, rd_seq, rd_val, found = emax, smax, vmax, True
+            rd_epoch, rd_seq, rd_val, obj_found = emax, smax, vmax, True
         else:
             rd_epoch = rd_seq = rd_val = 0
-            found = False
+            obj_found = False
+        # val == 0 is the device tombstone: full version discipline,
+        # reads back as notfound.
+        found = obj_found and rd_val != 0
 
         get_gate = is_get and leader_up and (lease_ok or epoch_ok)
-        stale = found and rd_epoch != lead_epoch
+        stale = obj_found and rd_epoch != lead_epoch
         rewrite = get_gate and stale and epoch_ok
-        get_ok = get_gate and ((not stale) or rewrite)
+        # all_or_quorum notfound dance: every member replica answered
+        # notfound -> serve without writing; otherwise a tombstone must
+        # commit at the current epoch (peer.erl:1568-1584).
+        member = self.members()
+        all_ok = all(heard[p] for p in member)
+        nf = get_gate and not obj_found
+        # tombstone needs a quorum of (hash-valid) notfound answers;
+        # with no corruption in this model, valid answers = heard
+        nf_quorum = self._met(heard)
+        nf_write = (nf and slot_valid and not all_ok and epoch_ok
+                    and nf_quorum)
+        get_ok = ((get_gate and obj_found and ((not stale) or rewrite))
+                  or (nf and (all_ok or not slot_valid or nf_write)))
 
         put_commit = is_put and epoch_ok and slot_valid
-        commit = put_commit or rewrite
+        commit = put_commit or rewrite or nf_write
         if commit:
             new_seq = self.ctr + 1
-            wval = val if is_put else rd_val
+            wval = val if is_put else (rd_val if rewrite else 0)
             for p in range(self.m):
                 if heard[p]:
                     self.store[p][slot] = (lead_epoch, new_seq, wval)
             self.ctr = new_seq
             out_vsn = (lead_epoch, new_seq)
-        elif get_ok:
-            out_vsn = (rd_epoch, rd_seq)
+        elif get_ok and obj_found:
+            # read repair: heal heard replicas lagging the winner
+            # (maybe_repair, peer.erl:1518-1536); tombstones too
+            for p in range(self.m):
+                if heard[p] and self.store[p][slot] != (rd_epoch, rd_seq,
+                                                        rd_val):
+                    self.store[p][slot] = (rd_epoch, rd_seq, rd_val)
+            out_vsn = (rd_epoch, rd_seq) if found else (0, 0)
         else:
             out_vsn = (0, 0)
         return {
