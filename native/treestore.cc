@@ -16,6 +16,9 @@
 // mirrors the reference's shared-DB ETS registry so many trees can
 // open one engine (synctree_leveldb.erl:52-83).
 
+#include <fcntl.h>
+#include <unistd.h>
+
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -27,6 +30,21 @@
 #include <vector>
 
 namespace {
+
+// fsync the directory containing `path` so a just-renamed file's
+// directory entry survives power loss (the tmp+rename+dirsync rite).
+void sync_parent_dir(const std::string& path) {
+  std::string dir = ".";
+  auto slash = path.find_last_of('/');
+  if (slash != std::string::npos) {
+    dir = path.substr(0, slash);
+  }
+  int fd = open(dir.c_str(), O_RDONLY);
+  if (fd >= 0) {
+    fsync(fd);
+    close(fd);
+  }
+}
 
 // CRC-32 (IEEE), table-driven — the framing checksum.
 uint32_t crc32(const uint8_t* data, size_t len) {
@@ -188,9 +206,14 @@ struct Store {
       frame.append(body);
       fwrite(frame.data(), 1, frame.size(), f);
     }
+    // Durable ordering: snapshot bytes reach disk BEFORE the rename
+    // publishes it, and the rename reaches disk (directory fsync)
+    // BEFORE the log truncation discards the records it replaced.
     fflush(f);
+    fsync(fileno(f));
     fclose(f);
     rename(tmp.c_str(), path.c_str());
+    sync_parent_dir(path);
     if (log) {
       fclose(log);
     }
@@ -317,7 +340,10 @@ void retpu_store_sync(void* h) {
   auto* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> g(s->mu);
   if (s->log) {
+    // fflush alone survives process crash but not OS crash/power loss;
+    // the advertised durability contract needs the fsync.
     fflush(s->log);
+    fsync(fileno(s->log));
   }
 }
 

@@ -110,8 +110,20 @@ class Backend:
         trees (backend.erl:97-108)."""
         return None
 
+    def monitored(self) -> Tuple[Any, ...]:
+        """Actor names the owning peer should monitor on the backend's
+        behalf at startup (the backend's helper processes; the peer
+        passes their DOWN signals to :meth:`handle_down`, matching the
+        monitor-then-callback flow of peer.erl:1919-1929).  Backends
+        that spawn helpers later use ``peer.monitor_backend(name)``."""
+        return ()
+
     def handle_down(self, ref: Any, pid: Any, reason: Any):
-        """False | ('ok',) | ('reset',) (backend.erl:84-93)."""
+        """React to a DOWN signal for a monitored process
+        (backend.erl:84-93): False = not mine / ignore; ('ok',) =
+        handled, keep going; ('reset',) = storage lost — the peer must
+        step down and re-probe (module_handle_down,
+        peer.erl:1937-1948)."""
         return False
 
 

@@ -330,4 +330,17 @@ def lazy_send_all(actor: Actor, msg: Tuple, self_id: Any, peers, views,
     reqid = next(_reqids)
     collector.reqid = reqid
     _fan_out(collector, name, msg, reqid, peers, self_id)
+    # A lazy collector waits indefinitely for its owner's ("ask",) —
+    # tie its lifetime to the owner (the Erlang collector dies with its
+    # parent): if the owning actor stops before asking, stop the
+    # collector too instead of leaking it in the registry forever.
+    owner = actor.name
+
+    def _owner_down(_name, cname=name):
+        c = collector.runtime.whereis(cname)
+        if c is not None and c.lazy:
+            future.resolve(("timeout", list(collector.replies)))
+            collector.runtime.stop_actor(cname)
+
+    actor.runtime.monitor(owner, _owner_down)
     return future, name

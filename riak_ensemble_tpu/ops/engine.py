@@ -102,7 +102,12 @@ class EngineState(NamedTuple):
     epoch: jax.Array        # [E, M] int32  per-peer current epoch
     fact_seq: jax.Array     # [E, M] int32  per-peer fact seq
     leader: jax.Array       # [E]    int32  global leader peer idx, -1 none
-    view_mask: jax.Array    # [E, V, M] bool  joint-consensus views
+    view_mask: jax.Array    # [E, V, M] bool  joint-consensus views,
+    #                         newest first (slot 0 = head), all-zero
+    #                         rows = unused capacity in the views list
+    view_vsn: jax.Array     # [E] int32  bumps on every views change
+    pend_vsn: jax.Array     # [E] int32  vsn of the adopted pending change
+    commit_vsn: jax.Array   # [E] int32  pend_vsn as of the last collapse
     obj_seq_ctr: jax.Array  # [E]    int32  leader per-epoch obj counter
     obj_epoch: jax.Array    # [E, M, S] int32  replica store: obj epochs
     obj_seq: jax.Array      # [E, M, S] int32  replica store: obj seqs
@@ -263,6 +268,9 @@ def init_state(n_ensembles: int, n_peers: int, n_slots: int,
         fact_seq=jnp.zeros((e, m), jnp.int32),
         leader=jnp.full((e,), -1, jnp.int32),
         view_mask=jnp.broadcast_to(jnp.asarray(vm), (e, v, m)),
+        view_vsn=jnp.zeros((e,), jnp.int32),
+        pend_vsn=jnp.zeros((e,), jnp.int32),
+        commit_vsn=jnp.zeros((e,), jnp.int32),
         obj_seq_ctr=jnp.zeros((e,), jnp.int32),
         obj_epoch=jnp.zeros((e, m, s), jnp.int32),
         obj_seq=jnp.zeros((e, m, s), jnp.int32),
@@ -741,43 +749,14 @@ def exchange_step(state: EngineState, run: jax.Array, up: jax.Array,
 # Membership reconfiguration kernel (joint consensus, ladder #5)
 
 
-@functools.partial(jax.jit, static_argnames=("axis_name",))
-def reconfig_step(state: EngineState, propose: jax.Array,
-                  new_view: jax.Array, up: jax.Array,
-                  axis_name: Optional[str] = None
-                  ) -> Tuple[EngineState, jax.Array, jax.Array]:
-    """Batched joint-consensus membership change.
-
-    The reference's update_members → transition dance (peer.erl:655-672,
-    751-774): a proposed view is CONSED onto the views list, quorums
-    must hold in EVERY view while joint (msg.erl:377-418 recursion —
-    here view slot 1 keeps the old view), and once the joint
-    configuration has committed, views collapse to the new one alone.
-    One call does one phase per ensemble, batched over E:
-
-    - ensembles with ``propose`` and a single active view: install the
-      joint configuration (new view into slot 0, old into slot 1) if a
-      commit quorum holds in the OLD view (try_commit gate);
-    - ensembles already joint (both view slots active): collapse to
-      slot 0 alone if a commit quorum holds in BOTH views
-      (should_transition/transition, :751-774).
-
-    propose  [E] bool; new_view [E, Ml] bool; up [E, Ml] bool.
-    Returns (state', installed [E], collapsed [E]).  Leaders whose
-    commit gate fails keep their current views (the host steps them
-    down / retries, as the reference does on failed try_commit).
-    """
+def _reconfig_gate(state: EngineState, up: jax.Array,
+                   axis_name: Optional[str]
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """(heard [E, Ml], commit quorum in every CURRENT view [E]) — the
+    try_commit gate (peer.erl:776-788) on epoch-matching acks."""
     member_now = state.view_mask.any(1)                      # [E, Ml]
     heard = up & member_now
-    # Peer-axis predicates must be global under sharding (a shard only
-    # sees its local peer slice).
-    is_joint = reduce_peers(
-        state.view_mask[:, 1, :].astype(jnp.int32), axis_name) > 0  # [E]
-    new_nonempty = reduce_peers(new_view.astype(jnp.int32),
-                                axis_name) > 0               # [E]
     has_leader = state.leader >= 0
-
-    # Commit gate in the CURRENT configuration (epoch-matching acks).
     gidx = _global_peer_idx(state.epoch.shape[1], axis_name)
     is_leader = gidx[None, :] == state.leader[:, None]
     lead_epoch = reduce_peers(jnp.where(is_leader, state.epoch, 0),
@@ -785,27 +764,109 @@ def reconfig_step(state: EngineState, propose: jax.Array,
     ack = heard & (state.epoch == lead_epoch[:, None])
     commit_ok = (_quorum_met(ack, heard, state.view_mask, axis_name)
                  & has_leader)
+    return heard, commit_ok
 
-    install = propose & ~is_joint & commit_ok & new_nonempty
-    collapse = is_joint & commit_ok & ~propose
 
-    old_v0 = state.view_mask[:, 0, :]
-    # install: slot0=new, slot1=old;  collapse: slot0 stays, slot1=0
-    v0 = jnp.where(install[:, None], new_view, old_v0)
-    v1 = jnp.where(install[:, None], old_v0,
-                   jnp.where(collapse[:, None], False,
-                             state.view_mask[:, 1, :]))
-    view_mask = jnp.stack([v0, v1], axis=1)
-    if state.view_mask.shape[1] > 2:
-        view_mask = jnp.concatenate(
-            [view_mask, state.view_mask[:, 2:, :]], axis=1)
-    # fact seq advances on a committed view change (try_commit
-    # increments; we fold install/collapse into one seq bump on the
-    # member replicas that heard it).
-    bump = (install | collapse)[:, None] & heard
-    fact_seq = jnp.where(bump, state.fact_seq + 1, state.fact_seq)
-    return (state._replace(view_mask=view_mask, fact_seq=fact_seq),
-            install, collapse)
+@functools.partial(jax.jit, static_argnames=("axis_name",))
+def reconfig_propose(state: EngineState, propose: jax.Array,
+                     new_view: jax.Array, vsn: jax.Array, up: jax.Array,
+                     axis_name: Optional[str] = None
+                     ) -> Tuple[EngineState, jax.Array]:
+    """Batched ``update_members`` + ``maybe_change_views``
+    (peer.erl:655-672, 1115-1135): CONS the proposed view onto the
+    views list and adopt the manager's pending version.
+
+    propose [E] bool; new_view [E, Ml] bool; vsn [E] int32 — the
+    pending change's version from the manager/root (gossip side);
+    up [E, Ml] bool.  Per ensemble, the install happens iff:
+
+    - a commit quorum holds in EVERY current view (the try_commit
+      gate — a joint ensemble may take FURTHER changes before
+      transitioning, exactly like consing onto the views list);
+    - ``vsn > pend_vsn`` (stale/duplicate pending changes are ignored,
+      the maybe_change_views vsn guard, :1117-1121);
+    - the proposed view is non-empty and the views list has a free
+      slot (the device bounds list depth at V; a full list nacks and
+      the host retries after a transition — backpressure the
+      reference gets implicitly from transition frequency).
+
+    Effect: views = [new | views], ``view_vsn`` bumps, ``pend_vsn``
+    adopts ``vsn``, fact seq bumps on the replicas that heard it.
+    Returns (state', installed [E]).
+    """
+    heard, commit_ok = _reconfig_gate(state, up, axis_name)
+    new_nonempty = reduce_peers(new_view.astype(jnp.int32),
+                                axis_name) > 0               # [E]
+    # Free capacity: the last (oldest) slot must be unused.
+    tail_used = reduce_peers(
+        state.view_mask[:, -1, :].astype(jnp.int32), axis_name) > 0
+    vsn_ok = vsn > state.pend_vsn
+    install = propose & commit_ok & new_nonempty & ~tail_used & vsn_ok
+
+    shifted = jnp.concatenate(
+        [new_view[:, None, :], state.view_mask[:, :-1, :]], axis=1)
+    view_mask = jnp.where(install[:, None, None], shifted,
+                          state.view_mask)
+    bump = install[:, None] & heard
+    return state._replace(
+        view_mask=view_mask,
+        view_vsn=jnp.where(install, state.view_vsn + 1, state.view_vsn),
+        pend_vsn=jnp.where(install, vsn, state.pend_vsn),
+        fact_seq=jnp.where(bump, state.fact_seq + 1, state.fact_seq),
+    ), install
+
+
+@functools.partial(jax.jit, static_argnames=("axis_name",))
+def reconfig_transition(state: EngineState, run: jax.Array,
+                        up: jax.Array,
+                        axis_name: Optional[str] = None
+                        ) -> Tuple[EngineState, jax.Array]:
+    """Batched ``maybe_transition``/``transition`` (peer.erl:751-774,
+    1199-1214): once the joint configuration has a commit quorum in
+    EVERY view, collapse the list to the head view alone and record
+    ``commit_vsn = pend_vsn`` (the dance's final step,
+    doc/Readme.md:106-153).  Returns (state', collapsed [E])."""
+    heard, commit_ok = _reconfig_gate(state, up, axis_name)
+    is_joint = reduce_peers(
+        state.view_mask[:, 1:, :].any(1).astype(jnp.int32), axis_name) > 0
+    collapse = run & is_joint & commit_ok
+
+    head_only = jnp.concatenate(
+        [state.view_mask[:, :1, :],
+         jnp.zeros_like(state.view_mask[:, 1:, :])], axis=1)
+    view_mask = jnp.where(collapse[:, None, None], head_only,
+                          state.view_mask)
+    bump = collapse[:, None] & heard
+    return state._replace(
+        view_mask=view_mask,
+        view_vsn=jnp.where(collapse, state.view_vsn + 1, state.view_vsn),
+        commit_vsn=jnp.where(collapse, state.pend_vsn, state.commit_vsn),
+        fact_seq=jnp.where(bump, state.fact_seq + 1, state.fact_seq),
+    ), collapse
+
+
+@functools.partial(jax.jit, static_argnames=("axis_name",))
+def reconfig_step(state: EngineState, propose: jax.Array,
+                  new_view: jax.Array, up: jax.Array,
+                  axis_name: Optional[str] = None
+                  ) -> Tuple[EngineState, jax.Array, jax.Array]:
+    """One reconfig phase per ensemble, batched over E — the fused
+    convenience over :func:`reconfig_propose` /
+    :func:`reconfig_transition`: ensembles with ``propose`` cons the
+    new view (vsn auto-derived as pend_vsn+1, i.e. the manager's next
+    pending version), the rest transition if joint and able.
+
+    propose  [E] bool; new_view [E, Ml] bool; up [E, Ml] bool.
+    Returns (state', installed [E], collapsed [E]).  Leaders whose
+    commit gate fails keep their current views (the host steps them
+    down / retries, as the reference does on failed try_commit).
+    """
+    state, installed = reconfig_propose(
+        state, propose, new_view, state.pend_vsn + 1, up,
+        axis_name=axis_name)
+    state, collapsed = reconfig_transition(state, ~propose, up,
+                                           axis_name=axis_name)
+    return state, installed, collapsed
 
 
 # ---------------------------------------------------------------------------

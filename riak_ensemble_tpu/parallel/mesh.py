@@ -56,6 +56,9 @@ _STATE_SPECS = eng.EngineState(
     fact_seq=P("ens", "peer"),
     leader=P("ens"),
     view_mask=P("ens", None, "peer"),
+    view_vsn=P("ens"),
+    pend_vsn=P("ens"),
+    commit_vsn=P("ens"),
     obj_seq_ctr=P("ens"),
     obj_epoch=P("ens", "peer", None),
     obj_seq=P("ens", "peer", None),
@@ -111,6 +114,17 @@ class ShardedEngine:
                                                      axis_name=ax),
             (_STATE_SPECS, P("ens"), P("ens", "peer"), P("ens", "peer")),
             (_STATE_SPECS, P("ens"), P("ens")))
+        self._reconfig_propose = smap(
+            lambda st, pr, nv, vsn, up: eng.reconfig_propose(
+                st, pr, nv, vsn, up, axis_name=ax),
+            (_STATE_SPECS, P("ens"), P("ens", "peer"), P("ens"),
+             P("ens", "peer")),
+            (_STATE_SPECS, P("ens")))
+        self._reconfig_transition = smap(
+            lambda st, run, up: eng.reconfig_transition(
+                st, run, up, axis_name=ax),
+            (_STATE_SPECS, P("ens"), P("ens", "peer")),
+            (_STATE_SPECS, P("ens")))
         self._exchange = smap(
             lambda st, run, up: eng.exchange_step(st, run, up,
                                                   axis_name=ax),
@@ -157,6 +171,16 @@ class ShardedEngine:
         """Joint-consensus membership change over the mesh
         (:func:`riak_ensemble_tpu.ops.engine.reconfig_step`)."""
         return self._reconfig(state, propose, new_view, up)
+
+    def reconfig_propose(self, state, propose, new_view, vsn, up):
+        """General views-list cons over the mesh
+        (:func:`riak_ensemble_tpu.ops.engine.reconfig_propose`)."""
+        return self._reconfig_propose(state, propose, new_view, vsn, up)
+
+    def reconfig_transition(self, state, run, up):
+        """Views-list collapse over the mesh
+        (:func:`riak_ensemble_tpu.ops.engine.reconfig_transition`)."""
+        return self._reconfig_transition(state, run, up)
 
     def exchange_step(self, state, run, up):
         """Anti-entropy sweep over the mesh

@@ -103,7 +103,10 @@ class Peer(Actor):
         self.ready = False
         self.tree_trust = not config.tree_validation
         self.tree_ready = False
-        self.alive = config.alive_ticks
+        # NB: named alive_credits, not `alive` — Actor.alive is the
+        # liveness flag and shadowing it would make _deliver drop
+        # messages once the ping credits hit zero.
+        self.alive_credits = config.alive_ticks
         self.last_views: Optional[Sequence] = None
         self.watchers: List[Any] = []
         self.busy = False
@@ -111,6 +114,8 @@ class Peer(Actor):
 
         self.mod: Backend = BACKENDS[backend](ensemble, peer_id,
                                               backend_args)
+        for helper in self.mod.monitored():
+            self.monitor_backend(helper)
         # synctree (shared-tree override via synctree_path,
         # peer.erl:2155-2167).
         tree_path = self.mod.synctree_path(ensemble, peer_id)
@@ -188,7 +193,12 @@ class Peer(Actor):
                 self.watchers.remove(msg[1])
             return
         if kind == "backend_pong":
-            self.alive = self.config.alive_ticks
+            self.alive_credits = self.config.alive_ticks
+            return
+        if kind == "backend_down":
+            # DOWN for a backend-monitored process -> the behaviour
+            # decides (module_handle_down, peer.erl:1937-1948).
+            self._module_handle_down(msg[1])
             return
         if kind == "peer_sync":
             _, fut, inner = msg
@@ -485,7 +495,7 @@ class Peer(Actor):
 
     def _leading_init(self) -> None:
         self.fsm_state = "leading"
-        self.alive = self.config.alive_ticks
+        self.alive_credits = self.config.alive_ticks
         self.tree_ready = False
         self._start_exchange()
         self._notify_leader_status(self.watchers)
@@ -978,14 +988,34 @@ class Peer(Actor):
     # ------------------------------------------------------------------
     # backend indirection (peer.erl:2115-2153)
 
+    def monitor_backend(self, actor_name: Any) -> None:
+        """Monitor a backend helper process on the backend's behalf
+        (erlang:monitor; DOWN flows to Mod:handle_down via the FSM
+        mailbox so suspension semantics hold, peer.erl:1919-1929)."""
+        self.runtime.monitor(
+            actor_name,
+            lambda name: self.runtime.post(self.name,
+                                           ("backend_down", name)))
+
+    def _module_handle_down(self, name: Any) -> None:
+        """module_handle_down (peer.erl:1937-1948): the behaviour
+        returns False (not mine), ('ok',) (recovered), or ('reset',)
+        — its storage is gone; step down and re-probe so the ensemble
+        re-establishes state from the quorum."""
+        result = self.mod.handle_down(name, name, "down")
+        if result is False or result is None:
+            return
+        if result[0] == "reset":
+            self._step_down("probe")
+
     def _mod_ping(self) -> bool:
         """Alive-ticks credit counter (peer.erl:2115-2128): 'async'
         spends a credit; backend_pong refills them."""
         result = self.mod.ping(self)
         if result == "ok":
             return True
-        if result == "async" and self.alive > 0:
-            self.alive -= 1
+        if result == "async" and self.alive_credits > 0:
+            self.alive_credits -= 1
             return True
         return False
 

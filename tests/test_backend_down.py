@@ -1,0 +1,115 @@
+"""Backend failure handling: the handle_down / reset path.
+
+The reference reacts to a crashed backend helper process with
+``module_handle_down`` → ``{reset, ...}`` → ``step_down``
+(src/riak_ensemble_peer.erl:1919-1948; behaviour contract
+src/riak_ensemble_backend.erl:84-93): the peer abandons leadership and
+re-probes, re-establishing state from the quorum.
+"""
+
+import pytest
+
+from riak_ensemble_tpu.backend import BasicBackend, register_backend
+from riak_ensemble_tpu.runtime import Actor
+from riak_ensemble_tpu.testing import Cluster, make_peers
+
+
+class _StoreActor(Actor):
+    """Stand-in for an external storage process a backend leans on."""
+
+    def handle(self, msg):
+        pass
+
+
+class HelperBackend(BasicBackend):
+    """BasicBackend that declares a helper actor; its death resets the
+    peer (the eleveldb-crashed analog)."""
+
+    down_events = []
+
+    def __init__(self, ensemble, peer_id, args=()):
+        super().__init__(ensemble, peer_id, ())
+        runtime, node = args
+        self.helper_name = ("store", ensemble, repr(peer_id))
+        if runtime.whereis(self.helper_name) is None:
+            _StoreActor(runtime, self.helper_name, node)
+
+    def monitored(self):
+        return (self.helper_name,)
+
+    def handle_down(self, ref, pid, reason):
+        type(self).down_events.append((self.peer_id, ref))
+        if ref == self.helper_name:
+            self.data = {}          # storage gone with the process
+            return ("reset",)
+        return False
+
+
+@pytest.fixture(autouse=True)
+def _fresh_events():
+    HelperBackend.down_events = []
+    register_backend("helper", HelperBackend)
+
+
+def _cluster_with_helpers():
+    c = Cluster(seed=11)
+    peers = make_peers(3)
+    c.create_ensemble(
+        "demo", peers, backend="helper",
+        backend_args=(c.runtime, peers[0].node))
+    # give each peer its own helper on its own node
+    return c, peers
+
+
+def test_helper_death_resets_leader_and_reelects():
+    c, peers = _cluster_with_helpers()
+    leader = c.wait_stable("demo")
+    c.kput_ok("demo", "k", b"v")
+
+    # Kill the LEADER's helper process mid-load.
+    lp = c.peer("demo", leader)
+    c.runtime.stop_actor(lp.mod.helper_name)
+    c.runtime.run_for(0.1)
+
+    # handle_down fired on the leader and it stepped down (reset).
+    assert any(pid == leader for pid, _ in HelperBackend.down_events)
+    assert lp.fsm_state != "leading"
+
+    # The ensemble re-elects (possibly the same peer after re-probe)
+    # and serves the committed value from the quorum.
+    new = c.wait_stable("demo")
+    assert c.kget_value("demo", "k") == b"v"
+    # The reset peer's local store was wiped; a fresh read repairs it
+    # through the quorum read path, so writes continue to commit.
+    c.kput_ok("demo", "k", b"v2")
+    assert c.kget_value("demo", "k") == b"v2"
+
+
+def test_follower_helper_death_does_not_depose_leader():
+    c, peers = _cluster_with_helpers()
+    leader = c.wait_stable("demo")
+    c.kput_ok("demo", "k", b"v")
+    follower = next(p for p in peers if p != leader)
+    fp = c.peer("demo", follower)
+    c.runtime.stop_actor(fp.mod.helper_name)
+    c.runtime.run_for(0.1)
+    assert any(pid == follower for pid, _ in HelperBackend.down_events)
+    # Leader unaffected; service continues.
+    assert c.leader_id("demo") == leader
+    assert c.kget_value("demo", "k") == b"v"
+
+
+def test_unrelated_down_is_ignored():
+    """handle_down returning False must leave the peer alone
+    (the not-mine branch, peer.erl:1940-1942)."""
+    c, peers = _cluster_with_helpers()
+    leader = c.wait_stable("demo")
+    lp = c.peer("demo", leader)
+    other = ("store", "demo", "unrelated")
+    _StoreActor(c.runtime, other, peers[0].node)
+    lp.monitor_backend(other)
+    c.runtime.stop_actor(other)
+    c.runtime.run_for(0.1)
+    assert any(ref == other for _, ref in HelperBackend.down_events)
+    assert lp.fsm_state == "leading"
+    assert c.leader_id("demo") == leader

@@ -110,3 +110,187 @@ def test_churn_cycle_at_scale():
         state, _, collapsed = eng.reconfig_step(
             state, jnp.zeros((e,), bool), full, up)
         assert bool(np.asarray(collapsed).all()), round_i
+
+
+# ---------------------------------------------------------------------------
+# General views-list semantics: arbitrary depth + the pend/commit vsn dance
+
+
+class ScalarViews:
+    """Independent scalar model of the reference's membership dance
+    (update_members cons, peer.erl:655-672; maybe_change_views vsn
+    guard, :1115-1135; transition collapse + commit_vsn, :751-774) for
+    one ensemble with all peers up and a fixed leader."""
+
+    def __init__(self, m, depth):
+        self.m, self.depth = m, depth
+        self.views = [set(range(m))]
+        self.view_vsn = 0
+        self.pend_vsn = 0
+        self.commit_vsn = 0
+
+    def propose(self, new_view, vsn):
+        if (vsn <= self.pend_vsn or not new_view
+                or len(self.views) >= self.depth):
+            return False
+        self.views.insert(0, set(new_view))
+        self.view_vsn += 1
+        self.pend_vsn = vsn
+        return True
+
+    def transition(self):
+        if len(self.views) <= 1:
+            return False
+        self.views = [self.views[0]]
+        self.view_vsn += 1
+        self.commit_vsn = self.pend_vsn
+        return True
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_views_dance_matches_scalar_model(seed):
+    """Randomized churn: proposals with stale/fresh vsns and
+    transitions, device (V=4) vs the scalar views-list model."""
+    rng = np.random.default_rng(seed)
+    e, m, depth = 16, 5, 4
+    state = eng.init_state(e, m, 8, n_views=depth)
+    up = jnp.ones((e, m), bool)
+    state, won = eng.elect_step(state, jnp.ones((e,), bool),
+                                jnp.zeros((e,), jnp.int32), up)
+    assert bool(np.asarray(won).all())
+    models = [ScalarViews(m, depth) for _ in range(e)]
+
+    for step in range(20):
+        if rng.random() < 0.6:
+            # propose: random view, vsn sometimes stale
+            nv = np.zeros((e, m), bool)
+            vsn = np.zeros((e,), np.int32)
+            views = []
+            for i in range(e):
+                size = rng.integers(0, m + 1)
+                view = set(rng.choice(m, size=size, replace=False).tolist())
+                views.append(view)
+                nv[i, list(view)] = True
+                vsn[i] = models[i].pend_vsn + rng.integers(0, 2)  # 0=stale
+            state, installed = eng.reconfig_propose(
+                state, jnp.ones((e,), bool), jnp.asarray(nv),
+                jnp.asarray(vsn), up)
+            inst = np.asarray(installed)
+            for i in range(e):
+                assert inst[i] == models[i].propose(views[i], int(vsn[i])), \
+                    (seed, step, i)
+        else:
+            state, collapsed = eng.reconfig_transition(
+                state, jnp.ones((e,), bool), up)
+            coll = np.asarray(collapsed)
+            for i in range(e):
+                assert coll[i] == models[i].transition(), (seed, step, i)
+
+        vm = np.asarray(state.view_mask)
+        vv = np.asarray(state.view_vsn)
+        pv = np.asarray(state.pend_vsn)
+        cv = np.asarray(state.commit_vsn)
+        for i in range(e):
+            mdl = models[i]
+            assert vv[i] == mdl.view_vsn, (seed, step, i)
+            assert pv[i] == mdl.pend_vsn, (seed, step, i)
+            assert cv[i] == mdl.commit_vsn, (seed, step, i)
+            got = [set(np.nonzero(vm[i, v])[0].tolist())
+                   for v in range(depth)]
+            want = [set(v) for v in mdl.views] + \
+                [set()] * (depth - len(mdl.views))
+            assert got == want, (seed, step, i)
+
+
+def test_deep_views_quorum_spans_every_view():
+    """Three stacked views: a commit needs a majority in ALL of them
+    (the msg.erl:377-418 recursion over an arbitrary list)."""
+    e, m = 4, 7
+    state = eng.init_state(e, m, 8, n_views=4)
+    up = jnp.ones((e, m), bool)
+    state, won = eng.elect_step(state, jnp.ones((e,), bool),
+                                jnp.zeros((e,), jnp.int32), up)
+    # views: head {0,1,2}, mid {2,3,4}, tail {0..6}
+    for view in ([2, 3, 4], [0, 1, 2]):
+        nv = np.zeros((e, m), bool)
+        nv[:, view] = True
+        state, installed = eng.reconfig_propose(
+            state, jnp.ones((e,), bool), jnp.asarray(nv),
+            jnp.asarray(np.asarray(state.pend_vsn) + 1), up)
+        assert bool(np.asarray(installed).all())
+    kind = jnp.full((e,), eng.OP_PUT, jnp.int32)
+    slot = jnp.zeros((e,), jnp.int32)
+    val = jnp.full((e,), 5, jnp.int32)
+    lease = jnp.ones((e,), bool)
+    # Up {0,1,2,5,6}: head 3/3, mid 1/3 -> fail.
+    up_p = jnp.asarray(np.tile([1, 1, 1, 0, 0, 1, 1], (e, 1)).astype(bool))
+    _, res = eng.kv_step(state, kind, slot, val, lease, up_p)
+    assert not bool(np.asarray(res.committed).any())
+    # Up {0,1,2,3,4}: head 3/3, mid 3/3, tail 5/7 -> commit.
+    up_q = jnp.asarray(np.tile([1, 1, 1, 1, 1, 0, 0], (e, 1)).astype(bool))
+    _, res = eng.kv_step(state, kind, slot, val, lease, up_q)
+    assert bool(np.asarray(res.committed).all())
+    # Transition collapses all the way to the head view.
+    state, collapsed = eng.reconfig_transition(
+        state, jnp.ones((e,), bool), up)
+    assert bool(np.asarray(collapsed).all())
+    vm = np.asarray(state.view_mask)
+    assert vm[:, 0, :3].all() and not vm[:, 1:, :].any()
+
+
+def test_full_views_list_backpressures():
+    """A views list at capacity nacks further proposals until a
+    transition frees a slot (the host retries, as after any failed
+    try_commit)."""
+    e, m = 2, 5
+    state = eng.init_state(e, m, 8, n_views=2)
+    up = jnp.ones((e, m), bool)
+    state, _ = eng.elect_step(state, jnp.ones((e,), bool),
+                              jnp.zeros((e,), jnp.int32), up)
+    nv = jnp.asarray(np.tile([1, 1, 1, 0, 0], (e, 1)).astype(bool))
+    state, installed = eng.reconfig_propose(
+        state, jnp.ones((e,), bool), nv,
+        jnp.asarray(np.asarray(state.pend_vsn) + 1), up)
+    assert bool(np.asarray(installed).all())
+    state2, installed = eng.reconfig_propose(
+        state, jnp.ones((e,), bool), nv,
+        jnp.asarray(np.asarray(state.pend_vsn) + 1), up)
+    assert not bool(np.asarray(installed).any())  # full: nack
+    state, collapsed = eng.reconfig_transition(
+        state, jnp.ones((e,), bool), up)
+    assert bool(np.asarray(collapsed).all())
+    state, installed = eng.reconfig_propose(
+        state, jnp.ones((e,), bool), nv,
+        jnp.asarray(np.asarray(state.pend_vsn) + 1), up)
+    assert bool(np.asarray(installed).all())  # slot freed
+
+
+def test_sharded_general_reconfig_matches_single():
+    from riak_ensemble_tpu.parallel.mesh import ShardedEngine, make_mesh
+
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    e, m = 8, 8
+    se = ShardedEngine(make_mesh(4, 2))
+    views = [list(range(5))]
+    up = jnp.ones((e, m), bool)
+    nv = jnp.asarray(np.tile([1, 1, 1, 0, 0, 0, 0, 0], (e, 1)).astype(bool))
+
+    def run(eng_or_se, state):
+        state, won = eng_or_se.elect_step(
+            state, jnp.ones((e,), bool), jnp.zeros((e,), jnp.int32), up)
+        vsn = jnp.ones((e,), jnp.int32)
+        state, inst = eng_or_se.reconfig_propose(
+            state, jnp.ones((e,), bool), nv, vsn, up)
+        state, coll = eng_or_se.reconfig_transition(
+            state, jnp.ones((e,), bool), up)
+        return won, inst, coll, state
+
+    out_s = run(eng, eng.init_state(e, m, 8, views=views))
+    out_m = run(se, se.init_state(e, m, 8, views=views))
+    for a, b in zip(jax.tree.leaves(out_s), jax.tree.leaves(out_m)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    won, inst, coll, state = out_s
+    assert bool(np.asarray(won).all()) and bool(np.asarray(inst).all())
+    assert bool(np.asarray(coll).all())
+    np.testing.assert_array_equal(np.asarray(state.commit_vsn), 1)
