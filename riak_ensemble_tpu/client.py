@@ -15,9 +15,9 @@ from __future__ import annotations
 
 from typing import Any
 
+from riak_ensemble_tpu import funref
 from riak_ensemble_tpu import router as routerlib
 from riak_ensemble_tpu.manager import manager_name
-from riak_ensemble_tpu.peer import do_kput_once, do_kupdate
 from riak_ensemble_tpu.runtime import Runtime
 from riak_ensemble_tpu.types import NOTFOUND, Obj
 
@@ -57,11 +57,13 @@ class Client:
     def kupdate(self, ensemble, key, current: Obj, new,
                 timeout: float = 10.0):
         return self._maybe(lambda: self._sync(
-            ensemble, ("put", key, do_kupdate, [current, new]), timeout))
+            ensemble, ("put", key, funref.ref("peer:kupdate"),
+                       [current, new]), timeout))
 
     def kput_once(self, ensemble, key, value, timeout: float = 10.0):
         return self._maybe(lambda: self._sync(
-            ensemble, ("put", key, do_kput_once, [value]), timeout))
+            ensemble, ("put", key, funref.ref("peer:kput_once"), [value]),
+            timeout))
 
     def kover(self, ensemble, key, value, timeout: float = 10.0):
         return self._maybe(lambda: self._sync(

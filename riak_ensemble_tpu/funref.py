@@ -1,0 +1,52 @@
+"""Symbolic references to registered modify functions.
+
+The reference never ships closures between nodes: a kmodify carries an
+``{Module, Function, Args}`` triple and the put FSM applies it by name
+(``riak_ensemble_peer.erl:303-317``, ``riak_ensemble_root.erl:82,104``).
+This module is that mechanism for the TPU framework: protocol events
+carry ``("fn", name, bound_args)`` tuples — plain data the restricted
+wire codec can ship — and the executing peer resolves the name against
+a process-local registry of functions registered at import time.
+
+Live callables still pass through :func:`resolve` untouched, so
+in-process tests (and the root leader's local gossip kmodify) can keep
+using real closures; they simply are not wire-encodable, same as any
+other local-only message.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Tuple
+
+_REGISTRY: Dict[str, Callable] = {}
+
+TAG = "fn"
+
+
+def register(name: str) -> Callable[[Callable], Callable]:
+    """Decorator: make `fn` addressable on the wire as `name`."""
+    def deco(fn: Callable) -> Callable:
+        assert name not in _REGISTRY, f"duplicate funref {name}"
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def ref(name: str, *bound: Any) -> Tuple:
+    """A wire-safe reference to registered function `name`, with
+    `bound` prepended to its call arguments (the Args of an MFA)."""
+    assert name in _REGISTRY, f"unregistered funref {name}"
+    return (TAG, name, tuple(bound))
+
+
+def resolve(spec: Any) -> Callable:
+    """Spec → callable.  Callables pass through; ``("fn", name,
+    bound)`` resolves against the registry; anything else raises."""
+    if callable(spec):
+        return spec
+    if (isinstance(spec, tuple) and len(spec) == 3 and spec[0] == TAG
+            and spec[1] in _REGISTRY):
+        fn = _REGISTRY[spec[1]]
+        return functools.partial(fn, *spec[2]) if spec[2] else fn
+    raise ValueError(f"unresolvable function spec: {spec!r}")

@@ -343,4 +343,9 @@ def lazy_send_all(actor: Actor, msg: Tuple, self_id: Any, peers, views,
             collector.runtime.stop_actor(cname)
 
     actor.runtime.monitor(owner, _owner_down)
+    # The collector always stops eventually (ask path or owner-down
+    # path); drop the owner monitor with it so a long-lived owner
+    # doesn't accumulate one dead closure per lazy_send_all call.
+    actor.runtime.monitor(
+        name, lambda _n: actor.runtime.demonitor(owner, _owner_down))
     return future, name

@@ -22,12 +22,12 @@ import asyncio
 import sys
 from typing import Any, Dict, Optional, Sequence, Tuple
 
+from riak_ensemble_tpu import funref
 from riak_ensemble_tpu import router as routerlib
 from riak_ensemble_tpu.client import translate
 from riak_ensemble_tpu.config import Config, fast_test_config
 from riak_ensemble_tpu.manager import Manager
 from riak_ensemble_tpu.netruntime import NetRuntime
-from riak_ensemble_tpu.peer import do_kput_once, do_kupdate
 from riak_ensemble_tpu.storage import Storage
 from riak_ensemble_tpu.types import NOTFOUND, Obj, PeerId
 
@@ -98,12 +98,14 @@ class AsyncNode:
 
     async def kput_once(self, ensemble, key, value, timeout: float = 10.0):
         return await self._sync(
-            ensemble, ("put", key, do_kput_once, [value]), timeout)
+            ensemble, ("put", key, funref.ref("peer:kput_once"), [value]),
+            timeout)
 
     async def kupdate(self, ensemble, key, current: Obj, new,
                       timeout: float = 10.0):
         return await self._sync(
-            ensemble, ("put", key, do_kupdate, [current, new]), timeout)
+            ensemble, ("put", key, funref.ref("peer:kupdate"),
+                       [current, new]), timeout)
 
     async def kdelete(self, ensemble, key, timeout: float = 10.0):
         return await self.kover(ensemble, key, NOTFOUND, timeout)

@@ -4,7 +4,8 @@ The production counterpart of the deterministic simulator
 (:mod:`riak_ensemble_tpu.runtime`): each OS process hosts ONE node's
 actor stack (storage, manager, routers, peers) on an asyncio loop with
 wall-clock timers, and node-to-node messages travel as length-prefixed
-pickle frames over TCP.  This is the DCN/host half of the distributed
+frames over TCP in the restricted :mod:`riak_ensemble_tpu.wire` codec
+(no code execution on decode).  This is the DCN/host half of the distributed
 communication backend (SURVEY §5): protocol math batches onto TPU via
 the ops kernels; membership/timers/messaging run here — the role the
 reference delegates to Erlang distribution (disterl,
@@ -27,17 +28,42 @@ unchanged on either runtime.
 from __future__ import annotations
 
 import asyncio
-import pickle
+import os
 import random
 import struct
+import sys
 import time
 from typing import Any, Callable, Dict, Generator, List, Optional, Tuple
 
+from riak_ensemble_tpu import wire
 from riak_ensemble_tpu.runtime import Actor, Future, Task, Timer
 from riak_ensemble_tpu.types import PeerId
 
 FRAME_HEADER = struct.Struct(">I")
 MAX_FRAME = 64 * 1024 * 1024
+
+#: log every dropped (non-encodable or malformed) frame to stderr
+_WIRE_DEBUG = bool(os.environ.get("RETPU_WIRE_DEBUG"))
+
+_warned_types: set = set()
+
+
+def _warn_unencodable(item: Any) -> None:
+    """A value the wire codec rejects is silently lost to the caller
+    (they see only a timeout), so say so — once per offending type
+    (every time, with the repr, under RETPU_WIRE_DEBUG).  Everything
+    here is guarded: this runs in the except path that must never kill
+    the sender task, and a hostile __repr__ may raise."""
+    try:
+        desc = repr(item)[:300] if _WIRE_DEBUG else type(item).__name__
+    except Exception:
+        desc = f"<{type(item).__name__} with raising __repr__>"
+    if _WIRE_DEBUG or desc not in _warned_types:
+        if not _WIRE_DEBUG:
+            _warned_types.add(desc)
+        print(f"riak_ensemble_tpu: dropping non-wire-encodable frame "
+              f"({desc}); only plain data and registered protocol "
+              f"types cross nodes", file=sys.stderr, flush=True)
 
 #: service-style names carrying their node at index 1
 _NODE_AT_1 = ("manager", "router", "rtr_proxy", "storage", "collector",
@@ -106,6 +132,18 @@ class NetRuntime:
             self.defer(lambda: callback(name))
             return
         self._monitors.setdefault(name, []).append(callback)
+
+    def demonitor(self, name: Any,
+                  callback: Callable[[Any], None]) -> None:
+        fns = self._monitors.get(name)
+        if fns is None:
+            return
+        try:
+            fns.remove(callback)
+        except ValueError:
+            pass
+        if not fns:
+            del self._monitors[name]
 
     def suspend(self, name: Any) -> None:
         self.actors[name].suspended = True
@@ -203,9 +241,13 @@ class NetRuntime:
                     break
                 payload = await reader.readexactly(length)
                 try:
-                    dst, msg = pickle.loads(payload)
-                except Exception:
-                    continue  # corrupt frame: drop (CRC role is TCP's)
+                    dst, msg = wire.decode(payload)
+                except Exception as exc:
+                    if _WIRE_DEBUG:
+                        print("WIRE-RXDROP", exc, payload[:120],
+                              file=sys.stderr, flush=True)
+                    continue  # corrupt/hostile frame: drop (the codec
+                    # only constructs allowlisted protocol types)
                 self.post(dst, msg)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
@@ -268,9 +310,13 @@ class _Conn:
             while True:
                 item = await self.queue.get()
                 try:
-                    payload = pickle.dumps(item, protocol=4)
+                    payload = wire.encode(item)
                 except Exception:
-                    continue  # unpicklable: local-only message, drop
+                    # WireError for out-of-allowlist values; anything
+                    # else (a hostile __repr__/__eq__, etc.) must not
+                    # kill the sender task and wedge the link.
+                    _warn_unencodable(item)
+                    continue  # not wire-encodable: local-only, drop
                 if writer is None:
                     try:
                         _r, writer = await asyncio.wait_for(

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from riak_ensemble_tpu import funref
 from riak_ensemble_tpu import msg as msglib
 from riak_ensemble_tpu.backend import BACKENDS, Backend
 from riak_ensemble_tpu.config import Config
@@ -107,6 +108,7 @@ class Peer(Actor):
         # liveness flag and shadowing it would make _deliver drop
         # messages once the ping credits hit zero.
         self.alive_credits = config.alive_ticks
+        self._backend_monitors: List[Tuple[Any, Callable]] = []
         self.last_views: Optional[Sequence] = None
         self.watchers: List[Any] = []
         self.busy = False
@@ -655,6 +657,17 @@ class Peer(Actor):
         if kind == "tree_pid":
             fut.resolve(self.tree)
             return
+        if kind == "fwd":
+            # A request already forwarded once by a follower: handle
+            # only if leading, else nack — never re-forward, or two
+            # followers with mutually stale fact.leader would bounce
+            # one request forever (the reference's forward is likewise
+            # a single hop, peer.erl:864-867).
+            if self.fsm_state == "leading":
+                self._leading_sync(inner[1], fut)
+            else:
+                fut.resolve("nack")
+            return
         if kind == "tree_corrupted":
             # common sync (peer.erl:1036-1040); leading overrides below.
             if self.fsm_state == "leading":
@@ -677,9 +690,18 @@ class Peer(Actor):
         (peer.erl:838-858, 1348-1356)."""
         if inner[0] in ("get", "put", "overwrite", "join", "update_members"):
             leader_addr = self.peer_addr(self.leader) if self.leader else None
-            if leader_addr is not None:
+            if leader_addr is None:
+                return  # drop; client times out
+            if self.leader.node == self.node:
+                # Same-host: hand over the caller's future directly.
                 self.send(leader_addr, ("forward", fut, inner))
-            # else: drop; client times out
+            else:
+                # Cross-node: a live future can't ride the wire; use
+                # the request-id'd xcall proxy (the From-pid analog).
+                # "fwd"-wrapped so the remote never forwards again.
+                out = msglib.xcall(self, leader_addr, ("fwd", inner),
+                                   self.config.local_put_timeout)
+                out.add_waiter(fut.resolve)
         else:
             fut.resolve("nack")
 
@@ -706,7 +728,13 @@ class Peer(Actor):
                     key, lambda: self._do_get_fsm(key, fut, opts))
         elif kind == "put":
             _, key, fun, args = inner
-            if not self.tree_ready:
+            try:
+                # Wire events carry ("fn", name, bound) specs, not
+                # closures (the reference's MFA, root.erl:82,104).
+                fun = funref.resolve(fun)
+            except ValueError:
+                fun = None
+            if fun is None or not self.tree_ready:
                 fut.resolve("failed")
             else:
                 self.workers.async_(
@@ -992,10 +1020,10 @@ class Peer(Actor):
         """Monitor a backend helper process on the backend's behalf
         (erlang:monitor; DOWN flows to Mod:handle_down via the FSM
         mailbox so suspension semantics hold, peer.erl:1919-1929)."""
-        self.runtime.monitor(
-            actor_name,
-            lambda name: self.runtime.post(self.name,
-                                           ("backend_down", name)))
+        callback = lambda name: self.runtime.post(  # noqa: E731
+            self.name, ("backend_down", name))
+        self._backend_monitors.append((actor_name, callback))
+        self.runtime.monitor(actor_name, callback)
 
     def _module_handle_down(self, name: Any) -> None:
         """module_handle_down (peer.erl:1937-1948): the behaviour
@@ -1356,6 +1384,11 @@ class Peer(Actor):
     def on_stop(self) -> None:
         self._cancel_timer()
         self.workers.reset()
+        # A backend helper may outlive this peer: release its monitors
+        # or each peer restart leaks a closure pinning the dead Peer.
+        for target, callback in self._backend_monitors:
+            self.runtime.demonitor(target, callback)
+        self._backend_monitors.clear()
         if self.runtime.whereis(self.tree) is not None:
             self.runtime.stop_actor(self.tree)
 
@@ -1406,6 +1439,7 @@ def existing_leader(replies, abandoned, latest: Fact):
 # K/V modify functions (peer.erl do_kupdate/do_kput_once/do_kmodify)
 
 
+@funref.register("peer:kupdate")
 def do_kupdate(obj, _next_seq, peer: Peer, args):
     """CAS on (epoch, seq) (peer.erl:259-270)."""
     current, new = args
@@ -1415,6 +1449,7 @@ def do_kupdate(obj, _next_seq, peer: Peer, args):
     return "failed"
 
 
+@funref.register("peer:kput_once")
 def do_kput_once(obj, _next_seq, peer: Peer, args):
     """peer.erl:278-284."""
     (new,) = args
@@ -1423,9 +1458,14 @@ def do_kput_once(obj, _next_seq, peer: Peer, args):
     return "failed"
 
 
+@funref.register("peer:kmodify")
 def do_kmodify(obj, next_seq, peer: Peer, args):
     """peer.erl:303-317: user function applied inside the put FSM."""
     mod_fun, default = args
+    try:
+        mod_fun = funref.resolve(mod_fun)
+    except ValueError:
+        return "failed"
     value = peer.mod.obj_value(obj)
     if value is NOTFOUND:
         value = default

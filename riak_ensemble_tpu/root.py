@@ -24,9 +24,9 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+from riak_ensemble_tpu import funref
 from riak_ensemble_tpu import router as routerlib
 from riak_ensemble_tpu import state as statelib
-from riak_ensemble_tpu.peer import do_kmodify
 from riak_ensemble_tpu.runtime import Future
 from riak_ensemble_tpu.state import ClusterState
 from riak_ensemble_tpu.types import EnsembleInfo, PeerId, Views, Vsn
@@ -39,7 +39,7 @@ def _call(mgr, target_node: str, fun, timeout: float) -> Future:
     """root.erl:74-90: kmodify on `target_node`'s root ensemble; the
     returned future resolves to "ok" | "failed" | "timeout"."""
     default = mgr.get_cluster_state()
-    event = ("put", KEY, do_kmodify, [fun, default])
+    event = ("put", KEY, funref.ref("peer:kmodify"), [fun, default])
     fut = routerlib.sync_send_event_fut(mgr.runtime, target_node, ROOT,
                                         event, timeout)
     out = Future()
@@ -57,33 +57,37 @@ def _call(mgr, target_node: str, fun, timeout: float) -> Future:
 def _cast(mgr, target_node: str, fun, timeout: float = 5.0) -> None:
     """root.erl:92-108: fire-and-forget kmodify."""
     default = mgr.get_cluster_state()
-    event = ("put", KEY, do_kmodify, [fun, default])
+    event = ("put", KEY, funref.ref("peer:kmodify"), [fun, default])
     routerlib.sync_send_event_fut(mgr.runtime, target_node, ROOT, event,
                                   timeout)
 
 
-# The mutator functions are module-level + functools.partial (NOT
-# closures) so root operations stay picklable when the put event is
-# forwarded across nodes by a real transport — the analog of the
-# reference shipping {Module, Function, Cmd} MFAs (root.erl:82,104).
+# The mutators are registered funrefs so root operations cross nodes as
+# plain data (name + bound args), never as live functions — the analog
+# of the reference shipping {Module, Function, Cmd} MFAs
+# (root.erl:82,104); the executing root leader resolves them locally.
 
 
+@funref.register("root:join")
 def _join_fun(joining_node: str, vsn: Vsn, cs: ClusterState):
     out = statelib.add_member(vsn, joining_node, cs)
     return out if out is not None else "failed"
 
 
+@funref.register("root:remove")
 def _remove_fun(target_node: str, vsn: Vsn, cs: ClusterState):
     out = statelib.del_member(vsn, target_node, cs)
     return out if out is not None else "failed"
 
 
+@funref.register("root:set_ensemble")
 def _set_ensemble_fun(ensemble: Any, info: EnsembleInfo, _vsn: Vsn,
                       cs: ClusterState):
     out = statelib.set_ensemble(ensemble, info, cs)
     return out if out is not None else "failed"
 
 
+@funref.register("root:update_ensemble")
 def _update_ensemble_fun(ensemble: Any, leader: Optional[PeerId],
                          views: Views, vsn: Vsn, _vsn: Vsn,
                          cs: ClusterState):
@@ -95,34 +99,29 @@ def join(mgr, target_node: str, joining_node: str,
          timeout: float = 60.0) -> Future:
     """Add `joining_node` to the cluster via `target_node`'s root
     ensemble (root.erl:47-55, root_call {join,..}:123-130)."""
-    import functools
     return _call(mgr, target_node,
-                 functools.partial(_join_fun, joining_node), timeout)
+                 funref.ref("root:join", joining_node), timeout)
 
 
 def remove(mgr, target_node: str, timeout: float = 60.0) -> Future:
     """Remove `target_node`, via the local root (root.erl:57-65)."""
-    import functools
     return _call(mgr, mgr.node,
-                 functools.partial(_remove_fun, target_node), timeout)
+                 funref.ref("root:remove", target_node), timeout)
 
 
 def set_ensemble(mgr, ensemble: Any, info: EnsembleInfo,
                  timeout: float = 10.0) -> Future:
     """Create/overwrite an ensemble record (root.erl:38-45,139-145)."""
-    import functools
     return _call(mgr, mgr.node,
-                 functools.partial(_set_ensemble_fun, ensemble, info),
-                 timeout)
+                 funref.ref("root:set_ensemble", ensemble, info), timeout)
 
 
 def update_ensemble(mgr, ensemble: Any, leader: Optional[PeerId],
                     views: Views, vsn: Vsn) -> None:
     """root.erl:34-36,159-165 (cast)."""
-    import functools
     _cast(mgr, mgr.node,
-          functools.partial(_update_ensemble_fun, ensemble, leader,
-                            views, vsn))
+          funref.ref("root:update_ensemble", ensemble, leader, views,
+                     vsn))
 
 
 def gossip(mgr, peer, vsn: Vsn, leader: PeerId, views: Views) -> None:
@@ -139,8 +138,9 @@ def gossip(mgr, peer, vsn: Vsn, leader: PeerId, views: Views) -> None:
         return out if out is not None else "failed"
 
     # Cast directly to the issuing peer itself (root.erl:68-70 sends to
-    # the root leader's own pid).
+    # the root leader's own pid).  This never leaves the node, so the
+    # mutator stays a live closure (resolve passes it through).
     fut = Future()
     mgr.runtime.post(peer.name, ("peer_sync", fut,
-                                 ("put", KEY, do_kmodify,
+                                 ("put", KEY, funref.ref("peer:kmodify"),
                                   [fun, mgr.get_cluster_state()])))

@@ -222,6 +222,21 @@ class Runtime:
             return
         self._monitors.setdefault(name, []).append(callback)
 
+    def demonitor(self, name: Any,
+                  callback: Callable[[Any], None]) -> None:
+        """Remove a monitor registered with :meth:`monitor` — needed
+        whenever the monitoring side finishes first, or a long-lived
+        monitored actor accumulates dead callbacks forever."""
+        fns = self._monitors.get(name)
+        if fns is None:
+            return
+        try:
+            fns.remove(callback)
+        except ValueError:
+            pass
+        if not fns:
+            del self._monitors[name]
+
     def suspend(self, name: Any) -> None:
         """Freeze an actor (erlang:suspend_process analog)."""
         self.actors[name].suspended = True

@@ -1,0 +1,217 @@
+"""Restricted wire codec for the TCP transport.
+
+The reference ships terms over disterl, whose decoder constructs only
+plain Erlang terms — it can never execute code.  This codec gives the
+TCP transport (:mod:`riak_ensemble_tpu.netruntime`) the same property:
+:func:`decode` builds values exclusively from an allowlist of plain
+containers and the protocol's registered record types.  A hostile peer
+that can reach the node port can at worst inject a well-formed protocol
+message (the disterl trust model without the cookie); it cannot make
+the decoder run arbitrary code the way ``pickle.loads`` would.
+
+Format: one tag byte per value, then a payload.  Sizes/counts are
+unsigned varints; ints are sign-magnitude big-endian byte strings so
+arbitrary precision survives.  Containers are count-prefixed element
+sequences; registered records are a type code plus their field values
+in declaration order.
+
+Anything not encodable (actor refs, futures, closures) raises
+:class:`WireError` at *encode* time — the transport drops such frames
+as local-only, exactly as it did for unpicklable values.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Callable, Dict, List, Tuple
+
+from riak_ensemble_tpu.state import ClusterState
+from riak_ensemble_tpu.types import (EnsembleInfo, Fact, NOTFOUND, Obj,
+                                     PeerId)
+
+__all__ = ["encode", "decode", "WireError"]
+
+
+class WireError(Exception):
+    """Value outside the wire allowlist, or a malformed frame."""
+
+
+_F64 = struct.Struct(">d")
+
+#: recursion guard, both directions: protocol messages are shallow (a
+#: gossip ClusterState inside a tuple is the deepest real frame).  On
+#: encode it also turns pathological user values (1000-deep nesting,
+#: self-referential containers) into WireError instead of
+#: RecursionError, so the transport's drop path handles them.
+_MAX_DEPTH = 32
+
+# Registered record types: code -> (class, field names).  Field values
+# recurse through the same codec, so nested records (a Fact's PeerId,
+# a ClusterState's EnsembleInfo dict) need no special casing.
+_RECORDS: Tuple[Tuple[type, Tuple[str, ...]], ...] = (
+    (PeerId, ("name", "node")),
+    (Obj, ("epoch", "seq", "key", "value")),
+    (Fact, ("epoch", "seq", "leader", "views", "view_vsn", "pend_vsn",
+            "commit_vsn", "pending")),
+    (EnsembleInfo, ("vsn", "leader", "views", "seq", "mod", "args")),
+    (ClusterState, ("id", "enabled", "members_vsn", "members",
+                    "ensembles", "pending")),
+)
+_RECORD_BY_CLS = {cls: (code, fields)
+                  for code, (cls, fields) in enumerate(_RECORDS)}
+
+
+def _put_uvarint(out: List[bytes], n: int) -> None:
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(bytes((b | 0x80,)))
+        else:
+            out.append(bytes((b,)))
+            return
+
+
+def _encode(out: List[bytes], v: Any, depth: int = 0) -> None:
+    if depth > _MAX_DEPTH:
+        raise WireError("value too deeply nested")
+    t = type(v)
+    if v is None:
+        out.append(b"N")
+    elif t is bool:
+        out.append(b"T" if v else b"F")
+    elif t is int:
+        raw = v.to_bytes((v.bit_length() + 8) // 8 or 1, "big", signed=True)
+        out.append(b"i")
+        _put_uvarint(out, len(raw))
+        out.append(raw)
+    elif t is float:
+        out.append(b"f" + _F64.pack(v))
+    elif t is str:
+        raw = v.encode("utf-8")
+        out.append(b"s")
+        _put_uvarint(out, len(raw))
+        out.append(raw)
+    elif t is bytes:
+        out.append(b"b")
+        _put_uvarint(out, len(v))
+        out.append(v)
+    elif t is tuple or t is list or t is set or t is frozenset:
+        out.append({tuple: b"t", list: b"l", set: b"e", frozenset: b"z"}[t])
+        _put_uvarint(out, len(v))
+        for item in v:
+            _encode(out, item, depth + 1)
+    elif t is dict:
+        out.append(b"d")
+        _put_uvarint(out, len(v))
+        for k, val in v.items():
+            _encode(out, k, depth + 1)
+            _encode(out, val, depth + 1)
+    elif v is NOTFOUND:
+        out.append(b"0")
+    elif t in _RECORD_BY_CLS:
+        code, fields = _RECORD_BY_CLS[t]
+        out.append(b"R")
+        _put_uvarint(out, code)
+        for name in fields:
+            _encode(out, getattr(v, name), depth + 1)
+    else:
+        raise WireError(f"type {t.__name__} is not wire-encodable")
+
+
+def encode(v: Any) -> bytes:
+    """Serialize an allowlisted value to a wire frame payload."""
+    out: List[bytes] = []
+    _encode(out, v)
+    return b"".join(out)
+
+
+class _Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes) -> None:
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        end = self.pos + n
+        if n < 0 or end > len(self.buf):
+            raise WireError("truncated frame")
+        chunk = self.buf[self.pos:end]
+        self.pos = end
+        return chunk
+
+    def uvarint(self) -> int:
+        shift = 0
+        n = 0
+        while True:
+            byte = self.take(1)[0]
+            n |= (byte & 0x7F) << shift
+            if not byte & 0x80:
+                return n
+            shift += 7
+            if shift > 63:
+                raise WireError("varint too long")
+
+
+def _decode(r: _Reader, depth: int) -> Any:
+    if depth > _MAX_DEPTH:
+        raise WireError("frame too deep")
+    tag = r.take(1)
+    if tag == b"N":
+        return None
+    if tag == b"T":
+        return True
+    if tag == b"F":
+        return False
+    if tag == b"i":
+        return int.from_bytes(r.take(r.uvarint()), "big", signed=True)
+    if tag == b"f":
+        return _F64.unpack(r.take(8))[0]
+    if tag == b"s":
+        try:
+            return r.take(r.uvarint()).decode("utf-8")
+        except UnicodeDecodeError as exc:
+            raise WireError(f"bad utf-8 in frame: {exc}") from None
+    if tag == b"b":
+        return r.take(r.uvarint())
+    if tag in (b"t", b"l", b"e", b"z"):
+        n = r.uvarint()
+        items = [_decode(r, depth + 1) for _ in range(n)]
+        if tag == b"t":
+            return tuple(items)
+        if tag == b"l":
+            return items
+        try:  # unhashable members are a malformed frame, not a crash
+            if tag == b"e":
+                return set(items)
+            return frozenset(items)
+        except TypeError as exc:
+            raise WireError(f"unhashable set member: {exc}") from None
+    if tag == b"d":
+        n = r.uvarint()
+        try:
+            return {_decode(r, depth + 1): _decode(r, depth + 1)
+                    for _ in range(n)}
+        except TypeError as exc:
+            raise WireError(f"unhashable dict key: {exc}") from None
+    if tag == b"0":
+        return NOTFOUND
+    if tag == b"R":
+        code = r.uvarint()
+        if code >= len(_RECORDS):
+            raise WireError(f"unknown record code {code}")
+        cls, fields = _RECORDS[code]
+        vals = [_decode(r, depth + 1) for _ in fields]
+        return cls(**dict(zip(fields, vals)))
+    raise WireError(f"unknown tag {tag!r}")
+
+
+def decode(payload: bytes) -> Any:
+    """Deserialize a frame payload; raises WireError on anything
+    malformed or outside the allowlist."""
+    r = _Reader(payload)
+    v = _decode(r, 0)
+    if r.pos != len(payload):
+        raise WireError("trailing bytes in frame")
+    return v

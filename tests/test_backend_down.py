@@ -113,3 +113,22 @@ def test_unrelated_down_is_ignored():
     assert any(ref == other for _, ref in HelperBackend.down_events)
     assert lp.fsm_state == "leading"
     assert c.leader_id("demo") == leader
+
+
+def test_peer_stop_releases_backend_monitors():
+    """A backend helper can outlive its peers; stopping a peer must
+    demonitor the helper or every peer restart leaks a closure pinning
+    the dead Peer (mirror of the msg.py lazy-collector fix)."""
+    from riak_ensemble_tpu.peer import peer_name
+
+    c, peers = _cluster_with_helpers()
+    c.wait_stable("demo")
+
+    victim = peers[0]
+    helper = c.peer("demo", victim).mod.helper_name
+    assert len(c.runtime._monitors.get(helper, [])) == 1
+
+    c.runtime.stop_actor(peer_name("demo", victim))
+    c.runtime.run_for(0.5)
+    assert c.runtime.whereis(helper) is not None  # helper outlives peer
+    assert len(c.runtime._monitors.get(helper, [])) == 0

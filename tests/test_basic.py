@@ -119,3 +119,27 @@ def test_multi_worker_pool():
     cur = c.kget("ens", "cas")[1]
     assert c.kupdate("ens", "cas", cur, b"b")[0] == "ok"
     assert c.kupdate("ens", "cas", cur, b"c") == "failed"
+
+
+def test_forwarded_request_never_bounces():
+    """A "fwd"-wrapped request (a follower already forwarded it once)
+    is handled by a leader and nacked by anyone else — never forwarded
+    a second hop, so two followers with mutually stale fact.leader
+    can't ping-pong one request (peer.erl:864-867 is one hop too)."""
+    from riak_ensemble_tpu.peer import peer_name, sync_send_event
+
+    c = Cluster(seed=11)
+    peers = make_peers(3)
+    c.create_ensemble("ens", peers)
+    leader = c.wait_stable("ens")
+    follower = next(p for p in peers if p != leader)
+
+    r = sync_send_event(c.runtime, peer_name("ens", leader),
+                        ("fwd", ("overwrite", "fk", b"fv")), timeout=10.0)
+    assert r[0] == "ok", r
+    assert c.kget_value("ens", "fk") == b"fv"
+
+    r = sync_send_event(c.runtime, peer_name("ens", follower),
+                        ("fwd", ("overwrite", "fk", b"xx")), timeout=10.0)
+    assert r == "nack", r
+    assert c.kget_value("ens", "fk") == b"fv"

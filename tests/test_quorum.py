@@ -168,3 +168,30 @@ class TestExtraCheck:
         quorum_met(replies, P(0), views, "quorum",
                    extra=lambda rs: seen.append(list(rs)) or True)
         assert seen and seen[0] == replies
+
+
+def test_lazy_collector_releases_owner_monitor():
+    """Every lazy_send_all (ping_quorum) must drop its owner-death
+    monitor when the collector finishes, or a long-lived leader
+    accumulates one dead closure per call forever."""
+    from riak_ensemble_tpu.peer import peer_name
+    from riak_ensemble_tpu.testing import Cluster, make_peers
+
+    c = Cluster(seed=23)
+    peers = make_peers(3)
+    c.create_ensemble("ens", peers)
+    leader = c.wait_stable("ens")
+    lname = peer_name("ens", leader)
+
+    def n_monitors():
+        return len(c.runtime._monitors.get(lname, []))
+
+    from riak_ensemble_tpu.peer import sync_send_event
+
+    base = n_monitors()
+    for _ in range(10):
+        r = sync_send_event(c.runtime, lname, ("ping_quorum",),
+                            timeout=10.0)
+        assert len(r) >= 2, r
+    c.runtime.run_for(1.0)
+    assert n_monitors() <= base + 1, (base, n_monitors())

@@ -1,0 +1,158 @@
+"""Restricted wire codec: roundtrips, allowlist rejection, hostile
+frames.  The codec replaces pickle on the TCP transport so a peer that
+can reach the node port can inject at worst a protocol message, never
+code (disterl's property, ADVICE r1)."""
+
+import pytest
+
+from riak_ensemble_tpu import wire
+from riak_ensemble_tpu.state import ClusterState
+from riak_ensemble_tpu.types import (EnsembleInfo, Fact, NOTFOUND, Obj,
+                                     PeerId)
+
+
+CASES = [
+    None, True, False, 0, 1, -1, 2 ** 80, -(2 ** 80), 1.5, -0.0,
+    "", "node0", "ünïcode", b"", b"\x00\xffpayload",
+    (), (1, 2), [1, [2, [3]]], {"a": 1, 2: (3,)}, {1, 2}, frozenset({3}),
+    NOTFOUND,
+    PeerId(1, "node0"), PeerId("root", "node1"),
+    Obj(epoch=3, seq=7, key="k", value=b"v"),
+    Obj(epoch=1, seq=1, key=("composite", 2), value=NOTFOUND),
+    Fact(epoch=2, seq=5, leader=PeerId(0, "n0"),
+         views=((PeerId(0, "n0"), PeerId(1, "n1")),),
+         view_vsn=(1, 0), pend_vsn=None, commit_vsn=(0, 0),
+         pending=((2, 1), ((PeerId(1, "n1"),),))),
+    EnsembleInfo(vsn=(1, 2), leader=None, views=((PeerId(0, "n0"),),),
+                 seq=None),
+    ClusterState(id=("node0", 123.5), enabled=True, members_vsn=(1, 0),
+                 members=frozenset({"node0", "node1"}),
+                 ensembles={"root": EnsembleInfo(
+                     vsn=(0, 1), leader=PeerId("root", "node0"),
+                     views=((PeerId("root", "node0"),),), seq=(1, 1))},
+                 pending={"root": ((1, 1), ((PeerId(2, "node2"),),))}),
+]
+
+
+@pytest.mark.parametrize("value", CASES, ids=lambda v: repr(v)[:40])
+def test_roundtrip(value):
+    out = wire.decode(wire.encode(value))
+    assert out == value
+    assert type(out) is type(value)
+
+
+def test_notfound_stays_singleton():
+    assert wire.decode(wire.encode(NOTFOUND)) is NOTFOUND
+
+
+def test_nested_message_shape():
+    # a realistic wire frame: (dst, msg) with a reply-from tuple
+    frame = (("peer", "kv", PeerId(1, "node1")),
+             ("get", "k", (("collector", "node0", 42), 7), 3))
+    assert wire.decode(wire.encode(frame)) == frame
+
+
+def test_rejects_unencodable():
+    class Evil:
+        pass
+    with pytest.raises(wire.WireError):
+        wire.encode(Evil())
+    with pytest.raises(wire.WireError):
+        wire.encode(lambda: None)  # closures never cross the wire
+
+
+def test_rejects_unknown_tag():
+    with pytest.raises(wire.WireError):
+        wire.decode(b"Q")
+
+
+def test_rejects_truncated():
+    payload = wire.encode((1, "abc", b"xyz"))
+    for cut in range(len(payload)):
+        with pytest.raises(wire.WireError):
+            wire.decode(payload[:cut])
+
+
+def test_rejects_trailing_garbage():
+    with pytest.raises(wire.WireError):
+        wire.decode(wire.encode(1) + b"N")
+
+
+def test_rejects_unknown_record_code():
+    with pytest.raises(wire.WireError):
+        wire.decode(b"R\x7f")
+
+
+def test_rejects_deep_nesting_bomb():
+    payload = b"t\x01" * 64 + b"N"
+    with pytest.raises(wire.WireError):
+        wire.decode(payload)
+
+
+def test_rejects_oversized_count():
+    # claims 2^40 tuple elements with no bodies: must fail cleanly,
+    # not allocate
+    payload = b"t" + bytes([0x80, 0x80, 0x80, 0x80, 0x80, 0x01])
+    with pytest.raises(wire.WireError):
+        wire.decode(payload)
+
+
+def test_no_pickle_in_transport():
+    import riak_ensemble_tpu.netruntime as nrt
+    import inspect
+    assert "pickle" not in inspect.getsource(nrt)
+
+
+def test_funref_roundtrip_and_resolve():
+    """Modify callbacks cross the wire as ("fn", name, bound) data —
+    the MFA analog (root.erl:82,104) — and resolve by registry."""
+    from riak_ensemble_tpu import funref
+    import riak_ensemble_tpu.root  # noqa: F401  (registers root:*)
+
+    spec = funref.ref("root:join", "node9")
+    got = wire.decode(wire.encode(spec))
+    assert got == spec
+    fn = funref.resolve(got)
+    from riak_ensemble_tpu import state as statelib
+    cs = statelib.new_state(("c", 1.0))
+    out = fn((1, 0), cs)
+    assert "node9" in out.members
+
+
+def test_funref_rejects_unregistered():
+    from riak_ensemble_tpu import funref
+    with pytest.raises(ValueError):
+        funref.resolve(("fn", "no:such", ()))
+    with pytest.raises(ValueError):
+        funref.resolve("not-a-spec")
+
+
+def test_encode_rejects_nesting_bomb():
+    """Pathological user values must become WireError (dropped frame),
+    not RecursionError (dead sender task)."""
+    v = []
+    for _ in range(1000):
+        v = [v]
+    with pytest.raises(wire.WireError):
+        wire.encode(v)
+
+
+def test_encode_rejects_self_reference():
+    v = []
+    v.append(v)
+    with pytest.raises(wire.WireError):
+        wire.encode(v)
+
+
+def test_decode_malformed_raises_wireerror_only():
+    """The documented contract: anything malformed raises WireError —
+    not UnicodeDecodeError / TypeError — so callers can catch narrowly."""
+    bad = [
+        b"s\x01\xff",          # invalid utf-8 in str
+        b"e\x01l\x00",         # set containing a list (unhashable)
+        b"z\x01l\x00",         # frozenset containing a list
+        b"d\x01l\x00N",        # dict with unhashable key
+    ]
+    for payload in bad:
+        with pytest.raises(wire.WireError):
+            wire.decode(payload)
