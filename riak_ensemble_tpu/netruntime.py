@@ -48,16 +48,22 @@ _WIRE_DEBUG = bool(os.environ.get("RETPU_WIRE_DEBUG"))
 _warned_types: set = set()
 
 
-def _warn_unencodable(item: Any) -> None:
+def _warn_unencodable(item: Any, exc: BaseException) -> None:
     """A value the wire codec rejects is silently lost to the caller
-    (they see only a timeout), so say so — once per offending type
-    (every time, with the repr, under RETPU_WIRE_DEBUG).  Everything
-    here is guarded: this runs in the except path that must never kill
-    the sender task, and a hostile __repr__ may raise."""
+    (they see only a timeout), so say so — once per distinct cause
+    (every time, with the full repr, under RETPU_WIRE_DEBUG).  The
+    frame is always a (dst, msg) tuple, so dedupe on the error text,
+    which names the actual offending inner type.  Everything here is
+    guarded: this runs in the except path that must never kill the
+    sender task, and a hostile __repr__/__str__ may raise."""
     try:
-        desc = repr(item)[:300] if _WIRE_DEBUG else type(item).__name__
+        cause = str(exc)[:200]
     except Exception:
-        desc = f"<{type(item).__name__} with raising __repr__>"
+        cause = type(exc).__name__
+    try:
+        desc = f"{cause}: {repr(item)[:300]}" if _WIRE_DEBUG else cause
+    except Exception:
+        desc = cause
     if _WIRE_DEBUG or desc not in _warned_types:
         if not _WIRE_DEBUG:
             _warned_types.add(desc)
@@ -311,11 +317,11 @@ class _Conn:
                 item = await self.queue.get()
                 try:
                     payload = wire.encode(item)
-                except Exception:
+                except Exception as exc:
                     # WireError for out-of-allowlist values; anything
                     # else (a hostile __repr__/__eq__, etc.) must not
                     # kill the sender task and wedge the link.
-                    _warn_unencodable(item)
+                    _warn_unencodable(item, exc)
                     continue  # not wire-encodable: local-only, drop
                 if writer is None:
                     try:

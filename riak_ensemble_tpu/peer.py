@@ -1020,8 +1020,17 @@ class Peer(Actor):
         """Monitor a backend helper process on the backend's behalf
         (erlang:monitor; DOWN flows to Mod:handle_down via the FSM
         mailbox so suspension semantics hold, peer.erl:1919-1929)."""
-        callback = lambda name: self.runtime.post(  # noqa: E731
-            self.name, ("backend_down", name))
+        def callback(name: Any) -> None:
+            # The monitor fired (helper died): the entry is spent —
+            # prune it so a backend that re-monitors a replacement
+            # helper after every restart doesn't grow the list.  (The
+            # deferred callback may land after on_stop cleared it.)
+            try:
+                self._backend_monitors.remove((name, callback))
+            except ValueError:
+                pass
+            self.runtime.post(self.name, ("backend_down", name))
+
         self._backend_monitors.append((actor_name, callback))
         self.runtime.monitor(actor_name, callback)
 

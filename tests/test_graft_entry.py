@@ -1,0 +1,23 @@
+"""Driver-contract guard: the multichip dry run (elections → K/V →
+leader-down failover → joint-consensus reconfig → integrity sweep,
+sharded-vs-single equivalence at every step) must keep passing on the
+virtual 8-device CPU mesh the driver uses."""
+
+import jax
+import pytest
+
+
+def test_dryrun_multichip_full_story():
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
+
+
+def test_entry_compiles():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
