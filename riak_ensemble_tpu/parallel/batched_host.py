@@ -139,6 +139,15 @@ class BatchedEnsembleService:
         self.key_slot: List[Dict[Any, int]] = [dict() for _ in range(n_ens)]
         self.free_slots: List[List[int]] = [
             list(range(n_slots)) for _ in range(n_ens)]
+        #: per-ensemble slot write generation: bumped on every queued
+        #: put, so a delete's deferred recycle can tell whether a later
+        #: write re-used the slot (then recycling would orphan it)
+        self.slot_gen: List[Dict[int, int]] = [dict() for _ in range(n_ens)]
+        #: per-ensemble slot -> handle of the last COMMITTED payload;
+        #: lets a committed overwrite/delete release the superseded
+        #: handle from ``values`` (otherwise the store grows forever)
+        self.slot_handle: List[Dict[int, int]] = [
+            dict() for _ in range(n_ens)]
         #: payload store: handle -> value (device carries handles)
         self.values: Dict[int, Any] = {}
         self.queues: List[List[_PendingOp]] = [[] for _ in range(n_ens)]
@@ -168,6 +177,7 @@ class BatchedEnsembleService:
             return fut
         handle = next(_handles) & 0x7FFFFFFF
         self.values[handle] = value
+        self.slot_gen[ens][slot] = self.slot_gen[ens].get(slot, 0) + 1
         self.queues[ens].append(_PendingOp(eng.OP_PUT, slot, handle, fut))
         return fut
 
@@ -192,10 +202,16 @@ class BatchedEnsembleService:
         handle = 0  # 0 = tombstone handle
         op = _PendingOp(eng.OP_PUT, slot, handle, fut)
         self.queues[ens].append(op)
+        gen = self.slot_gen[ens].get(slot, 0)
 
         def recycle(result):
-            if isinstance(result, tuple) and result[0] == "ok":
-                self.key_slot[ens].pop(key, None)
+            # Recycle only if no put re-used this slot after the
+            # delete was queued (a later committed write would be
+            # orphaned) and the key still owns it (double-delete).
+            if isinstance(result, tuple) and result[0] == "ok" \
+                    and self.slot_gen[ens].get(slot, 0) == gen \
+                    and self.key_slot[ens].get(key) == slot:
+                del self.key_slot[ens][key]
                 self.free_slots[ens].append(slot)
         fut.add_waiter(recycle)
         return fut
@@ -380,6 +396,14 @@ class BatchedEnsembleService:
                 served += 1
                 if op.kind == eng.OP_PUT:
                     if committed[j, e]:
+                        # Release the payload this write superseded
+                        # (rounds resolve in device order, so the last
+                        # committed handle per slot survives).
+                        old = self.slot_handle[e].pop(op.slot, 0)
+                        if old and old != op.handle:
+                            self.values.pop(old, None)
+                        if op.handle:
+                            self.slot_handle[e][op.slot] = op.handle
                         op.fut.resolve(("ok", (int(vsn[j, e, 0]),
                                                int(vsn[j, e, 1]))))
                     else:

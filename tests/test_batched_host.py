@@ -180,3 +180,36 @@ def test_service_composes_with_sharded_engine():
     assert (np.asarray(svc.state.leader) != leaders).all()
     for e in range(8):
         assert settle(runtime, svc.kget(e, "k")) == ("ok", f"v{e}".encode())
+
+
+def test_delete_then_put_same_flush_keeps_put():
+    """A delete and a later put for the same key riding one flush:
+    the delete's deferred slot recycle must NOT free the slot the put
+    re-wrote, or the committed put becomes unreachable (found by the
+    service linearizability sweep, seed 702)."""
+    runtime, svc = make_service(n_ens=1, n_slots=4)
+    assert settle(runtime, svc.kput(0, "k", b"v1"))[0] == "ok"
+    fd = svc.kdelete(0, "k")
+    fp = svc.kput(0, "k", b"v2")
+    assert settle(runtime, fd)[0] == "ok"
+    assert settle(runtime, fp)[0] == "ok"
+    assert settle(runtime, svc.kget(0, "k")) == ("ok", b"v2")
+    # and a lone delete still recycles its slot
+    assert settle(runtime, svc.kdelete(0, "k"))[0] == "ok"
+    assert settle(runtime, svc.kget(0, "k")) == ("ok", NOTFOUND)
+    assert len(svc.free_slots[0]) == 4
+
+
+def test_committed_overwrites_release_payloads():
+    """The host payload store must not grow per committed overwrite or
+    delete — superseded handles are released when the new write
+    commits."""
+    runtime, svc = make_service(n_ens=1, n_slots=4)
+    for i in range(20):
+        assert settle(runtime, svc.kput(0, "k", b"v%d" % i))[0] == "ok"
+    assert settle(runtime, svc.kget(0, "k")) == ("ok", b"v19")
+    assert len(svc.values) <= 2, len(svc.values)
+    assert settle(runtime, svc.kdelete(0, "k"))[0] == "ok"
+    for i in range(10):
+        assert settle(runtime, svc.kput(0, "x", b"x%d" % i))[0] == "ok"
+    assert len(svc.values) <= 2, len(svc.values)

@@ -1,0 +1,143 @@
+"""sc.erl-analog linearizability check for the BATCHED SERVICE path.
+
+The scalar actor stack has its own workload checker
+(test_linearizability.py); this one drives the same plausible-value
+model (test/sc.erl get_post:112-148, prop_sc:835-880 postconditions)
+against :class:`BatchedEnsembleService` — the engine-backed scale path
+— under an up-mask nemesis: the leader is killed between enqueue and
+flush (so the election folds into the same launch that carries the
+ops), peers flap, and virtual time jumps past the lease so reads race
+lease expiry.  Every seed is a reproducible schedule.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from riak_ensemble_tpu.config import fast_test_config  # noqa: E402
+from riak_ensemble_tpu.linearizability import KeyModel  # noqa: E402
+from riak_ensemble_tpu.parallel.batched_host import (  # noqa: E402
+    BatchedEnsembleService,
+)
+from riak_ensemble_tpu.runtime import Runtime  # noqa: E402
+from riak_ensemble_tpu.types import NOTFOUND  # noqa: E402
+
+N_ENS = 6
+N_PEERS = 5
+N_KEYS = 3
+ROUNDS = 35
+
+
+def _drain(svc, runtime, pending, max_flushes=10):
+    """Flush until every submitted future resolves (queued ops past
+    max_ops_per_tick ride later launches)."""
+    for _ in range(max_flushes):
+        if all(fut.done for _, _, _, fut, _ in pending):
+            return
+        svc.flush()
+        runtime.run_for(0.001)
+    raise AssertionError("ops never resolved")
+
+
+def _apply_outcomes(pending):
+    """Feed resolutions to the models in resolution (= device round)
+    order.  Put/delete acks are linearization points; 'failed' after a
+    flush is an unknown outcome (the op may have partially landed in a
+    later retry window) -> stays plausible, exactly like a timeout in
+    sc.erl."""
+    for kind, model, op_id, fut, _payload in pending:
+        r = fut.value
+        if kind in ("put", "del"):
+            if isinstance(r, tuple) and r[0] == "ok":
+                model.ack_write(op_id)
+            else:
+                model.timeout_write(op_id)
+        else:  # get
+            if isinstance(r, tuple) and r[0] == "ok":
+                model.ack_read(r[1])
+            # 'failed' read returned nothing: no model event
+
+
+@pytest.mark.parametrize("seed", [701, 702, 703, 704, 705, 706])
+def test_service_linearizable_under_nemesis(seed):
+    rng = np.random.default_rng(seed)
+    runtime = Runtime(seed=seed)
+    config = fast_test_config()
+    svc = BatchedEnsembleService(runtime, N_ENS, N_PEERS, n_slots=8,
+                                 tick=None, max_ops_per_tick=8,
+                                 config=config)
+    models = {(e, k): KeyModel(f"{e}/key{k}")
+              for e in range(N_ENS) for k in range(N_KEYS)}
+    vals = itertools.count(1)
+    down = {}  # ens -> peer index currently down
+
+    for _round in range(ROUNDS):
+        # -- nemesis: up-mask churn --------------------------------------
+        r = rng.random()
+        if r < 0.25 and down:
+            # heal a random downed peer
+            e = list(down)[int(rng.integers(len(down)))]
+            svc.set_peer_up(e, down.pop(e), True)
+        elif r < 0.55:
+            # kill the CURRENT LEADER of a random ensemble right
+            # before the flush that carries this round's ops — the
+            # election folds into the same launch (mid-flush kill)
+            e = int(rng.integers(N_ENS))
+            if e not in down and svc.leader_np[e] >= 0:
+                p = int(svc.leader_np[e])
+                svc.set_peer_up(e, p, False)
+                down[e] = p
+
+        # -- submit a concurrent batch -----------------------------------
+        pending = []
+        for _ in range(int(rng.integers(2, 8))):
+            e = int(rng.integers(N_ENS))
+            k = int(rng.integers(N_KEYS))
+            m = models[(e, k)]
+            key = f"key{k}"
+            op = rng.random()
+            if op < 0.5:
+                payload = f"{seed}-{next(vals)}".encode()
+                op_id = m.invoke_write(payload)
+                fut = svc.kput(e, key, payload)
+                if fut.done and fut.value == "failed":
+                    # pre-flush rejection (no slot): definitely a no-op
+                    m.fail_write(op_id)
+                else:
+                    pending.append(("put", m, op_id, fut, payload))
+            elif op < 0.85:
+                pending.append(("get", m, None, svc.kget(e, key), None))
+            else:
+                op_id = m.invoke_write(NOTFOUND)
+                fut = svc.kdelete(e, key)
+                if fut.done:
+                    # no slot -> nothing to delete: an immediate ack of
+                    # the NOTFOUND state
+                    m.ack_write(op_id)
+                else:
+                    pending.append(("del", m, op_id, fut, None))
+
+        # -- lease expiry race: sometimes jump virtual time past the
+        #    lease before flushing, so leased reads race renewal ------
+        if rng.random() < 0.3:
+            runtime.run_for(config.lease() * 2.5)
+        _drain(svc, runtime, pending)
+        _apply_outcomes(pending)
+
+    # -- quiesce + no-data-loss read-back (prop_sc:835-880) -------------
+    for e, p in list(down.items()):
+        svc.set_peer_up(e, p, True)
+    svc.flush()  # fold in any pending elections
+    pending = []
+    for (e, k), m in models.items():
+        pending.append(("get", m, None, svc.kget(e, f"key{k}"), None))
+    _drain(svc, runtime, pending)
+    _apply_outcomes(pending)  # raises Violation on stale/lost reads
+
+    served = sum(1 for m in models.values()
+                 for ev in m.history if ev[0] == "read")
+    assert served >= len(models), "quiesced read-back did not complete"
+    assert svc.flushes >= ROUNDS
