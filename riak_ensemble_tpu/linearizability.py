@@ -93,6 +93,15 @@ class KeyModel:
         w = self.inflight.pop(op_id)
         # Linearization point inside the op window: state is now w's
         # value; in-flight concurrent writes may serialize after it.
+        # (An earlier-completed read's pinned value X stays plausible
+        # only if something could make X current after this write —
+        # an in-flight or timed-out write of X, which `inflight`/
+        # `maybe` already cover.  MODEL ASSUMPTION: read results are
+        # fed to ack_read in a serialization-consistent order — a read
+        # must not be reported after an overlapping write's ack if it
+        # linearized before that write.  Both harness drivers satisfy
+        # this: the batched service resolves in device round order and
+        # the actor stack serializes same-key ops through one worker.)
         self.possible = {w.value} | self._inflight_values()
         self.history.append(("ack", op_id, w.value))
 
@@ -113,12 +122,12 @@ class KeyModel:
         value = _val(value)
         valid = self.possible | self._inflight_values() | self.maybe
         if value not in valid:
+            what = ("DATA LOSS (notfound read, but a write must be "
+                    "visible)" if value is NOTFOUND else "stale/phantom "
+                    "read")
             raise Violation(
-                f"read of {self.key!r} returned {value!r}; plausible "
+                f"{what} of {self.key!r}: returned {value!r}; plausible "
                 f"was {valid!r}\nhistory tail: {self.history[-12:]}")
-        if value is NOTFOUND and NOTFOUND not in valid:
-            raise Violation(f"DATA LOSS on {self.key!r}: notfound read "
-                            f"but a write must be visible")
         # A linearizable read pins the state (timed-out writes may
         # still land later, so `maybe` persists).
         self.possible = {value} | self._inflight_values()

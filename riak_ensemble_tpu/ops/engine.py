@@ -63,6 +63,7 @@ version discipline, which is what consensus is about.
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -74,6 +75,12 @@ from riak_ensemble_tpu.ops import quorum as quorum_lib
 from riak_ensemble_tpu.ops.quorum import (
     quorum_met_batch, reduce_peers, views_to_mask,
 )
+
+#: opt-in: run the engine's quorum reduce as the Pallas kernel
+#: (ops/pallas_quorum.quorum_met_epallas) instead of the jnp chain.
+#: Single-shard launches only — the sharded (axis_name) path keeps the
+#: psum collectives.
+PALLAS_QUORUM = os.environ.get("RETPU_PALLAS_QUORUM", "") == "1"
 
 # Op kinds for kv_step.
 OP_NOOP = 0
@@ -309,7 +316,15 @@ def _quorum_met(ack: jax.Array, heard: jax.Array, view_mask: jax.Array,
     is already included, so self_idx=-1); heard [E, Ml] bool (up
     members — heard-but-not-acking peers are nacks); view_mask
     [E, V, Ml] bool -> [E] bool.
+
+    With ``RETPU_PALLAS_QUORUM=1`` (and no peer-axis sharding) the
+    reduce runs as the Pallas kernel — differentially tested against
+    this path.
     """
+    if PALLAS_QUORUM and axis_name is None and ack.ndim == 2:
+        from riak_ensemble_tpu.ops.pallas_quorum import quorum_met_epallas
+        res = quorum_met_epallas(ack, heard & ~ack, view_mask)
+        return res == quorum_lib.MET
     res = quorum_met_batch(
         ack, heard & ~ack, view_mask,
         jnp.full(ack.shape[:-1], -1, jnp.int32),

@@ -135,11 +135,20 @@ def update(levels: Levels, seg_ids: jnp.ndarray,
     hashes, then per level recompute only the K touched parents by
     gathering their ``width`` children — O(K · width · height) work
     regardless of tree size.  Duplicate parents recompute identically,
-    so the scatter is idempotent.
+    so the parent scatter is idempotent.
+
+    Duplicate ``seg_ids`` in one batch are LAST-WRITE-WINS (the batch
+    is a sequence of inserts): JAX leaves duplicate-index scatter order
+    unspecified, so every duplicate is redirected to the value of its
+    final occurrence before scattering (O(K²) index compare — K is a
+    few thousand; the hashing dominates).
     """
     out = list(levels)
     depth = len(levels) - 1  # leaf level index
-    out[depth] = out[depth].at[seg_ids].set(new_leaves)
+    k = seg_ids.shape[0]
+    eq = seg_ids[None, :] == seg_ids[:, None]            # [K, K]
+    last_occ = jnp.max(jnp.where(eq, jnp.arange(k)[None, :], -1), axis=1)
+    out[depth] = out[depth].at[seg_ids].set(new_leaves[last_occ])
     ids = seg_ids
     for level in range(depth - 1, -1, -1):
         parent_ids = ids // width

@@ -38,20 +38,11 @@ from riak_ensemble_tpu.ops.quorum import MET, NACK, REQUIRED_MODES, UNDECIDED
 LANE = 128
 
 
-def _kernel(votes_ref, nacks_ref, vmt_ref, members_ref, thresh_ref,
-            active_ref, out_ref):
-    votes = votes_ref[:]          # [BE, Mp] f32 (valid + self term)
-    nacks = nacks_ref[:]          # [BE, Mp] f32
-    vmt = vmt_ref[:]              # [Mp, Vp] f32 view membership
-    members = members_ref[:]      # [1, Vp]
-    thresh = thresh_ref[:]        # [1, Vp]
-    active = active_ref[:]        # [1, Vp] (1.0 = real view)
-
-    # MXU: per-view vote counts for the whole ensemble block at once.
-    heard = jnp.dot(votes, vmt, preferred_element_type=jnp.float32)
-    n_nack = jnp.dot(nacks, vmt, preferred_element_type=jnp.float32)
-
-    is_active = active > 0.0
+def _resolve(heard, n_nack, members, thresh, is_active, out_ref):
+    """Shared kernel tail: threshold + joint-view AND + in-order
+    first-unmet nack — the subtle half of the quorum semantics
+    (msg.erl:377-418's recursion), written once for both the shared-
+    and per-ensemble-mask front ends."""
     met_v = (heard >= thresh) | ~is_active                  # [BE, Vp]
     nack_v = ((n_nack >= thresh) | (heard + n_nack == members)) \
         & is_active
@@ -70,6 +61,21 @@ def _kernel(votes_ref, nacks_ref, vmt_ref, members_ref, thresh_ref,
                     jnp.where(unmet_nacked > 0, NACK, UNDECIDED))
     out_ref[:] = jnp.broadcast_to(res[:, None].astype(jnp.int32),
                                   out_ref.shape)
+
+
+def _kernel(votes_ref, nacks_ref, vmt_ref, members_ref, thresh_ref,
+            active_ref, out_ref):
+    votes = votes_ref[:]          # [BE, Mp] f32 (valid + self term)
+    nacks = nacks_ref[:]          # [BE, Mp] f32
+    vmt = vmt_ref[:]              # [Mp, Vp] f32 view membership
+    members = members_ref[:]      # [1, Vp]
+    thresh = thresh_ref[:]        # [1, Vp]
+    active = active_ref[:]        # [1, Vp] (1.0 = real view)
+
+    # MXU: per-view vote counts for the whole ensemble block at once.
+    heard = jnp.dot(votes, vmt, preferred_element_type=jnp.float32)
+    n_nack = jnp.dot(nacks, vmt, preferred_element_type=jnp.float32)
+    _resolve(heard, n_nack, members, thresh, active > 0.0, out_ref)
 
 
 @functools.partial(jax.jit,
@@ -134,4 +140,72 @@ def quorum_met_pallas(valid: jax.Array, nack: jax.Array,
         out_shape=jax.ShapeDtypeStruct((ep, LANE), jnp.int32),
         interpret=interpret,
     )(votes, nacks, vmt, members_p, thresh_p, active_p)
+    return out[:e, 0].astype(jnp.int8)
+
+
+# ---------------------------------------------------------------------------
+# Per-ensemble view masks (the engine's state layout)
+
+
+_SUB = 8  # f32 sublane tile: pad the view axis to it
+
+
+def _ekernel(votes_ref, nacks_ref, mask_ref, out_ref):
+    """Per-ensemble variant: each ensemble carries its own ``[V, M]``
+    membership (reconfigs diverge them), so vote counting is a fused
+    broadcast-multiply-reduce over the peer lanes instead of one shared
+    MXU matmul, with the threshold derived in-kernel; the resolve tail
+    is shared with :func:`_kernel`."""
+    votes = votes_ref[:]          # [BE, Mp] f32
+    nacks = nacks_ref[:]          # [BE, Mp] f32
+    mask = mask_ref[:]            # [BE, Vp, Mp] f32
+
+    heard = jnp.sum(mask * votes[:, None, :], axis=2)       # [BE, Vp]
+    n_nack = jnp.sum(mask * nacks[:, None, :], axis=2)
+    members = jnp.sum(mask, axis=2)
+    thresh = jnp.floor(members * 0.5) + 1.0
+    _resolve(heard, n_nack, members, thresh, members > 0.0, out_ref)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_e", "interpret"))
+def quorum_met_epallas(valid: jax.Array, nack: jax.Array,
+                       view_mask: jax.Array, block_e: int = 512,
+                       interpret: Optional[bool] = None) -> jax.Array:
+    """Pallas form of the ENGINE's quorum predicate: ``required=
+    "quorum"``, no self term (the leader's vote is already folded into
+    ``valid``), per-ensemble ``view_mask [E, V, M]``.  Drop-in for
+    ``quorum_met_batch(valid, nack, view_mask, self_idx=-1,
+    required="quorum", axis_name=None)``; returns int8 ``[E]``.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    e, m = valid.shape
+    assert view_mask.ndim == 3 and view_mask.shape[0] == e \
+        and view_mask.shape[2] == m, view_mask.shape
+    v = view_mask.shape[1]
+    assert m <= LANE and v <= _SUB, "peer/view axes exceed one tile"
+
+    ep = -(-e // block_e) * block_e
+    votes = jnp.pad(valid.astype(jnp.float32),
+                    ((0, ep - e), (0, LANE - m)))
+    nacks = jnp.pad(nack.astype(jnp.float32),
+                    ((0, ep - e), (0, LANE - m)))
+    # Padded views have zero members → inactive → always met.
+    mask = jnp.pad(view_mask.astype(jnp.float32),
+                   ((0, ep - e), (0, _SUB - v), (0, LANE - m)))
+
+    grid = (ep // block_e,)
+    out = pl.pallas_call(
+        _ekernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_e, LANE), lambda i: (i, 0)),
+            pl.BlockSpec((block_e, LANE), lambda i: (i, 0)),
+            pl.BlockSpec((block_e, _SUB, LANE), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_e, LANE), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((ep, LANE), jnp.int32),
+        interpret=interpret,
+    )(votes, nacks, mask)
     return out[:e, 0].astype(jnp.int8)

@@ -103,6 +103,10 @@ class _PendingOp:
     slot: int
     handle: int
     fut: Future
+    key: Any = None
+    #: slot write generation at enqueue (puts only) — lets the failed
+    #: path tell whether it was the slot's last queued write
+    gen: int = 0
 
 
 class BatchedEnsembleService:
@@ -148,6 +152,11 @@ class BatchedEnsembleService:
         #: handle from ``values`` (otherwise the store grows forever)
         self.slot_handle: List[Dict[int, int]] = [
             dict() for _ in range(n_ens)]
+        #: deferred slot recycles: (key, slot, gen) waiting until no
+        #: queued op still references the slot (an overflowed get must
+        #: never read a recycled slot another key re-used)
+        self._recycle_pending: List[List[Tuple[Any, int, int]]] = [
+            [] for _ in range(n_ens)]
         #: payload store: handle -> value (device carries handles)
         self.values: Dict[int, Any] = {}
         self.queues: List[List[_PendingOp]] = [[] for _ in range(n_ens)]
@@ -177,8 +186,10 @@ class BatchedEnsembleService:
             return fut
         handle = next(_handles) & 0x7FFFFFFF
         self.values[handle] = value
-        self.slot_gen[ens][slot] = self.slot_gen[ens].get(slot, 0) + 1
-        self.queues[ens].append(_PendingOp(eng.OP_PUT, slot, handle, fut))
+        gen = self.slot_gen[ens].get(slot, 0) + 1
+        self.slot_gen[ens][slot] = gen
+        self.queues[ens].append(
+            _PendingOp(eng.OP_PUT, slot, handle, fut, key, gen))
         return fut
 
     def kget(self, ens: int, key: Any) -> Future:
@@ -205,14 +216,8 @@ class BatchedEnsembleService:
         gen = self.slot_gen[ens].get(slot, 0)
 
         def recycle(result):
-            # Recycle only if no put re-used this slot after the
-            # delete was queued (a later committed write would be
-            # orphaned) and the key still owns it (double-delete).
-            if isinstance(result, tuple) and result[0] == "ok" \
-                    and self.slot_gen[ens].get(slot, 0) == gen \
-                    and self.key_slot[ens].get(key) == slot:
-                del self.key_slot[ens][key]
-                self.free_slots[ens].append(slot)
+            if isinstance(result, tuple) and result[0] == "ok":
+                self._recycle_pending[ens].append((key, slot, gen))
         fut.add_waiter(recycle)
         return fut
 
@@ -236,6 +241,29 @@ class BatchedEnsembleService:
         slot = self.free_slots[ens].pop()
         self.key_slot[ens][key] = slot
         return slot
+
+    def _drain_recycles(self) -> None:
+        """Free slots whose recycle was deferred, once nothing queued
+        references them and the conditions still hold: no later put
+        bumped the generation, nothing live is committed, and the key
+        still owns the slot."""
+        for e in range(self.n_ens):
+            pend = self._recycle_pending[e]
+            if not pend:
+                continue
+            busy = {op.slot for op in self.queues[e]}
+            keep = []
+            for key, slot, gen in pend:
+                if slot in busy:
+                    keep.append((key, slot, gen))
+                elif self.slot_gen[e].get(slot, 0) == gen \
+                        and self.slot_handle[e].get(slot, 0) == 0 \
+                        and self.key_slot[e].get(key) == slot:
+                    del self.key_slot[e][key]
+                    self.free_slots[e].append(slot)
+                # else: the slot was re-used meanwhile — drop the stale
+                # recycle request
+            self._recycle_pending[e] = keep
 
     def _schedule(self) -> None:
         if self.tick is None:
@@ -408,6 +436,15 @@ class BatchedEnsembleService:
                                                int(vsn[j, e, 1]))))
                     else:
                         self.values.pop(op.handle, None)
+                        # A failed put that was the slot's last queued
+                        # write may leave it holding nothing committed
+                        # (fresh slot, or a tombstone whose delete-side
+                        # recycle was skipped because this put bumped
+                        # the generation): queue it for recycling or
+                        # the slot leaks until the key is deleted.
+                        if op.key is not None:
+                            self._recycle_pending[e].append(
+                                (op.key, op.slot, op.gen))
                         op.fut.resolve("failed")
                 else:
                     if get_ok[j, e]:
@@ -420,4 +457,5 @@ class BatchedEnsembleService:
                     else:
                         op.fut.resolve("failed")
         self.ops_served += served
+        self._drain_recycles()
         return served

@@ -168,6 +168,23 @@ class Network:
         self.drop_hook: Optional[Callable[[str, Any, Any], bool]] = None
         self.min_latency = 1e-4
         self.max_latency = 5e-4
+        #: adversarial reorder window (chaos mode); 0 = off
+        self.chaos_window = 0.0
+        self.chaos_local = 0.0
+
+    def chaos(self, window: float = 0.05, local: float = 0.0) -> None:
+        """PULSE-analog delivery permutation: every cross-node message
+        gets an independent uniform delay in ``[0, window)``, so any
+        two messages in flight within the window can deliver in either
+        order — the seeded RNG makes each seed one reproducible total
+        order of deliveries.  ``local`` adds the same treatment to
+        same-node sends (stronger than Erlang, which guarantees
+        per-pair signal order; protocols gated on reqids must still
+        converge).  The window should dwarf max_latency and stay well
+        under the protocol timeouts (tick/lease) or chaos turns into
+        blanket message loss."""
+        self.chaos_window = window
+        self.chaos_local = local
 
     def partition(self, group_a: List[str], group_b: List[str]) -> None:
         """Cut all links between two node groups (sc.erl:1012-1022)."""
@@ -182,7 +199,14 @@ class Network:
         return src == dst or frozenset((src, dst)) not in self.cut_links
 
     def latency(self) -> float:
+        if self.chaos_window > 0.0:
+            return self.runtime.rng.uniform(0.0, self.chaos_window)
         return self.runtime.rng.uniform(self.min_latency, self.max_latency)
+
+    def local_latency(self) -> float:
+        if self.chaos_local > 0.0:
+            return self.runtime.rng.uniform(0.0, self.chaos_local)
+        return 0.0
 
 
 class Runtime:
@@ -307,7 +331,8 @@ class Runtime:
         if self.net.drop_hook is not None and \
                 self.net.drop_hook(src_node, dst, msg):
             return
-        delay = 0.0 if dst_node == src_node else self.net.latency()
+        delay = self.net.local_latency() if dst_node == src_node \
+            else self.net.latency()
         self.send_after(delay, dst, msg)
 
     def spawn_task(self, gen: Generator, name: str = "task") -> Task:

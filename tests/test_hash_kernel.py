@@ -124,3 +124,23 @@ def test_million_segment_build_compiles():
     leaf_mask = np.asarray(
         hashk.diff_levels(levels, updated)[-1])
     assert set(np.nonzero(leaf_mask)[0]) == set(np.asarray(ids).tolist())
+
+
+def test_update_duplicate_seg_ids_last_write_wins():
+    """A batch with duplicate segment ids is a sequence of inserts:
+    the final occurrence must win deterministically (JAX scatter order
+    with duplicates is otherwise unspecified)."""
+    segs = 16 ** 2
+    leaves = jnp.zeros((segs, hashk.LANES), jnp.uint32)
+    levels = hashk.build(leaves, width=16)
+    ids = jnp.asarray([7, 3, 7, 7, 3])
+    rng = np.random.default_rng(5)
+    new = jnp.asarray(rng.integers(0, 2 ** 32, (5, hashk.LANES),
+                                   dtype=np.uint32))
+    got = hashk.update(levels, ids, new, width=16)
+    # sequential oracle
+    want = levels
+    for i in range(5):
+        want = hashk.update(want, ids[i:i + 1], new[i:i + 1], width=16)
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

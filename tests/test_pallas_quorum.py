@@ -65,3 +65,72 @@ def test_pallas_block_padding():
     got = np.asarray(quorum_met_pallas(valid, nack, mask, self_idx,
                                        block_e=256))
     np.testing.assert_array_equal(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# Per-ensemble-mask kernel (the engine's quorum path under
+# RETPU_PALLAS_QUORUM=1)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_epallas_matches_reference(seed):
+    from riak_ensemble_tpu.ops.pallas_quorum import quorum_met_epallas
+
+    rng = np.random.default_rng(seed)
+    e, v, m = 37, 3, 7
+    valid = jnp.asarray(rng.random((e, m)) < 0.55)
+    nack = jnp.asarray((rng.random((e, m)) < 0.3)) & ~valid
+    mask = rng.random((e, v, m)) < 0.6
+    mask[:, 0, :] |= ~mask[:, 0, :].any(-1, keepdims=True)  # view 0 active
+    if seed == 2:
+        mask[:, 2, :] = False  # padded (inactive) trailing view
+    mask = jnp.asarray(mask)
+
+    ref = quorum_met_batch(valid, nack, mask,
+                           jnp.full((e,), -1, jnp.int32),
+                           required="quorum")
+    got = quorum_met_epallas(valid, nack, mask)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_engine_flag_gated_pallas_equivalence():
+    """RETPU_PALLAS_QUORUM=1 must not change any engine result: run a
+    full protocol slice (elect, puts/gets with a down peer, reconfig)
+    with the flag off and on and compare everything."""
+    import jax as _jax
+
+    from riak_ensemble_tpu.ops import engine as eng
+
+    e, m, s, k = 16, 5, 8, 3
+
+    def scenario():
+        state = eng.init_state(e, m, s, views=[list(range(m))])
+        up = jnp.ones((e, m), bool)
+        yes = jnp.ones((e,), bool)
+        state, won = eng.elect_step(state, yes,
+                                    jnp.zeros((e,), jnp.int32), up)
+        kind = jnp.asarray(np.stack([np.full(e, eng.OP_PUT),
+                                     np.full(e, eng.OP_PUT),
+                                     np.full(e, eng.OP_GET)]), jnp.int32)
+        slot = jnp.asarray(np.arange(k * e).reshape(k, e) % s, jnp.int32)
+        val = jnp.asarray(1 + np.arange(k * e).reshape(k, e), jnp.int32)
+        lease = jnp.ones((k, e), bool)
+        up2 = up.at[:, 0].set(False)
+        state, res = eng.kv_step_scan(state, kind, slot, val, lease, up2)
+        nv = jnp.asarray(np.tile(np.arange(m) < m - 1, (e, 1)))
+        state, inst, _ = eng.reconfig_step(state, yes, nv, up2)
+        return won, res, inst, state
+
+    try:
+        eng.PALLAS_QUORUM = False
+        _jax.clear_caches()
+        base = scenario()
+        eng.PALLAS_QUORUM = True
+        _jax.clear_caches()
+        flagged = scenario()
+    finally:
+        eng.PALLAS_QUORUM = False
+        _jax.clear_caches()
+
+    for a, b in zip(_jax.tree.leaves(base), _jax.tree.leaves(flagged)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -2,16 +2,27 @@
 
 The reference hooks Quviq PULSE to explore message interleavings
 (pulse_replace_module, peer.erl:56-57; SURVEY §5).  Our deterministic
-seeded runtime provides the same lever: every seed is a different —
-but reproducible — total order of message deliveries and timer
-firings, and widening the latency band widens the reordering window.
-This sweep runs the core failover scenario across many schedules; any
-failing seed is a reproducible race.
+seeded runtime provides the same lever twice over:
+
+- every seed is a different — but reproducible — total order of
+  message deliveries and timer firings;
+- ``Network.chaos`` is the adversarial delivery-order permuter: each
+  cross-node message gets an independent uniform delay inside a
+  window that dwarfs normal latency (and optionally same-node sends
+  get the same treatment, which is STRONGER reordering than Erlang's
+  per-pair signal order), so any two in-flight messages can deliver
+  in either order.
+
+The sweep runs four scenarios — leader failover, membership churn
+under load, synctree corruption + exchange, and read-path CAS races —
+across seeds × chaos windows.  Any failing seed is a reproducible
+race.
 """
 
 import pytest
 
-from riak_ensemble_tpu.testing import Cluster, make_peers
+from riak_ensemble_tpu.testing import Cluster, ManagedCluster, make_peers
+from riak_ensemble_tpu.types import NOTFOUND, PeerId
 
 
 @pytest.mark.parametrize("seed", range(60, 76))
@@ -38,3 +49,113 @@ def test_failover_under_schedule_fuzzing(seed):
     c.runtime.run_for(2.0)
     c.kput_ok("ens", "k", b"v2")
     assert c.kget_value("ens", "k") == b"v2"
+
+
+@pytest.mark.parametrize("seed", range(80, 88))
+def test_failover_under_chaos_permuter(seed):
+    """The failover story again, but with the true permuter on: a
+    20 ms reorder window (vs 0.5 ms normal latency, under the 50 ms
+    tick) plus same-node send jitter."""
+    c = Cluster(seed=seed)
+    c.runtime.net.chaos(window=0.02, local=0.002)
+    peers = make_peers(3)
+    c.create_ensemble("ens", peers)
+    leader = c.wait_stable("ens")
+
+    c.kput_ok("ens", "k", b"v1")
+    c.suspend_peer("ens", leader)
+    assert c.runtime.run_until(
+        lambda: c.leader_id("ens") not in (None, leader), 60.0), \
+        f"seed {seed}"
+    c.wait_stable("ens")
+    assert c.kget_value("ens", "k") == b"v1"
+    c.resume_peer("ens", leader)
+    c.runtime.run_for(2.0)
+    c.kput_ok("ens", "k", b"v2")
+    assert c.kget_value("ens", "k") == b"v2"
+
+
+@pytest.mark.parametrize("seed", range(90, 96))
+def test_membership_churn_under_chaos(seed):
+    """update_members add→remove cycles racing client writes with the
+    permuter on: the joint-consensus dance (pending/views vsns, the
+    manager-driven peer starts) must converge under arbitrary
+    vote/commit reordering."""
+    mc = ManagedCluster(seed=seed)
+    mc.runtime.net.chaos(window=0.01, local=0.001)
+    mc.ens_start(3)
+    extra = PeerId(4, mc.node0)
+    assert mc.kput("k", b"v0")[0] == "ok"
+
+    base = [PeerId("root", mc.node0), PeerId(2, mc.node0),
+            PeerId(3, mc.node0)]
+    for i in range(2):
+        r = mc.update_members("root", [("add", extra)])
+        assert r == "ok", (seed, i, r)
+        mc.wait_members("root", base + [extra])
+        mc.wait_stable("root")
+        assert mc.kput("k", b"v%d" % i)[0] == "ok"
+        r = mc.update_members("root", [("del", extra)])
+        assert r == "ok", (seed, i, r)
+        assert mc.runtime.run_until(
+            lambda: extra not in mc.mgr(mc.node0).get_members("root"),
+            60.0, poll=0.1), (seed, i, "del never transitioned")
+        mc.wait_stable("root")
+        r = mc.kget("k")
+        assert r[0] == "ok" and r[1].value == b"v%d" % i, (seed, i, r)
+
+
+@pytest.mark.parametrize("seed", range(100, 106))
+def test_corruption_exchange_under_chaos(seed):
+    """Synctree corruption detected and healed while the exchange's
+    level-batched round trips are being reordered by the permuter; the
+    reads must never surface notfound for a committed key
+    (corrupt_segment_test postcondition)."""
+    mc = ManagedCluster(seed=seed)
+    mc.runtime.net.chaos(window=0.01, local=0.001)
+    mc.ens_start(3)
+    assert mc.kput("corrupt", b"test")[0] == "ok"
+    leader = mc.wait_leader("root")
+    mc.tree_of("root", leader).tree.corrupt("corrupt")
+
+    def never_notfound():
+        r = mc.kget("corrupt")
+        if r[0] == "ok":
+            assert r[1].value is not NOTFOUND, f"seed {seed}: notfound"
+            return r[1].value == b"test"
+        return False
+    assert mc.runtime.run_until(never_notfound, 60.0), f"seed {seed}"
+
+
+@pytest.mark.parametrize("seed", range(110, 116))
+def test_read_path_cas_races_under_chaos(seed):
+    """Interleaved CAS updates, deletes, and reads with the permuter
+    on and a mid-run leader freeze: every CAS outcome must be
+    ok/failed (no hangs), and the final read must return the last
+    acked write."""
+    c = Cluster(seed=seed)
+    c.runtime.net.chaos(window=0.015, local=0.001)
+    peers = make_peers(3)
+    c.create_ensemble("ens", peers)
+    leader = c.wait_stable("ens")
+
+    c.kput_ok("ens", "k", b"v0")
+    last = b"v0"
+    for i in range(1, 6):
+        if i == 3:
+            c.suspend_peer("ens", leader)
+            assert c.runtime.run_until(
+                lambda: c.leader_id("ens") not in (None, leader), 60.0)
+            c.wait_stable("ens")
+        r = c.kget("ens", "k")
+        assert r[0] == "ok", (seed, i, r)
+        cur = r[1]
+        out = c.kupdate("ens", "k", cur, b"v%d" % i)
+        if isinstance(out, tuple) and out[0] == "ok":
+            last = b"v%d" % i
+        else:
+            assert out in ("failed", "timeout") or out[0] == "error", \
+                (seed, i, out)
+    c.resume_peer("ens", leader)
+    c.wait_stable("ens")
+    assert c.kget_value("ens", "k") == last

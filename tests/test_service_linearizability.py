@@ -44,17 +44,19 @@ def _drain(svc, runtime, pending, max_flushes=10):
 
 def _apply_outcomes(pending):
     """Feed resolutions to the models in resolution (= device round)
-    order.  Put/delete acks are linearization points; 'failed' after a
-    flush is an unknown outcome (the op may have partially landed in a
-    later retry window) -> stays plausible, exactly like a timeout in
-    sc.erl."""
+    order.  Put/delete acks are linearization points; 'failed' is a
+    DEFINITIVE no-op — the engine gates every replica write on the
+    round's quorum commit (_kv_round put_commit), so a failed op can
+    never partially land later.  fail_write keeps the checker strong:
+    a timed-out value would stay plausible forever and mask exactly
+    the stale-read/data-loss signals this sweep exists to catch."""
     for kind, model, op_id, fut, _payload in pending:
         r = fut.value
         if kind in ("put", "del"):
             if isinstance(r, tuple) and r[0] == "ok":
                 model.ack_write(op_id)
             else:
-                model.timeout_write(op_id)
+                model.fail_write(op_id)
         else:  # get
             if isinstance(r, tuple) and r[0] == "ok":
                 model.ack_read(r[1])
