@@ -140,15 +140,17 @@ def update(levels: Levels, seg_ids: jnp.ndarray,
     Duplicate ``seg_ids`` in one batch are LAST-WRITE-WINS (the batch
     is a sequence of inserts): JAX leaves duplicate-index scatter order
     unspecified, so every duplicate is redirected to the value of its
-    final occurrence before scattering (O(K²) index compare — K is a
-    few thousand; the hashing dominates).
+    final occurrence before scattering.  A scatter-max over the segment
+    axis finds that occurrence in O(K + S) (max is order-independent,
+    so it is deterministic under duplicate indices, unlike set).
     """
     out = list(levels)
     depth = len(levels) - 1  # leaf level index
     k = seg_ids.shape[0]
-    eq = seg_ids[None, :] == seg_ids[:, None]            # [K, K]
-    last_occ = jnp.max(jnp.where(eq, jnp.arange(k)[None, :], -1), axis=1)
-    out[depth] = out[depth].at[seg_ids].set(new_leaves[last_occ])
+    last_occ_by_seg = jnp.zeros(out[depth].shape[0], jnp.int32) \
+        .at[seg_ids].max(jnp.arange(k, dtype=jnp.int32))
+    out[depth] = out[depth].at[seg_ids].set(
+        new_leaves[last_occ_by_seg[seg_ids]])
     ids = seg_ids
     for level in range(depth - 1, -1, -1):
         parent_ids = ids // width

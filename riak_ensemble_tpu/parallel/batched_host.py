@@ -26,7 +26,6 @@ flush, amortized over every op in the batch).
 from __future__ import annotations
 
 import functools
-import itertools
 import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
@@ -40,7 +39,6 @@ from riak_ensemble_tpu.ops import engine as eng
 from riak_ensemble_tpu.runtime import Future, Runtime, Timer
 from riak_ensemble_tpu.types import NOTFOUND
 
-_handles = itertools.count(1)
 
 
 @functools.partial(jax.jit, static_argnames=("want_vsn",))
@@ -157,8 +155,14 @@ class BatchedEnsembleService:
         #: never read a recycled slot another key re-used)
         self._recycle_pending: List[List[Tuple[Any, int, int]]] = [
             [] for _ in range(n_ens)]
-        #: payload store: handle -> value (device carries handles)
+        #: payload store: handle -> value (device carries handles).
+        #: Handles are int32 on device and 0 is the tombstone sentinel,
+        #: so released handles are recycled — a monotonically growing
+        #: counter would wrap into live (or tombstone) handles after
+        #: 2^31 puts.
         self.values: Dict[int, Any] = {}
+        self._free_handles: List[int] = []
+        self._next_handle = 1
         self.queues: List[List[_PendingOp]] = [[] for _ in range(n_ens)]
         #: leader leases, host-side: ensemble -> expiry (runtime.now)
         self.lease_until = np.zeros((n_ens,), dtype=float)
@@ -184,7 +188,7 @@ class BatchedEnsembleService:
         if slot is None:
             fut.resolve("failed")
             return fut
-        handle = next(_handles) & 0x7FFFFFFF
+        handle = self._alloc_handle()
         self.values[handle] = value
         gen = self.slot_gen[ens].get(slot, 0) + 1
         self.slot_gen[ens][slot] = gen
@@ -231,6 +235,20 @@ class BatchedEnsembleService:
             self._timer = None
 
     # -- internals ---------------------------------------------------------
+
+    def _alloc_handle(self) -> int:
+        if self._free_handles:
+            return self._free_handles.pop()
+        h = self._next_handle
+        assert h <= 0x7FFFFFFF, "2^31 live payloads cannot fit int32 handles"
+        self._next_handle += 1
+        return h
+
+    def _release_handle(self, handle: int) -> None:
+        """Drop a payload and make its handle reusable (double release
+        is a no-op — the handle returns to the pool once)."""
+        if handle and self.values.pop(handle, None) is not None:
+            self._free_handles.append(handle)
 
     def _slot_for(self, ens: int, key: Any, allocate: bool) -> Optional[int]:
         slot = self.key_slot[ens].get(key)
@@ -428,14 +446,14 @@ class BatchedEnsembleService:
                         # (rounds resolve in device order, so the last
                         # committed handle per slot survives).
                         old = self.slot_handle[e].pop(op.slot, 0)
-                        if old and old != op.handle:
-                            self.values.pop(old, None)
+                        if old != op.handle:
+                            self._release_handle(old)
                         if op.handle:
                             self.slot_handle[e][op.slot] = op.handle
                         op.fut.resolve(("ok", (int(vsn[j, e, 0]),
                                                int(vsn[j, e, 1]))))
                     else:
-                        self.values.pop(op.handle, None)
+                        self._release_handle(op.handle)
                         # A failed put that was the slot's last queued
                         # write may leave it holding nothing committed
                         # (fresh slot, or a tombstone whose delete-side

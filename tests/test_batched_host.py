@@ -213,3 +213,16 @@ def test_committed_overwrites_release_payloads():
     for i in range(10):
         assert settle(runtime, svc.kput(0, "x", b"x%d" % i))[0] == "ok"
     assert len(svc.values) <= 2, len(svc.values)
+
+
+def test_handles_recycled_not_monotonic():
+    """Released payload handles return to a pool (device handles are
+    int32 and 0 is the tombstone; a wrapping counter would eventually
+    alias live handles)."""
+    runtime, svc = make_service(n_ens=1, n_slots=2)
+    for i in range(30):
+        assert settle(runtime, svc.kput(0, "k", b"v%d" % i))[0] == "ok"
+    # 30 committed overwrites but only ~1 live payload: the handle
+    # counter must not have advanced 30 times.
+    assert svc._next_handle <= 4, svc._next_handle
+    assert len(svc.values) <= 2

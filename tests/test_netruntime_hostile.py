@@ -1,0 +1,90 @@
+"""Adversarial transport input: a hostile peer that can reach the node
+port must at worst inject a well-formed protocol message — malformed,
+oversized, deep-nested, or unknown-record frames are dropped and the
+node keeps serving (the codec's no-code-on-decode property end to
+end).  The pickle transport this replaces failed this by design."""
+
+import asyncio
+import struct
+
+import pytest
+
+from riak_ensemble_tpu import wire
+from riak_ensemble_tpu.netruntime import FRAME_HEADER, NetRuntime
+from riak_ensemble_tpu.runtime import Actor
+
+
+class _Sink(Actor):
+    def __init__(self, runtime, name, node):
+        super().__init__(runtime, name, node)
+        self.got = []
+
+    def handle(self, msg):
+        self.got.append(msg)
+
+
+def _frame(payload: bytes) -> bytes:
+    return FRAME_HEADER.pack(len(payload)) + payload
+
+
+HOSTILE = [
+    b"",                                   # empty payload
+    b"\x00" * 64,                          # zero garbage
+    b"Q" + b"\xff" * 32,                   # unknown tag
+    b"R\x7fNN",                            # unknown record code
+    b"t\x01" * 64 + b"N",                  # nesting bomb
+    b"t" + bytes([0x80] * 5 + [0x01]),     # huge claimed count
+    b"s\x02\xff\xff",                      # invalid utf-8 str
+    b"e\x01l\x00",                         # unhashable set member
+    # pickle opcodes (what an old-style attacker would send): must be
+    # rejected as an unknown tag, never evaluated
+    b"\x80\x04\x95n.",
+]
+
+
+def test_hostile_frames_dropped_node_keeps_serving():
+    async def scenario():
+        runtime = NetRuntime("node0", {"node0": ("127.0.0.1", 0)})
+        # Bind an ephemeral port directly (peers map has port 0).
+        runtime.loop = asyncio.get_running_loop()
+        server = await asyncio.start_server(
+            runtime._on_client, "127.0.0.1", 0)
+        runtime._server = server
+        port = server.sockets[0].getsockname()[1]
+
+        sink = _Sink(runtime, ("manager", "node0"), "node0")
+
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        for payload in HOSTILE:
+            writer.write(_frame(payload))
+        # A valid frame after the garbage: the connection (and node)
+        # must still deliver it.
+        ok = wire.encode((("manager", "node0"), ("ping", 42)))
+        writer.write(_frame(ok))
+        await writer.drain()
+
+        for _ in range(200):
+            if sink.got:
+                break
+            await asyncio.sleep(0.01)
+        assert sink.got == [("ping", 42)], sink.got
+
+        # Oversized frame header: connection is closed defensively,
+        # but a fresh connection still works.
+        writer.write(FRAME_HEADER.pack(1 << 31))
+        await writer.drain()
+        writer.close()
+
+        r2, w2 = await asyncio.open_connection("127.0.0.1", port)
+        w2.write(_frame(wire.encode((("manager", "node0"),
+                                     ("ping", 43)))))
+        await w2.drain()
+        for _ in range(200):
+            if len(sink.got) >= 2:
+                break
+            await asyncio.sleep(0.01)
+        assert sink.got[-1] == ("ping", 43), sink.got
+        w2.close()
+        await runtime.stop()
+
+    asyncio.run(scenario())
