@@ -77,6 +77,7 @@ class _LocalEngine:
     full_step = staticmethod(eng.full_step)
     rebuild_trees = staticmethod(eng.rebuild_trees)
     exchange_step = staticmethod(eng.exchange_step)
+    reconfig_step = staticmethod(eng.reconfig_step)
 
 
 class WallRuntime:
@@ -137,6 +138,14 @@ class BatchedEnsembleService:
         #: it issued) — election planning costs zero device round trips
         self.leader_np = np.full((n_ens,), -1, dtype=np.int32)
         self.member_np = np.ones((n_ens, n_peers), dtype=bool)
+        #: membership-change pipeline, host side: a requested change is
+        #: DESIRED until its joint view installs on device, PENDING
+        #: until the joint view collapses, then live in member_np.
+        #: Every update_members call advances whatever is in flight.
+        self._desired_view_np = np.ones((n_ens, n_peers), dtype=bool)
+        self._desired_mask = np.zeros((n_ens,), dtype=bool)
+        self._pending_view_np = np.ones((n_ens, n_peers), dtype=bool)
+        self._pending_mask = np.zeros((n_ens,), dtype=bool)
         #: per-ensemble key→slot and free slots
         self.key_slot: List[Dict[Any, int]] = [dict() for _ in range(n_ens)]
         self.free_slots: List[List[int]] = [
@@ -228,6 +237,87 @@ class BatchedEnsembleService:
     def set_peer_up(self, ens: int, peer: int, up: bool) -> None:
         """Failure-detector input (the host's nodedown/suspend signal)."""
         self.up[ens, peer] = up
+
+    def update_members(self, sel: np.ndarray,
+                       new_view: np.ndarray) -> np.ndarray:
+        """Batched joint-consensus membership change for the selected
+        ensembles — the update_members → transition dance
+        (peer.erl:655-672, 751-774) as two device launches: install
+        the joint view (old AND new quorums gate commits while it
+        holds), then collapse to the new view once the joint quorum
+        confirms.
+
+        sel [E] bool — change these ensembles; new_view [E, M] bool —
+        their new membership (rows of unselected ensembles ignored).
+        Returns ``changed [E]``: ensembles whose membership finished
+        changing during this call (including changes left in flight by
+        an earlier call that completed now).  A change whose install
+        or collapse could not commit yet (no leader, quorum missing)
+        stays in flight and EVERY later call advances it — an
+        all-False ``sel`` makes this a pure retry.  A new request for
+        an ensemble whose previous change is still joint on device is
+        deferred until that change collapses (one change in flight per
+        ensemble, like the reference's single pending views list).
+        Ensembles whose leader left the membership (or was down) get
+        an election folded into the next flush via the host mirrors,
+        exactly like a reference leader shutting down after
+        transitioning itself out (peer.erl:763-771).
+        """
+        jnp = self._jnp
+        sel = np.asarray(sel, bool)
+        new_view = np.asarray(new_view, bool)
+
+        # Record the request; an ensemble already joint on device
+        # keeps its in-flight view until that collapses.
+        accept = sel & ~self._pending_mask
+        self._desired_view_np = np.where(accept[:, None], new_view,
+                                         self._desired_view_np)
+        self._desired_mask = self._desired_mask | accept
+
+        up_j = jnp.asarray(self.up)
+        # Proposing is leader work (leading({update_members,_}),
+        # peer.erl:655): only ensembles with a live leader install —
+        # leaderless ones keep the change desired until a flush's
+        # election gives them one.
+        idx = np.arange(self.n_ens)
+        leader = self.leader_np
+        leader_ok = np.zeros((self.n_ens,), bool)
+        has = leader >= 0
+        leader_ok[has] = self.up[idx[has], leader[has]]
+        propose = self._desired_mask & ~self._pending_mask & leader_ok
+        dv_j = jnp.asarray(self._desired_view_np)
+        state, installed, collapsed1 = self.engine.reconfig_step(
+            self.state, jnp.asarray(propose), dv_j, up_j)
+        state, _, collapsed2 = self.engine.reconfig_step(
+            state, jnp.zeros((self.n_ens,), bool), dv_j, up_j)
+        self.state = state
+        installed_now = propose & np.asarray(installed)
+        # Collapses land in EITHER launch: joint views left over from
+        # earlier calls transition during launch 1 (its ~propose
+        # half), fresh installs during launch 2.
+        collapsed = np.asarray(collapsed1) | np.asarray(collapsed2)
+
+        # Host mirrors.  Installs move desired -> pending; a collapse
+        # promotes its pending view to the live membership.
+        self._pending_view_np = np.where(installed_now[:, None],
+                                         self._desired_view_np,
+                                         self._pending_view_np)
+        self._pending_mask = self._pending_mask | installed_now
+        self._desired_mask = self._desired_mask & ~installed_now
+        changed = self._pending_mask & collapsed
+        self.member_np = np.where(changed[:, None],
+                                  self._pending_view_np, self.member_np)
+        self._pending_mask = self._pending_mask & ~changed
+
+        # A leader no longer in (or not up in) its membership forces
+        # an election on the next flush.
+        still_ok = np.zeros((self.n_ens,), bool)
+        still_ok[has] = self.member_np[idx[has], leader[has]] & \
+            self.up[idx[has], leader[has]]
+        dropped = changed & has & ~still_ok
+        self.leader_np = np.where(dropped, -1, leader)
+        self.lease_until[dropped] = 0.0
+        return changed
 
     def stop(self) -> None:
         if self._timer is not None:

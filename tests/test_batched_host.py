@@ -226,3 +226,130 @@ def test_handles_recycled_not_monotonic():
     # counter must not have advanced 30 times.
     assert svc._next_handle <= 4, svc._next_handle
     assert len(svc.values) <= 2
+
+
+def test_service_update_members_end_to_end():
+    """Membership change through the serving path: shrink 5 -> 3
+    members (dropping the current leader), data survives, the next
+    flush elects a new leader from the surviving membership, and ops
+    keep flowing; then grow back to 5."""
+    runtime, svc = make_service(n_ens=8, n_peers=5, n_slots=8)
+    for e in range(8):
+        assert settle(runtime, svc.kput(e, "k", b"v-%d" % e))[0] == "ok"
+
+    leader0 = svc.leader_np.copy()
+    assert (leader0 >= 0).all()
+    # Drop the leader's peer from every ensemble's membership.
+    new_view = np.ones((8, 5), bool)
+    new_view[np.arange(8), leader0] = False
+    changed = svc.update_members(np.ones(8, bool), new_view)
+    assert changed.all(), changed
+    assert (svc.member_np == new_view).all()
+    # Old leaders were transitioned out -> elections pending.
+    assert (svc.leader_np == -1).all()
+
+    for e in range(8):
+        r = settle(runtime, svc.kget(e, "k"))
+        assert r == ("ok", b"v-%d" % e), (e, r)
+    assert (svc.leader_np >= 0).all()
+    assert np.take_along_axis(new_view, svc.leader_np[:, None],
+                              1).all(), "leader outside new membership"
+
+    # Grow back to the full membership and write through it.
+    changed = svc.update_members(np.ones(8, bool), np.ones((8, 5), bool))
+    assert changed.all()
+    for e in range(8):
+        assert settle(runtime, svc.kput(e, "k", b"w-%d" % e))[0] == "ok"
+        assert settle(runtime, svc.kget(e, "k")) == ("ok", b"w-%d" % e)
+
+
+def test_service_update_members_sharded_engine():
+    """The same membership change composes with ShardedEngine on the
+    virtual mesh."""
+    import jax
+    from riak_ensemble_tpu.parallel.mesh import ShardedEngine, make_mesh
+
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    runtime = Runtime(seed=61)
+    se = ShardedEngine(make_mesh(4, 2))
+    svc = BatchedEnsembleService(runtime, 8, 8, n_slots=8, tick=0.005,
+                                 config=fast_test_config(), engine=se)
+    for e in range(8):
+        assert settle(runtime, svc.kput(e, "k", b"x%d" % e))[0] == "ok"
+    new_view = np.ones((8, 8), bool)
+    new_view[:, 7] = False
+    changed = svc.update_members(np.ones(8, bool), new_view)
+    assert changed.all()
+    for e in range(8):
+        assert settle(runtime, svc.kget(e, "k")) == ("ok", b"x%d" % e)
+
+
+def test_service_skewed_queues():
+    """Heavily skewed load: one ensemble with a deep queue, the rest
+    idle or light — padding rounds must not corrupt idle ensembles'
+    state and every queued op resolves correctly."""
+    runtime, svc = make_service(n_ens=16, n_peers=3, n_slots=8)
+    futs = []
+    for i in range(40):  # deep queue on ensemble 0 (> max_ops_per_tick)
+        futs.append((b"d%d" % i, svc.kput(0, "hot", b"d%d" % i)))
+    light = [(e, svc.kput(e, "cold", b"c%d" % e)) for e in (3, 9)]
+    for _v, f in futs:
+        assert settle(runtime, f)[0] == "ok"
+    for e, f in light:
+        assert settle(runtime, f)[0] == "ok"
+    assert settle(runtime, svc.kget(0, "hot")) == ("ok", b"d39")
+    for e in (3, 9):
+        assert settle(runtime, svc.kget(e, "cold")) == ("ok", b"c%d" % e)
+    for e in (1, 2, 15):
+        assert settle(runtime, svc.kget(e, "hot")) == ("ok", NOTFOUND)
+
+
+def test_service_update_members_blocked_collapse_lands_later():
+    """Install commits under the old view while the NEW view lacks
+    quorum, so the collapse blocks; after healing, a later call (pure
+    retry, all-False sel) must land the leftover collapse and promote
+    the host membership mirror (the joint view is collapsed by the
+    FIRST launch's transition half — its outcome must not be lost)."""
+    runtime, svc = make_service(n_ens=1, n_peers=5, n_slots=4)
+    assert settle(runtime, svc.kput(0, "k", b"v"))[0] == "ok"
+    leader = int(svc.leader_np[0])
+    assert leader == 0  # lowest-index candidate wins
+
+    svc.set_peer_up(0, 1, False)
+    svc.set_peer_up(0, 2, False)
+    nv = np.zeros((1, 5), bool)
+    nv[0, :3] = True  # {0,1,2}: only 1/3 up -> collapse must block
+    changed = svc.update_members(np.ones(1, bool), nv)
+    assert not changed.any()
+    assert svc._pending_mask[0]
+    assert svc.member_np[0].all()  # mirror keeps the old view
+
+    svc.set_peer_up(0, 1, True)
+    svc.set_peer_up(0, 2, True)
+    changed = svc.update_members(np.zeros(1, bool), nv)
+    assert changed.all(), changed
+    assert (svc.member_np[0] == nv[0]).all()
+    assert not svc._pending_mask[0]
+    assert settle(runtime, svc.kget(0, "k")) == ("ok", b"v")
+
+
+def test_service_update_members_blocked_install_retries():
+    """A request made while no commit quorum exists (leader down, no
+    election yet) cannot install; it stays desired and a later pure
+    retry lands it after the next flush re-elects."""
+    runtime, svc = make_service(n_ens=1, n_peers=5, n_slots=4)
+    assert settle(runtime, svc.kput(0, "k", b"v"))[0] == "ok"
+    svc.set_peer_up(0, int(svc.leader_np[0]), False)
+
+    nv = np.zeros((1, 5), bool)
+    nv[0, 1:4] = True
+    changed = svc.update_members(np.ones(1, bool), nv)
+    assert not changed.any()
+    assert svc._desired_mask[0] and not svc._pending_mask[0]
+
+    # A flush folds in the re-election; the retry then completes.
+    assert settle(runtime, svc.kget(0, "k")) == ("ok", b"v")
+    changed = svc.update_members(np.zeros(1, bool), nv)
+    assert changed.all(), changed
+    assert (svc.member_np[0] == nv[0]).all()

@@ -77,7 +77,7 @@ def test_service_linearizable_under_nemesis(seed):
     down = {}  # ens -> peer index currently down
 
     for _round in range(ROUNDS):
-        # -- nemesis: up-mask churn --------------------------------------
+        # -- nemesis: up-mask + membership churn -------------------------
         r = rng.random()
         if r < 0.25 and down:
             # heal a random downed peer
@@ -92,6 +92,22 @@ def test_service_linearizable_under_nemesis(seed):
                 p = int(svc.leader_np[e])
                 svc.set_peer_up(e, p, False)
                 down[e] = p
+        elif r < 0.7:
+            # membership churn concurrent with the workload: shrink a
+            # random up-and-running ensemble by one member (or restore
+            # the full view), keys must survive the joint-consensus
+            # transition
+            e = int(rng.integers(N_ENS))
+            sel = np.zeros((N_ENS,), bool)
+            sel[e] = True
+            nv = svc.member_np.copy()
+            if nv[e].sum() == N_PEERS:
+                victim = int(rng.integers(N_PEERS))
+                if victim != svc.leader_np[e]:
+                    nv[e, victim] = False
+            else:
+                nv[e] = True
+            svc.update_members(sel, nv)
 
         # -- submit a concurrent batch -----------------------------------
         pending = []
