@@ -26,6 +26,7 @@ flush, amortized over every op in the batch).
 from __future__ import annotations
 
 import functools
+import os
 import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
@@ -139,9 +140,12 @@ class BatchedEnsembleService:
         self.leader_np = np.full((n_ens,), -1, dtype=np.int32)
         self.member_np = np.ones((n_ens, n_peers), dtype=bool)
         #: membership-change pipeline, host side: a requested change is
-        #: DESIRED until its joint view installs on device, PENDING
-        #: until the joint view collapses, then live in member_np.
-        #: Every update_members call advances whatever is in flight.
+        #: QUEUED while an earlier change is still joint on device,
+        #: DESIRED until its joint view installs, PENDING until the
+        #: joint view collapses, then live in member_np.  Every
+        #: update_members call advances whatever is in flight.
+        self._queued_view_np = np.ones((n_ens, n_peers), dtype=bool)
+        self._queued_mask = np.zeros((n_ens,), dtype=bool)
         self._desired_view_np = np.ones((n_ens, n_peers), dtype=bool)
         self._desired_mask = np.zeros((n_ens,), dtype=bool)
         self._pending_view_np = np.ones((n_ens, n_peers), dtype=bool)
@@ -256,23 +260,31 @@ class BatchedEnsembleService:
         stays in flight and EVERY later call advances it — an
         all-False ``sel`` makes this a pure retry.  A new request for
         an ensemble whose previous change is still joint on device is
-        deferred until that change collapses (one change in flight per
-        ensemble, like the reference's single pending views list).
-        Ensembles whose leader left the membership (or was down) get
-        an election folded into the next flush via the host mirrors,
-        exactly like a reference leader shutting down after
-        transitioning itself out (peer.erl:763-771).
+        QUEUED (latest request wins the queue slot) and becomes the
+        next proposal once that change collapses — so ``changed[e]``
+        means e's membership reached its in-flight view this call, not
+        necessarily this call's ``new_view`` row; compare
+        ``member_np`` when that distinction matters.  Ensembles whose
+        leader left the membership (or was down) get an election
+        folded into the next flush via the host mirrors, exactly like
+        a reference leader shutting down after transitioning itself
+        out (peer.erl:763-771).
         """
         jnp = self._jnp
         sel = np.asarray(sel, bool)
         new_view = np.asarray(new_view, bool)
 
-        # Record the request; an ensemble already joint on device
-        # keeps its in-flight view until that collapses.
+        # Record the request.  An ensemble already joint on device
+        # keeps its in-flight view until that collapses; the new
+        # request waits in the queued tier.
         accept = sel & ~self._pending_mask
+        defer = sel & self._pending_mask
         self._desired_view_np = np.where(accept[:, None], new_view,
                                          self._desired_view_np)
         self._desired_mask = self._desired_mask | accept
+        self._queued_view_np = np.where(defer[:, None], new_view,
+                                        self._queued_view_np)
+        self._queued_mask = self._queued_mask | defer
 
         up_j = jnp.asarray(self.up)
         # Proposing is leader work (leading({update_members,_}),
@@ -288,17 +300,26 @@ class BatchedEnsembleService:
         dv_j = jnp.asarray(self._desired_view_np)
         state, installed, collapsed1 = self.engine.reconfig_step(
             self.state, jnp.asarray(propose), dv_j, up_j)
-        state, _, collapsed2 = self.engine.reconfig_step(
-            state, jnp.zeros((self.n_ens,), bool), dv_j, up_j)
+        # Launch 2 only exists to collapse views launch 1 freshly
+        # installed (launch 1's transition half already attempted
+        # every leftover); skip the device round trip if nothing
+        # could have installed.
+        if propose.any():
+            state, _, collapsed2 = self.engine.reconfig_step(
+                state, jnp.zeros((self.n_ens,), bool), dv_j, up_j)
+            collapsed2 = np.asarray(collapsed2)
+        else:
+            collapsed2 = np.zeros((self.n_ens,), bool)
         self.state = state
         installed_now = propose & np.asarray(installed)
         # Collapses land in EITHER launch: joint views left over from
         # earlier calls transition during launch 1 (its ~propose
         # half), fresh installs during launch 2.
-        collapsed = np.asarray(collapsed1) | np.asarray(collapsed2)
+        collapsed = np.asarray(collapsed1) | collapsed2
 
         # Host mirrors.  Installs move desired -> pending; a collapse
-        # promotes its pending view to the live membership.
+        # promotes its pending view to the live membership and lets a
+        # queued next request advance to desired.
         self._pending_view_np = np.where(installed_now[:, None],
                                          self._desired_view_np,
                                          self._pending_view_np)
@@ -308,6 +329,12 @@ class BatchedEnsembleService:
         self.member_np = np.where(changed[:, None],
                                   self._pending_view_np, self.member_np)
         self._pending_mask = self._pending_mask & ~changed
+        promote = self._queued_mask & changed
+        self._desired_view_np = np.where(promote[:, None],
+                                         self._queued_view_np,
+                                         self._desired_view_np)
+        self._desired_mask = self._desired_mask | promote
+        self._queued_mask = self._queued_mask & ~promote
 
         # A leader no longer in (or not up in) its membership forces
         # an election on the next flush.
@@ -323,6 +350,119 @@ class BatchedEnsembleService:
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
+
+    # -- checkpoint / resume -----------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Checkpoint the whole service: the device ``EngineState``
+        via orbax plus the host mirrors (key→slot maps, payload store,
+        membership pipeline) as one 4-copy CRC blob (save.erl's
+        paranoid format; pickle is fine here — local disk is the same
+        trust boundary as the reference's term_to_binary files).
+
+        Crash atomicity: each save writes a fresh ``ckpt.<n>``
+        directory and only then flips the CRC-protected ``CURRENT``
+        pointer — a crash at any point leaves the previous checkpoint
+        (engine+host pair, always from the same save) restorable.
+
+        Queued ops are flushed (resolved) first so the persisted host
+        mirrors carry no half-applied side effects (a saved key→slot
+        allocation whose put never ran would leak the slot forever).
+        Leases are not persisted — a restarted service must never
+        trust a pre-crash lease (the reference restarts into probe; we
+        restart lease-less and re-establish via the next quorum
+        round).
+        """
+        import pickle
+
+        from riak_ensemble_tpu import save as savelib
+        from riak_ensemble_tpu.ops import checkpoint as ckpt
+
+        while any(self.queues):
+            self.flush()
+        os.makedirs(path, exist_ok=True)
+        n = self._current_ckpt(path) + 1
+        d = os.path.join(path, f"ckpt.{n}")
+        ckpt.save(os.path.join(d, "engine"), self.state)
+        host = {
+            "shape": (self.n_ens, self.n_peers, self.n_slots),
+            "key_slot": self.key_slot,
+            "free_slots": self.free_slots,
+            "slot_gen": self.slot_gen,
+            "slot_handle": self.slot_handle,
+            "recycle_pending": self._recycle_pending,
+            "values": self.values,
+            "free_handles": self._free_handles,
+            "next_handle": self._next_handle,
+            "leader": self.leader_np,
+            "member": self.member_np,
+            "desired_view": self._desired_view_np,
+            "desired_mask": self._desired_mask,
+            "queued_view": self._queued_view_np,
+            "queued_mask": self._queued_mask,
+            "pending_view": self._pending_view_np,
+            "pending_mask": self._pending_mask,
+            "up": self.up,
+        }
+        savelib.write(os.path.join(d, "host"),
+                      pickle.dumps(host, protocol=4))
+        savelib.write(os.path.join(path, "CURRENT"), str(n).encode())
+        # Old checkpoints are garbage once CURRENT moved (best effort).
+        import shutil
+        for name in os.listdir(path):
+            if name.startswith("ckpt.") and name != f"ckpt.{n}":
+                shutil.rmtree(os.path.join(path, name),
+                              ignore_errors=True)
+
+    @staticmethod
+    def _current_ckpt(path: str) -> int:
+        from riak_ensemble_tpu import save as savelib
+
+        raw = savelib.read(os.path.join(path, "CURRENT"))
+        try:
+            return int(raw.decode()) if raw else 0
+        except ValueError:
+            return 0
+
+    @classmethod
+    def restore(cls, runtime: Runtime, path: str, **kw
+                ) -> "BatchedEnsembleService":
+        """Bring a service back from :meth:`save`; ``kw`` forwards
+        construction options (tick, config, engine, ...)."""
+        import pickle
+
+        from riak_ensemble_tpu import save as savelib
+        from riak_ensemble_tpu.ops import checkpoint as ckpt
+
+        n = cls._current_ckpt(path)
+        d = os.path.join(path, f"ckpt.{n}")
+        raw = savelib.read(os.path.join(d, "host"))
+        if raw is None:
+            raise FileNotFoundError(f"no service checkpoint at {path}")
+        host = pickle.loads(raw)
+        n_ens, n_peers, n_slots = host["shape"]
+        svc = cls(runtime, n_ens, n_peers, n_slots, **kw)
+        svc.state = ckpt.load(os.path.join(d, "engine"),
+                              template=svc.state)
+        svc.key_slot = host["key_slot"]
+        svc.free_slots = host["free_slots"]
+        svc.slot_gen = host["slot_gen"]
+        svc.slot_handle = host["slot_handle"]
+        svc._recycle_pending = host["recycle_pending"]
+        svc.values = host["values"]
+        svc._free_handles = host["free_handles"]
+        svc._next_handle = host["next_handle"]
+        svc.leader_np = np.asarray(host["leader"])
+        svc.member_np = np.asarray(host["member"])
+        svc._desired_view_np = np.asarray(host["desired_view"])
+        svc._desired_mask = np.asarray(host["desired_mask"])
+        svc._queued_view_np = np.asarray(host["queued_view"])
+        svc._queued_mask = np.asarray(host["queued_mask"])
+        svc._pending_view_np = np.asarray(host["pending_view"])
+        svc._pending_mask = np.asarray(host["pending_mask"])
+        svc.up = np.asarray(host["up"])
+        # lease_until stays zero: no pre-crash lease is ever trusted.
+        return svc
 
     # -- internals ---------------------------------------------------------
 

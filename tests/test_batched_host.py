@@ -353,3 +353,96 @@ def test_service_update_members_blocked_install_retries():
     changed = svc.update_members(np.zeros(1, bool), nv)
     assert changed.all(), changed
     assert (svc.member_np[0] == nv[0]).all()
+
+
+def test_service_save_restore_roundtrip(tmp_path):
+    """Full service checkpoint: device state via orbax + host mirrors
+    via the CRC blob; a restored service serves the same data, holds
+    no pre-crash lease, and keeps its membership pipeline."""
+    runtime, svc = make_service(n_ens=4, n_peers=5, n_slots=4)
+    for e in range(4):
+        assert settle(runtime, svc.kput(e, "k", b"v%d" % e))[0] == "ok"
+    assert settle(runtime, svc.kdelete(3, "k"))[0] == "ok"
+    nv = np.ones((4, 5), bool)
+    nv[:, 4] = False
+    assert svc.update_members(np.ones(4, bool), nv).all()
+    svc.save(str(tmp_path / "ckpt"))
+    svc.stop()
+
+    rt2 = Runtime(seed=99)
+    svc2 = BatchedEnsembleService.restore(
+        rt2, str(tmp_path / "ckpt"), tick=0.005,
+        config=fast_test_config())
+    assert (svc2.lease_until == 0).all()  # never trust pre-crash leases
+    assert (svc2.member_np == nv).all()
+    for e in range(3):
+        assert settle(rt2, svc2.kget(e, "k")) == ("ok", b"v%d" % e)
+    assert settle(rt2, svc2.kget(3, "k")) == ("ok", NOTFOUND)
+    # and the restored service keeps serving writes
+    assert settle(rt2, svc2.kput(0, "k", b"post"))[0] == "ok"
+    assert settle(rt2, svc2.kget(0, "k")) == ("ok", b"post")
+
+
+def test_service_update_members_queued_request_not_dropped():
+    """A request targeting an ensemble whose earlier change is still
+    joint is queued (not silently dropped): once the first change
+    collapses, a retry proposes and lands the queued view."""
+    runtime, svc = make_service(n_ens=1, n_peers=5, n_slots=4)
+    assert settle(runtime, svc.kput(0, "k", b"v"))[0] == "ok"
+
+    svc.set_peer_up(0, 1, False)
+    svc.set_peer_up(0, 2, False)
+    view_a = np.zeros((1, 5), bool)
+    view_a[0, :3] = True          # collapse blocks: 1/3 up
+    assert not svc.update_members(np.ones(1, bool), view_a).any()
+    assert svc._pending_mask[0]
+
+    view_b = np.zeros((1, 5), bool)
+    view_b[0, [0, 3, 4]] = True   # new request while A is joint
+    changed = svc.update_members(np.ones(1, bool), view_b)
+    # A still cannot collapse (quorum still missing) and B must wait.
+    assert not changed.any()
+    assert svc._queued_mask[0]
+
+    svc.set_peer_up(0, 1, True)
+    svc.set_peer_up(0, 2, True)
+    # Retry 1: A collapses, B advances to desired.
+    changed = svc.update_members(np.zeros(1, bool), view_a)
+    assert changed.all()
+    assert (svc.member_np[0] == view_a[0]).all()
+    # Retry 2: B proposes + lands.
+    changed = svc.update_members(np.zeros(1, bool), view_a)
+    assert changed.all()
+    assert (svc.member_np[0] == view_b[0]).all()
+    assert settle(runtime, svc.kget(0, "k")) == ("ok", b"v")
+
+
+def test_service_save_versioned_and_queued_ops_flushed(tmp_path):
+    """Repeated saves keep a restorable checkpoint at every point
+    (CURRENT pointer flips only after the new pair is complete), and
+    queued-but-unflushed ops are resolved before snapshotting so no
+    slot/handle side effects leak into the image."""
+    runtime, svc = make_service(n_ens=1, n_peers=3, n_slots=2)
+    assert settle(runtime, svc.kput(0, "a", b"1"))[0] == "ok"
+    svc.save(str(tmp_path / "c"))
+    # enqueue WITHOUT settling: save must flush it, not leak it
+    fut = svc.kput(0, "b", b"2")
+    svc.save(str(tmp_path / "c"))
+    assert fut.done and fut.value[0] == "ok"
+    svc.stop()
+
+    import os
+    names = sorted(os.listdir(tmp_path / "c"))
+    assert "CURRENT" in names
+    assert sum(n.startswith("ckpt.") for n in names) == 1  # old pruned
+
+    rt2 = Runtime(seed=7)
+    svc2 = BatchedEnsembleService.restore(
+        rt2, str(tmp_path / "c"), tick=0.005, config=fast_test_config())
+    assert settle(rt2, svc2.kget(0, "a")) == ("ok", b"1")
+    assert settle(rt2, svc2.kget(0, "b")) == ("ok", b"2")
+    # no leaked slots/handles: both keys live, store consistent
+    assert len(svc2.values) == 2
+    assert len(svc2.free_slots[0]) == 0
+    assert settle(rt2, svc2.kdelete(0, "a"))[0] == "ok"
+    assert settle(rt2, svc2.kput(0, "c", b"3"))[0] == "ok"
