@@ -86,6 +86,11 @@ PALLAS_QUORUM = os.environ.get("RETPU_PALLAS_QUORUM", "") == "1"
 OP_NOOP = 0
 OP_GET = 1
 OP_PUT = 2
+#: compare-and-swap: commit ``val`` iff the slot's current version
+#: equals (exp_epoch, exp_seq); expecting (0, 0) on an absent slot is
+#: create-if-missing — so OP_CAS carries both do_kupdate
+#: (peer.erl:259-270) and do_kput_once (:278-284) semantics.
+OP_CAS = 3
 
 #: Merkle trie fan-out (the reference's width-16 trie, synctree.erl:88).
 TREE_WIDTH = 16
@@ -457,15 +462,23 @@ def _kv_context(state: EngineState, up: jax.Array,
 
 def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
               slot: jax.Array, val: jax.Array, lease_ok: jax.Array,
-              axis_name: Optional[str]) -> Tuple[EngineState, KvResult]:
+              axis_name: Optional[str],
+              exp_epoch: Optional[jax.Array] = None,
+              exp_seq: Optional[jax.Array] = None
+              ) -> Tuple[EngineState, KvResult]:
     """One K/V protocol round given a precomputed context."""
     s = state.obj_epoch.shape[-1]
     heard, leader_up = ctx.heard, ctx.leader_up
     lead_epoch, epoch_ok = ctx.lead_epoch, ctx.epoch_ok
+    if exp_epoch is None:
+        exp_epoch = jnp.zeros_like(kind)
+    if exp_seq is None:
+        exp_seq = jnp.zeros_like(kind)
 
     is_put = kind == OP_PUT
     is_get = kind == OP_GET
-    active = is_put | is_get
+    is_cas = kind == OP_CAS
+    active = is_put | is_get | is_cas
     slot_valid = (slot >= 0) & (slot < s)
     slot_c = jnp.clip(slot, 0, s - 1)
 
@@ -524,11 +537,33 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
     get_ok = ((get_gate & obj_found & (~stale | rewrite))
               | (nf & (all_ok | ~slot_valid | nf_write)))
 
-    # Commit path (shared by put, rewrite and notfound tombstone).
+    # Commit path (shared by put, CAS, rewrite and notfound
+    # tombstone).  CAS compares the expected version against the
+    # slot's CURRENT stored version atomically within this round (the
+    # do_kupdate (epoch, seq) equality, peer.erl:259-270, with the
+    # key-hashed worker's serialization guaranteed by sequential
+    # rounds); expecting (0, 0) on an absent slot is create-if-missing
+    # (do_kput_once, :278-284).  A tombstone counts as an existing
+    # version for the compare (ksafe_delete reads the tombstone's vsn)
+    # but val 0 still reads back notfound.
     new_seq = state.obj_seq_ctr + 1                          # [E]
     put_commit = is_put & epoch_ok & slot_valid
-    commit = put_commit | rewrite | nf_write
-    wval = jnp.where(is_put, val, jnp.where(rewrite, rd_val, 0))
+    exp_absent = (exp_epoch == 0) & (exp_seq == 0)
+    # (0, 0) matches a tombstone as well as true absence — put-once
+    # succeeds over a notfound-valued object (do_kput_once,
+    # peer.erl:278-284) — and TRUE absence additionally needs a quorum
+    # of hash-valid notfound answers (same nf_quorum guard as the GET
+    # tombstone path): without it, corrupting every holder's leaves
+    # would let a (0,0) CAS overwrite committed data the integrity
+    # gate excluded.
+    vsn_match = ((obj_found & (rd_epoch == exp_epoch)
+                  & (rd_seq == exp_seq))
+                 | (exp_absent & obj_found & (rd_val == 0))
+                 | (exp_absent & ~obj_found & nf_quorum))
+    cas_commit = is_cas & epoch_ok & slot_valid & vsn_match
+    commit = put_commit | cas_commit | rewrite | nf_write
+    wval = jnp.where(is_put | is_cas, val,
+                     jnp.where(rewrite, rd_val, 0))
 
     # Read repair (maybe_repair, peer.erl:1518-1536): a successful
     # current-epoch read heals reachable replicas that lag the winning
@@ -555,10 +590,14 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
     tree_leaf, tree_node = _write_path(
         state.tree_leaf, state.tree_node, slot_c, new_leaf, do_write)
 
+    # Version reported for any served object INCLUDING tombstones —
+    # the reference's kget hands back the notfound obj with its vsn,
+    # which is what ksafe_delete's CAS compares against
+    # (client.erl:kget → peer.erl:1568-1584 tombstone objects).
     out_epoch = jnp.where(commit, lead_epoch,
-                          jnp.where(get_ok & found, rd_epoch, 0))
+                          jnp.where(get_ok & obj_found, rd_epoch, 0))
     out_seq = jnp.where(commit, new_seq,
-                        jnp.where(get_ok & found, rd_seq, 0))
+                        jnp.where(get_ok & obj_found, rd_seq, 0))
     res = KvResult(
         committed=commit,
         get_ok=get_ok,
@@ -577,12 +616,16 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
 @functools.partial(jax.jit, static_argnames=("axis_name",))
 def kv_step(state: EngineState, kind: jax.Array, slot: jax.Array,
             val: jax.Array, lease_ok: jax.Array, up: jax.Array,
-            axis_name: Optional[str] = None
+            axis_name: Optional[str] = None,
+            exp_epoch: Optional[jax.Array] = None,
+            exp_seq: Optional[jax.Array] = None
             ) -> Tuple[EngineState, KvResult]:
     """One K/V protocol round per ensemble, batched over E.
 
-    kind [E] int32 (OP_NOOP/OP_GET/OP_PUT); slot [E] int32; val [E]
-    int32 (payload for puts); lease_ok [E] bool (host lease check,
+    kind [E] int32 (OP_NOOP/OP_GET/OP_PUT/OP_CAS); slot [E] int32;
+    val [E] int32 (payload for puts/CAS); exp_epoch/exp_seq [E] int32
+    (the CAS expected version; ignored for other kinds, default 0);
+    lease_ok [E] bool (host lease check,
     check_lease peer.erl:1493-1516); up [E, Ml] bool.
 
     Semantics per ensemble:
@@ -605,33 +648,43 @@ def kv_step(state: EngineState, kind: jax.Array, slot: jax.Array,
       :1568-1584) — all batched across ensembles.
     """
     ctx = _kv_context(state, up, axis_name)
-    return _kv_round(state, ctx, kind, slot, val, lease_ok, axis_name)
+    return _kv_round(state, ctx, kind, slot, val, lease_ok, axis_name,
+                     exp_epoch, exp_seq)
 
 
 @functools.partial(jax.jit, static_argnames=("axis_name",))
 def kv_step_scan(state: EngineState, kind: jax.Array, slot: jax.Array,
                  val: jax.Array, lease_ok: jax.Array, up: jax.Array,
-                 axis_name: Optional[str] = None
+                 axis_name: Optional[str] = None,
+                 exp_epoch: Optional[jax.Array] = None,
+                 exp_seq: Optional[jax.Array] = None
                  ) -> Tuple[EngineState, KvResult]:
     """K sequential K/V rounds per ensemble in one launch.
 
-    kind/slot/val: [K, E]; lease_ok: [K, E]; up: [E, Ml] (held fixed
-    across the K rounds).  Sequentiality per ensemble preserves the
-    per-key serialization the reference gets from key-hashed workers
-    (async/3, peer.erl:1220-1225).  Results are stacked [K, E].
+    kind/slot/val (and exp_epoch/exp_seq when any op is OP_CAS):
+    [K, E]; lease_ok: [K, E]; up: [E, Ml] (held fixed across the K
+    rounds).  Sequentiality per ensemble preserves the per-key
+    serialization the reference gets from key-hashed workers (async/3,
+    peer.erl:1220-1225) — and makes each CAS's read-compare-write
+    atomic.  Results are stacked [K, E].
 
     Ballot state (epoch/leader/views) is invariant across the rounds,
     so the round context — including its peer-axis collectives — is
     computed once outside the scan.
     """
     ctx = _kv_context(state, up, axis_name)
+    if exp_epoch is None:
+        exp_epoch = jnp.zeros_like(kind)
+    if exp_seq is None:
+        exp_seq = jnp.zeros_like(kind)
 
     def body(st, op):
-        k, sl, v, lz = op
-        st2, r = _kv_round(st, ctx, k, sl, v, lz, axis_name)
+        k, sl, v, lz, xe, xs = op
+        st2, r = _kv_round(st, ctx, k, sl, v, lz, axis_name, xe, xs)
         return st2, r
 
-    return jax.lax.scan(body, state, (kind, slot, val, lease_ok))
+    return jax.lax.scan(body, state,
+                        (kind, slot, val, lease_ok, exp_epoch, exp_seq))
 
 
 # ---------------------------------------------------------------------------
@@ -892,7 +945,9 @@ def reconfig_step(state: EngineState, propose: jax.Array,
 def full_step(state: EngineState, elect: jax.Array, cand: jax.Array,
               kind: jax.Array, slot: jax.Array, val: jax.Array,
               lease_ok: jax.Array, up: jax.Array,
-              axis_name: Optional[str] = None
+              axis_name: Optional[str] = None,
+              exp_epoch: Optional[jax.Array] = None,
+              exp_seq: Optional[jax.Array] = None
               ) -> Tuple[EngineState, jax.Array, KvResult]:
     """Election round (where needed) followed by K K/V rounds, fused.
 
@@ -902,5 +957,6 @@ def full_step(state: EngineState, elect: jax.Array, cand: jax.Array,
     """
     state, won = elect_step(state, elect, cand, up, axis_name=axis_name)
     state, res = kv_step_scan(state, kind, slot, val, lease_ok, up,
-                              axis_name=axis_name)
+                              axis_name=axis_name, exp_epoch=exp_epoch,
+                              exp_seq=exp_seq)
     return state, won, res

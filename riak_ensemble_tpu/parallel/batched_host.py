@@ -107,6 +107,10 @@ class _PendingOp:
     #: slot write generation at enqueue (puts only) — lets the failed
     #: path tell whether it was the slot's last queued write
     gen: int = 0
+    #: CAS expected version (OP_CAS only)
+    exp: Tuple[int, int] = (0, 0)
+    #: resolve gets as ("ok", value, vsn) instead of ("ok", value)
+    want_vsn: bool = False
 
 
 class BatchedEnsembleService:
@@ -220,6 +224,58 @@ class BatchedEnsembleService:
         self.queues[ens].append(_PendingOp(eng.OP_GET, slot, 0, fut))
         return fut
 
+    def kget_vsn(self, ens: int, key: Any) -> Future:
+        """Read returning the version too: ('ok', value|NOTFOUND,
+        (epoch, seq)) — the handle a subsequent :meth:`kupdate` /
+        :meth:`ksafe_delete` CAS needs.  An absent key reads as
+        ('ok', NOTFOUND, (0, 0)); CAS'ing against (0, 0) is
+        create-if-missing (the kput_once semantics)."""
+        fut = Future()
+        slot = self._slot_for(ens, key, allocate=False)
+        if slot is None:
+            fut.resolve(("ok", NOTFOUND, (0, 0)))
+            return fut
+        self.queues[ens].append(
+            _PendingOp(eng.OP_GET, slot, 0, fut, want_vsn=True))
+        return fut
+
+    def kupdate(self, ens: int, key: Any, expected_vsn: Tuple[int, int],
+                value: Any) -> Future:
+        """Compare-and-swap (do_kupdate, peer.erl:259-270): commit
+        `value` iff the key's current version equals `expected_vsn`
+        (from a :meth:`kput`/:meth:`kupdate` result or
+        :meth:`kget_vsn`); (0, 0) on an absent key is
+        create-if-missing (kput_once).  Resolves ('ok', new_vsn) or
+        'failed' (version mismatch / no quorum)."""
+        fut = Future()
+        slot = self._slot_for(ens, key, allocate=True)
+        if slot is None:
+            fut.resolve("failed")
+            return fut
+        handle = self._alloc_handle()
+        self.values[handle] = value
+        gen = self.slot_gen[ens].get(slot, 0) + 1
+        self.slot_gen[ens][slot] = gen
+        self.queues[ens].append(
+            _PendingOp(eng.OP_CAS, slot, handle, fut, key, gen,
+                       exp=(int(expected_vsn[0]), int(expected_vsn[1]))))
+        return fut
+
+    def ksafe_delete(self, ens: int, key: Any,
+                     expected_vsn: Tuple[int, int]) -> Future:
+        """Version-guarded delete (ksafe_delete): CAS to a tombstone."""
+        fut = Future()
+        slot = self._slot_for(ens, key, allocate=False)
+        if slot is None:
+            fut.resolve("failed")  # nothing at this key to guard
+            return fut
+        op = _PendingOp(eng.OP_CAS, slot, 0, fut, key,
+                        self.slot_gen[ens].get(slot, 0),
+                        exp=(int(expected_vsn[0]), int(expected_vsn[1])))
+        self.queues[ens].append(op)
+        self._recycle_on_ok(fut, ens, key, slot)
+        return fut
+
     def kdelete(self, ens: int, key: Any) -> Future:
         """Tombstone write (slot recycled once committed)."""
         fut = Future()
@@ -230,13 +286,19 @@ class BatchedEnsembleService:
         handle = 0  # 0 = tombstone handle
         op = _PendingOp(eng.OP_PUT, slot, handle, fut)
         self.queues[ens].append(op)
+        self._recycle_on_ok(fut, ens, key, slot)
+        return fut
+
+    def _recycle_on_ok(self, fut: Future, ens: int, key: Any,
+                       slot: int) -> None:
+        """Once a delete commits, queue the slot for deferred
+        recycling (validated and applied by _drain_recycles)."""
         gen = self.slot_gen[ens].get(slot, 0)
 
         def recycle(result):
             if isinstance(result, tuple) and result[0] == "ok":
                 self._recycle_pending[ens].append((key, slot, gen))
         fut.add_waiter(recycle)
-        return fut
 
     def set_peer_up(self, ens: int, peer: int, up: bool) -> None:
         """Failure-detector input (the host's nodedown/suspend signal)."""
@@ -540,7 +602,9 @@ class BatchedEnsembleService:
         return elect, cand
 
     def _launch(self, kind: np.ndarray, slot: np.ndarray,
-                val: np.ndarray, k: int, want_vsn: bool):
+                val: np.ndarray, k: int, want_vsn: bool,
+                exp_e: Optional[np.ndarray] = None,
+                exp_s: Optional[np.ndarray] = None):
         """One ``full_step`` launch + host bookkeeping shared by
         :meth:`flush` (future-based) and :meth:`execute` (bulk):
         elections folded in, lease check/renewal, corruption-driven
@@ -558,7 +622,9 @@ class BatchedEnsembleService:
             jnp.asarray(np.broadcast_to(lease_ok, (max(k, 1),
                                                    self.n_ens))[:k]
                         if k else np.zeros((0, self.n_ens), bool)),
-            jnp.asarray(self.up))
+            jnp.asarray(self.up),
+            exp_epoch=None if exp_e is None else jnp.asarray(exp_e),
+            exp_seq=None if exp_s is None else jnp.asarray(exp_s))
         self.state = state
 
         # ONE device->host transfer per launch: results pack into a
@@ -653,6 +719,8 @@ class BatchedEnsembleService:
         kind = np.zeros((k, self.n_ens), dtype=np.int32)
         slot = np.zeros((k, self.n_ens), dtype=np.int32)
         val = np.zeros((k, self.n_ens), dtype=np.int32)
+        exp_e = np.zeros((k, self.n_ens), dtype=np.int32)
+        exp_s = np.zeros((k, self.n_ens), dtype=np.int32)
         taken: List[List[_PendingOp]] = []
         for e in range(self.n_ens):
             ops = self.queues[e][:k]
@@ -662,15 +730,16 @@ class BatchedEnsembleService:
                 kind[j, e] = op.kind
                 slot[j, e] = op.slot
                 val[j, e] = op.handle
+                exp_e[j, e], exp_s[j, e] = op.exp
 
         committed, get_ok, found, value, vsn = self._launch(
-            kind, slot, val, k, want_vsn=True)
+            kind, slot, val, k, want_vsn=True, exp_e=exp_e, exp_s=exp_s)
 
         served = 0
         for e in range(self.n_ens):
             for j, op in enumerate(taken[e]):
                 served += 1
-                if op.kind == eng.OP_PUT:
+                if op.kind in (eng.OP_PUT, eng.OP_CAS):
                     if committed[j, e]:
                         # Release the payload this write superseded
                         # (rounds resolve in device order, so the last
@@ -696,12 +765,15 @@ class BatchedEnsembleService:
                         op.fut.resolve("failed")
                 else:
                     if get_ok[j, e]:
-                        if found[j, e] and value[j, e] != 0:
-                            op.fut.resolve(
-                                ("ok", self.values.get(int(value[j, e]),
-                                                       NOTFOUND)))
-                        else:
-                            op.fut.resolve(("ok", NOTFOUND))
+                        out = (self.values.get(int(value[j, e]), NOTFOUND)
+                               if found[j, e] and value[j, e] != 0
+                               else NOTFOUND)
+                        # vsn is the object's — a tombstone's real
+                        # version rides along with NOTFOUND, so CAS
+                        # chains (ksafe_delete → kupdate) work.
+                        rvsn = (int(vsn[j, e, 0]), int(vsn[j, e, 1]))
+                        op.fut.resolve(("ok", out, rvsn)
+                                       if op.want_vsn else ("ok", out))
                     else:
                         op.fut.resolve("failed")
         self.ops_served += served

@@ -29,10 +29,21 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from riak_ensemble_tpu.ops import engine as eng
+
+
+def _default_exp(kind, exp_epoch, exp_seq):
+    """shard_map takes concrete operands: absent CAS expected-version
+    arrays materialize as zeros of the op-matrix shape."""
+    if exp_epoch is None:
+        exp_epoch = jnp.zeros(kind.shape, kind.dtype)
+    if exp_seq is None:
+        exp_seq = jnp.zeros(kind.shape, kind.dtype)
+    return exp_epoch, exp_seq
 
 
 def make_mesh(n_ens: int, n_peer: int = 1,
@@ -97,17 +108,20 @@ class ShardedEngine:
             (_STATE_SPECS, P("ens"), P("ens"), P("ens", "peer")),
             (_STATE_SPECS, P("ens")))
         self._kv = smap(
-            lambda st, k, sl, v, lz, up: eng.kv_step_scan(
-                st, k, sl, v, lz, up, axis_name=ax),
+            lambda st, k, sl, v, lz, up, xe, xs: eng.kv_step_scan(
+                st, k, sl, v, lz, up, axis_name=ax, exp_epoch=xe,
+                exp_seq=xs),
             (_STATE_SPECS, P(None, "ens"), P(None, "ens"), P(None, "ens"),
-             P(None, "ens"), P("ens", "peer")),
+             P(None, "ens"), P("ens", "peer"), P(None, "ens"),
+             P(None, "ens")),
             (_STATE_SPECS, _SCAN_RESULT_SPECS))
         self._full = smap(
-            lambda st, el, ca, k, sl, v, lz, up: eng.full_step(
-                st, el, ca, k, sl, v, lz, up, axis_name=ax),
+            lambda st, el, ca, k, sl, v, lz, up, xe, xs: eng.full_step(
+                st, el, ca, k, sl, v, lz, up, axis_name=ax,
+                exp_epoch=xe, exp_seq=xs),
             (_STATE_SPECS, P("ens"), P("ens"), P(None, "ens"),
              P(None, "ens"), P(None, "ens"), P(None, "ens"),
-             P("ens", "peer")),
+             P("ens", "peer"), P(None, "ens"), P(None, "ens")),
             (_STATE_SPECS, P("ens"), _SCAN_RESULT_SPECS))
         self._reconfig = smap(
             lambda st, pr, nv, up: eng.reconfig_step(st, pr, nv, up,
@@ -159,13 +173,21 @@ class ShardedEngine:
     def elect_step(self, state, elect, cand, up):
         return self._elect(state, elect, cand, up)
 
-    def kv_step_scan(self, state, kind, slot, val, lease_ok, up):
+    def kv_step_scan(self, state, kind, slot, val, lease_ok, up,
+                     exp_epoch=None, exp_seq=None):
         """Ops are [K, E]-shaped (a scan of K rounds), matching
-        :func:`riak_ensemble_tpu.ops.engine.kv_step_scan`."""
-        return self._kv(state, kind, slot, val, lease_ok, up)
+        :func:`riak_ensemble_tpu.ops.engine.kv_step_scan`.  shard_map
+        takes concrete operands, so absent CAS versions materialize as
+        zeros here."""
+        exp_epoch, exp_seq = _default_exp(kind, exp_epoch, exp_seq)
+        return self._kv(state, kind, slot, val, lease_ok, up,
+                        exp_epoch, exp_seq)
 
-    def full_step(self, state, elect, cand, kind, slot, val, lease_ok, up):
-        return self._full(state, elect, cand, kind, slot, val, lease_ok, up)
+    def full_step(self, state, elect, cand, kind, slot, val, lease_ok,
+                  up, exp_epoch=None, exp_seq=None):
+        exp_epoch, exp_seq = _default_exp(kind, exp_epoch, exp_seq)
+        return self._full(state, elect, cand, kind, slot, val, lease_ok,
+                          up, exp_epoch, exp_seq)
 
     def reconfig_step(self, state, propose, new_view, up):
         """Joint-consensus membership change over the mesh

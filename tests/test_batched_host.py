@@ -446,3 +446,63 @@ def test_service_save_versioned_and_queued_ops_flushed(tmp_path):
     assert len(svc2.free_slots[0]) == 0
     assert settle(rt2, svc2.kdelete(0, "a"))[0] == "ok"
     assert settle(rt2, svc2.kput(0, "c", b"3"))[0] == "ok"
+
+
+def test_service_cas_chain():
+    """kupdate/ksafe_delete through the serving path: CAS on the vsn
+    from kput/kget_vsn; stale CAS fails without touching data;
+    tombstone vsn rides kget_vsn so delete-then-guard chains work."""
+    runtime, svc = make_service(n_ens=2, n_peers=5, n_slots=4)
+    r = settle(runtime, svc.kput(0, "k", b"v1"))
+    assert r[0] == "ok"
+    vsn1 = r[1]
+
+    r = settle(runtime, svc.kupdate(0, "k", vsn1, b"v2"))
+    assert r[0] == "ok"
+    vsn2 = r[1]
+    assert vsn2 != vsn1
+    assert settle(runtime, svc.kget(0, "k")) == ("ok", b"v2")
+
+    # stale CAS: fails, value untouched, payload store clean
+    assert settle(runtime, svc.kupdate(0, "k", vsn1, b"v3")) == "failed"
+    assert settle(runtime, svc.kget(0, "k")) == ("ok", b"v2")
+
+    # kget_vsn returns the same vsn a CAS needs
+    r = settle(runtime, svc.kget_vsn(0, "k"))
+    assert r == ("ok", b"v2", vsn2), r
+
+    # version-guarded delete, then stale-guard delete fails
+    assert settle(runtime, svc.ksafe_delete(0, "k", vsn1)) == "failed"
+    r = settle(runtime, svc.ksafe_delete(0, "k", vsn2))
+    assert r[0] == "ok"
+    assert settle(runtime, svc.kget(0, "k")) == ("ok", NOTFOUND)
+
+    # create-if-missing: CAS against (0, 0) is kput_once
+    r = settle(runtime, svc.kupdate(1, "fresh", (0, 0), b"first"))
+    assert r[0] == "ok"
+    assert settle(runtime, svc.kupdate(1, "fresh", (0, 0),
+                                       b"second")) == "failed"
+    assert settle(runtime, svc.kget(1, "fresh")) == ("ok", b"first")
+
+
+def test_service_cas_failed_releases_payload():
+    runtime, svc = make_service(n_ens=1, n_peers=3, n_slots=2)
+    r = settle(runtime, svc.kput(0, "k", b"a"))
+    vsn = r[1]
+    assert settle(runtime, svc.kupdate(0, "k", (9, 9), b"b")) == "failed"
+    assert settle(runtime, svc.kupdate(0, "k", vsn, b"c"))[0] == "ok"
+    assert settle(runtime, svc.kget(0, "k")) == ("ok", b"c")
+    assert len(svc.values) == 1  # failed/superseded payloads released
+
+
+def test_service_create_if_missing_on_recycled_slot():
+    """A recycled slot keeps the previous key's tombstone on device;
+    create-if-missing for a NEW key mapped onto it must still succeed
+    (the engine's (0,0) matches tombstones, like do_kput_once)."""
+    runtime, svc = make_service(n_ens=1, n_peers=3, n_slots=1)
+    assert settle(runtime, svc.kput(0, "old", b"x"))[0] == "ok"
+    assert settle(runtime, svc.kdelete(0, "old"))[0] == "ok"
+    assert len(svc.free_slots[0]) == 1  # slot recycled, tombstone stays
+    r = settle(runtime, svc.kupdate(0, "new", (0, 0), b"y"))
+    assert r[0] == "ok", r
+    assert settle(runtime, svc.kget(0, "new")) == ("ok", b"y")

@@ -353,3 +353,204 @@ def test_distributed_helpers_on_virtual_mesh():
     state, won = se.elect_step(state, jnp.ones((e,), bool),
                                jnp.zeros((e,), jnp.int32), up)
     assert bool(np.asarray(won).all())
+
+
+# ---------------------------------------------------------------------------
+# OP_CAS: compare-and-swap (do_kupdate + do_kput_once semantics)
+
+
+class TestCas:
+    def _setup(self, e=4, m=5):
+        st = eng.init_state(e, m, S)
+        up = jnp.ones((e, m), bool)
+        st, won = eng.elect_step(st, jnp.ones((e,), bool),
+                                 jnp.zeros((e,), jnp.int32), up)
+        assert np.asarray(won).all()
+        return st, up, e
+
+    def _one(self, st, up, e, kind, slot, val, exp=None):
+        k = jnp.full((1, e), kind, jnp.int32)
+        sl = jnp.full((1, e), slot, jnp.int32)
+        v = jnp.full((1, e), val, jnp.int32)
+        lz = jnp.ones((1, e), bool)
+        xe = xs = None
+        if exp is not None:
+            xe = jnp.full((1, e), exp[0], jnp.int32)
+            xs = jnp.full((1, e), exp[1], jnp.int32)
+        st, r = eng.kv_step_scan(st, k, sl, v, lz, up,
+                                 exp_epoch=xe, exp_seq=xs)
+        return st, jax.tree.map(lambda x: np.asarray(x)[0], r)
+
+    def test_cas_on_current_vsn_commits(self):
+        st, up, e = self._setup()
+        st, r = self._one(st, up, e, eng.OP_PUT, 0, 11)
+        vsn = (int(r.obj_vsn[0, 0]), int(r.obj_vsn[0, 1]))
+        st, r = self._one(st, up, e, eng.OP_CAS, 0, 22, exp=vsn)
+        assert r.committed.all()
+        st, r = self._one(st, up, e, eng.OP_GET, 0, 0)
+        assert (r.value == 22).all()
+
+    def test_cas_on_stale_vsn_fails_value_untouched(self):
+        st, up, e = self._setup()
+        st, r1 = self._one(st, up, e, eng.OP_PUT, 0, 11)
+        old = (int(r1.obj_vsn[0, 0]), int(r1.obj_vsn[0, 1]))
+        st, _ = self._one(st, up, e, eng.OP_PUT, 0, 12)  # bumps vsn
+        st, r = self._one(st, up, e, eng.OP_CAS, 0, 99, exp=old)
+        assert not r.committed.any()
+        assert not r.get_ok.any()
+        st, r = self._one(st, up, e, eng.OP_GET, 0, 0)
+        assert (r.value == 12).all()
+
+    def test_cas_create_if_missing(self):
+        st, up, e = self._setup()
+        st, r = self._one(st, up, e, eng.OP_CAS, 3, 7, exp=(0, 0))
+        assert r.committed.all()
+        st, r = self._one(st, up, e, eng.OP_GET, 3, 0)
+        assert (r.value == 7).all()
+        # put-once: a second create-expecting-absent must fail
+        st, r = self._one(st, up, e, eng.OP_CAS, 3, 8, exp=(0, 0))
+        assert not r.committed.any()
+        st, r = self._one(st, up, e, eng.OP_GET, 3, 0)
+        assert (r.value == 7).all()
+
+    def test_cas_delete_via_tombstone(self):
+        """ksafe_delete: CAS to val 0 (tombstone) with the read vsn."""
+        st, up, e = self._setup()
+        st, r = self._one(st, up, e, eng.OP_PUT, 1, 5)
+        vsn = (int(r.obj_vsn[0, 0]), int(r.obj_vsn[0, 1]))
+        st, r = self._one(st, up, e, eng.OP_CAS, 1, 0, exp=vsn)
+        assert r.committed.all()
+        st, r = self._one(st, up, e, eng.OP_GET, 1, 0)
+        assert r.get_ok.all() and not r.found.any()
+
+    def test_cas_within_one_scan_serializes(self):
+        """Two CAS with the same expected vsn riding one scan: the
+        first wins, the second fails (per-key serialization)."""
+        st, up, e = self._setup()
+        st, r = self._one(st, up, e, eng.OP_PUT, 0, 1)
+        ve, vs = int(r.obj_vsn[0, 0]), int(r.obj_vsn[0, 1])
+        kind = jnp.full((2, e), eng.OP_CAS, jnp.int32)
+        slot = jnp.zeros((2, e), jnp.int32)
+        val = jnp.asarray(np.broadcast_to(np.array([[21], [22]]),
+                                          (2, e)), jnp.int32)
+        xe = jnp.full((2, e), ve, jnp.int32)
+        xs = jnp.full((2, e), vs, jnp.int32)
+        st, r = eng.kv_step_scan(st, kind, slot, val,
+                                 jnp.ones((2, e), bool), up,
+                                 exp_epoch=xe, exp_seq=xs)
+        committed = np.asarray(r.committed)
+        assert committed[0].all() and not committed[1].any()
+        st, r = self._one(st, up, e, eng.OP_GET, 0, 0)
+        assert (r.value == 21).all()
+
+    def test_cas_after_failover_needs_fresh_read(self):
+        """A new epoch's GET rewrites the object (update_key), so a
+        CAS with the pre-failover vsn fails until re-read."""
+        st, up, e = self._setup()
+        st, r = self._one(st, up, e, eng.OP_PUT, 0, 9)
+        old = (int(r.obj_vsn[0, 0]), int(r.obj_vsn[0, 1]))
+        up2 = up.at[:, 0].set(False)
+        st, won = eng.elect_step(st, jnp.ones((e,), bool),
+                                 jnp.ones((e,), jnp.int32), up2)
+        assert np.asarray(won).all()
+        st, r = self._one(st, up2, e, eng.OP_GET, 0, 0)  # rewrites
+        fresh = (int(r.obj_vsn[0, 0]), int(r.obj_vsn[0, 1]))
+        assert fresh != old
+        st, r = self._one(st, up2, e, eng.OP_CAS, 0, 33, exp=old)
+        assert not r.committed.any()
+        st, r = self._one(st, up2, e, eng.OP_CAS, 0, 33, exp=fresh)
+        assert r.committed.all()
+
+
+@pytest.mark.parametrize("mesh_shape", [(4, 2)])
+def test_sharded_cas_matches_single_device(mesh_shape):
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    n_ens, n_peer = mesh_shape
+    e, m = 8, 8
+    se = ShardedEngine(make_mesh(n_ens, n_peer))
+    views = [list(range(5))]
+
+    def run(stepper, state):
+        up = jnp.ones((e, m), bool)
+        state, won = stepper.elect_step(
+            state, jnp.ones((e,), bool), jnp.zeros((e,), jnp.int32), up)
+        # put, then a matching CAS, then a stale CAS, then a get
+        kind = jnp.asarray(np.stack(
+            [np.full(e, eng.OP_PUT), np.full(e, eng.OP_CAS),
+             np.full(e, eng.OP_CAS), np.full(e, eng.OP_GET)]), jnp.int32)
+        slot = jnp.ones((4, e), jnp.int32)
+        val = jnp.asarray(np.stack([np.full(e, 5), np.full(e, 6),
+                                    np.full(e, 7), np.zeros(e)]),
+                          jnp.int32)
+        xe = jnp.ones((4, e), jnp.int32)       # epoch 1 after election
+        xs = jnp.asarray(np.stack([np.zeros(e), np.ones(e),
+                                   np.ones(e), np.zeros(e)]), jnp.int32)
+        lease = jnp.ones((4, e), bool)
+        state, res = stepper.kv_step_scan(state, kind, slot, val, lease,
+                                          up, exp_epoch=xe, exp_seq=xs)
+        return won, res, state
+
+    class Single:
+        elect_step = staticmethod(eng.elect_step)
+        kv_step_scan = staticmethod(eng.kv_step_scan)
+
+    a = run(Single(), eng.init_state(e, m, S, views=views))
+    b = run(se, se.init_state(e, m, S, views=views))
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    _, res, _ = a
+    committed = np.asarray(res.committed)
+    # matching CAS commits, stale CAS fails, get sees the CAS value
+    assert committed[1].all() and not committed[2].any()
+    np.testing.assert_array_equal(np.asarray(res.value[3]), 6)
+
+
+class TestCasIntegrity:
+    """CAS create-if-missing interacts with tombstones and the
+    integrity gate exactly like the GET notfound dance."""
+
+    def _setup(self, e=2, m=5):
+        st = eng.init_state(e, m, S)
+        up = jnp.ones((e, m), bool)
+        st, won = eng.elect_step(st, jnp.ones((e,), bool),
+                                 jnp.zeros((e,), jnp.int32), up)
+        assert np.asarray(won).all()
+        return st, up, e
+
+    def _one(self, st, up, e, kind, slot, val, exp=(0, 0)):
+        k = jnp.full((1, e), kind, jnp.int32)
+        sl = jnp.full((1, e), slot, jnp.int32)
+        v = jnp.full((1, e), val, jnp.int32)
+        st, r = eng.kv_step_scan(
+            st, k, sl, v, jnp.ones((1, e), bool), up,
+            exp_epoch=jnp.full((1, e), exp[0], jnp.int32),
+            exp_seq=jnp.full((1, e), exp[1], jnp.int32))
+        return st, jax.tree.map(lambda x: np.asarray(x)[0], r)
+
+    def test_cas_create_over_tombstone(self):
+        """do_kput_once succeeds over a notfound-valued object
+        (peer.py:1462-1467): a (0,0) CAS must too, or recycled slots
+        (which keep the old key's tombstone) livelock creation."""
+        st, up, e = self._setup()
+        st, r = self._one(st, up, e, eng.OP_PUT, 0, 5)
+        vsn = (int(r.obj_vsn[0, 0]), int(r.obj_vsn[0, 1]))
+        st, r = self._one(st, up, e, eng.OP_CAS, 0, 0, exp=vsn)  # delete
+        assert r.committed.all()
+        st, r = self._one(st, up, e, eng.OP_CAS, 0, 7, exp=(0, 0))
+        assert r.committed.all()
+        st, r = self._one(st, up, e, eng.OP_GET, 0, 0)
+        assert (r.value == 7).all()
+
+    def test_cas_create_refused_when_all_holders_corrupt(self):
+        """Corrupting every holder's stored object makes the slot look
+        absent to the integrity gate; a (0,0) CAS must NOT commit over
+        it (the nf_quorum guard, same as the GET tombstone path)."""
+        st, up, e = self._setup()
+        st, r = self._one(st, up, e, eng.OP_PUT, 3, 42)
+        assert r.committed.all()
+        # out-of-band damage on EVERY replica's object at the slot
+        st = st._replace(obj_val=st.obj_val.at[:, :, 3].set(999))
+        st, r = self._one(st, up, e, eng.OP_CAS, 3, 1, exp=(0, 0))
+        assert not r.committed.any(), \
+            "CAS overwrote data the integrity gate had excluded"

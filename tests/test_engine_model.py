@@ -84,11 +84,12 @@ class ScalarModel:
         epoch_ok = self._met(ack) and has_leader and leader_up
         return heard, leader_up, lead_epoch, epoch_ok
 
-    def kv(self, kind, slot, val, lease_ok, up, ctx=None):
+    def kv(self, kind, slot, val, lease_ok, up, ctx=None, exp=(0, 0)):
         heard, leader_up, lead_epoch, epoch_ok = \
             ctx if ctx is not None else self._context(up)
         is_put = kind == eng.OP_PUT
         is_get = kind == eng.OP_GET
+        is_cas = kind == eng.OP_CAS
         slot_valid = 0 <= slot < self.s
 
         # newest among heard replicas at slot
@@ -127,10 +128,19 @@ class ScalarModel:
                   or (nf and (all_ok or not slot_valid or nf_write)))
 
         put_commit = is_put and epoch_ok and slot_valid
-        commit = put_commit or rewrite or nf_write
+        # CAS: expected vsn vs the CURRENT stored winner, atomically
+        # this round; (0, 0) matches a tombstone (put-once over
+        # notfound) or true absence confirmed by a notfound quorum.
+        exp_absent = tuple(exp) == (0, 0)
+        vsn_match = ((obj_found and (rd_epoch, rd_seq) == tuple(exp))
+                     or (exp_absent and obj_found and rd_val == 0)
+                     or (exp_absent and not obj_found and nf_quorum))
+        cas_commit = is_cas and epoch_ok and slot_valid and vsn_match
+        commit = put_commit or cas_commit or rewrite or nf_write
         if commit:
             new_seq = self.ctr + 1
-            wval = val if is_put else (rd_val if rewrite else 0)
+            wval = (val if (is_put or is_cas)
+                    else (rd_val if rewrite else 0))
             for p in range(self.m):
                 if heard[p]:
                     self.store[p][slot] = (lead_epoch, new_seq, wval)
@@ -143,7 +153,9 @@ class ScalarModel:
                 if heard[p] and self.store[p][slot] != (rd_epoch, rd_seq,
                                                         rd_val):
                     self.store[p][slot] = (rd_epoch, rd_seq, rd_val)
-            out_vsn = (rd_epoch, rd_seq) if found else (0, 0)
+            # vsn reported for tombstones too (the notfound obj
+            # carries its version, peer.erl:1568-1584)
+            out_vsn = (rd_epoch, rd_seq)
         else:
             out_vsn = (0, 0)
         return {
@@ -154,11 +166,14 @@ class ScalarModel:
             "obj_vsn": out_vsn,
         }
 
-    def kv_scan(self, kinds, slots, vals, leases, up):
+    def kv_scan(self, kinds, slots, vals, leases, up, exps=None):
         # context is computed once per launch (ballot state invariant)
         ctx = self._context(up)
-        return [self.kv(k, sl, v, lz, up, ctx)
-                for k, sl, v, lz in zip(kinds, slots, vals, leases)]
+        if exps is None:
+            exps = [(0, 0)] * len(kinds)
+        return [self.kv(k, sl, v, lz, up, ctx, xp)
+                for k, sl, v, lz, xp in zip(kinds, slots, vals, leases,
+                                            exps)]
 
 
 def _random_views(rng, m):
@@ -201,23 +216,48 @@ def test_engine_matches_scalar_model(seed):
                                          int(cand_np[i]), up_np[i])
                 assert won_np[i] == expect, (seed, step, i)
         else:
-            kinds = rng.choice([eng.OP_NOOP, eng.OP_GET, eng.OP_PUT],
-                               (k, e)).astype(np.int32)
+            kinds = rng.choice(
+                [eng.OP_NOOP, eng.OP_GET, eng.OP_PUT, eng.OP_CAS],
+                (k, e)).astype(np.int32)
             slots = rng.integers(-1, s + 1, (k, e)).astype(np.int32)
             vals = rng.integers(1, 1000, (k, e)).astype(np.int32)
             leases = rng.random((k, e)) < 0.5
+            # CAS expected versions: mix of the pre-launch stored
+            # winner (likely-succeeding), absent (0,0), and garbage.
+            exp_e = np.zeros((k, e), np.int32)
+            exp_s = np.zeros((k, e), np.int32)
+            for j in range(k):
+                for i in range(e):
+                    if kinds[j, i] != eng.OP_CAS:
+                        continue
+                    mode = rng.random()
+                    sl = slots[j, i]
+                    if mode < 0.45 and 0 <= sl < s:
+                        md = models[i]
+                        cands = [md.store[p][sl] for p in range(m)
+                                 if up_np[i, p] and md.store[p][sl][1] > 0]
+                        if cands:
+                            best = max(cands)
+                            exp_e[j, i], exp_s[j, i] = best[0], best[1]
+                    elif mode < 0.7:
+                        pass  # (0, 0): create-if-missing attempt
+                    else:
+                        exp_e[j, i] = rng.integers(0, 4)
+                        exp_s[j, i] = rng.integers(0, 6)
             state, res = eng.kv_step_scan(
                 state, jnp.asarray(kinds), jnp.asarray(slots),
-                jnp.asarray(vals), jnp.asarray(leases), up)
+                jnp.asarray(vals), jnp.asarray(leases), up,
+                exp_epoch=jnp.asarray(exp_e), exp_seq=jnp.asarray(exp_s))
             committed = np.asarray(res.committed)
             get_ok = np.asarray(res.get_ok)
             found = np.asarray(res.found)
             value = np.asarray(res.value)
             vsn = np.asarray(res.obj_vsn)
             for i in range(e):
-                exp = models[i].kv_scan(kinds[:, i], slots[:, i],
-                                        vals[:, i], leases[:, i],
-                                        up_np[i])
+                exp = models[i].kv_scan(
+                    kinds[:, i], slots[:, i], vals[:, i], leases[:, i],
+                    up_np[i],
+                    exps=list(zip(exp_e[:, i], exp_s[:, i])))
                 for j in range(k):
                     tag = (seed, step, i, j)
                     assert committed[j, i] == exp[j]["committed"], tag

@@ -75,6 +75,9 @@ def test_service_linearizable_under_nemesis(seed):
               for e in range(N_ENS) for k in range(N_KEYS)}
     vals = itertools.count(1)
     down = {}  # ens -> peer index currently down
+    #: last vsn seen in a write ack per (ens, key) — CAS ops use it
+    #: (sometimes deliberately stale)
+    vsns = {}
 
     for _round in range(ROUNDS):
         # -- nemesis: up-mask + membership churn -------------------------
@@ -117,7 +120,7 @@ def test_service_linearizable_under_nemesis(seed):
             m = models[(e, k)]
             key = f"key{k}"
             op = rng.random()
-            if op < 0.5:
+            if op < 0.4:
                 payload = f"{seed}-{next(vals)}".encode()
                 op_id = m.invoke_write(payload)
                 fut = svc.kput(e, key, payload)
@@ -126,6 +129,28 @@ def test_service_linearizable_under_nemesis(seed):
                     m.fail_write(op_id)
                 else:
                     pending.append(("put", m, op_id, fut, payload))
+
+                def _track(res, ek=(e, k)):
+                    if isinstance(res, tuple) and res[0] == "ok":
+                        vsns[ek] = res[1]
+                fut.add_waiter(_track)
+            elif op < 0.55:
+                # CAS on the last acked vsn (sometimes stale by now —
+                # then it must fail cleanly; the model's fail_write
+                # matches the engine's all-or-nothing CAS)
+                payload = f"{seed}-{next(vals)}".encode()
+                exp = vsns.get((e, k), (0, 0))
+                op_id = m.invoke_write(payload)
+                fut = svc.kupdate(e, key, exp, payload)
+                if fut.done and fut.value == "failed":
+                    m.fail_write(op_id)
+                else:
+                    pending.append(("put", m, op_id, fut, payload))
+
+                def _track2(res, ek=(e, k)):
+                    if isinstance(res, tuple) and res[0] == "ok":
+                        vsns[ek] = res[1]
+                fut.add_waiter(_track2)
             elif op < 0.85:
                 pending.append(("get", m, None, svc.kget(e, key), None))
             else:
