@@ -679,12 +679,45 @@ class BatchedEnsembleService:
                 self.state, jnp.asarray(run), jnp.asarray(self.up))
             self.repairs += int(
                 np.asarray(diverged)[np.asarray(synced)].sum())
+            self._emit("svc_exchange", {"ensembles": int(run.sum())})
         self.flushes += 1
+        self._emit("svc_launch", {
+            "k": k, "elections": int(elect.sum()),
+            "won": int(won_np.sum()),
+            "corrupt_replicas": (int(corrupt.sum())
+                                 if corrupt is not None else 0),
+        })
         return committed, get_ok, found, value, vsn
 
+    def _emit(self, kind: str, payload: Any) -> None:
+        """Feed the runtime's tracing hook (utils.trace.Tracer) when
+        one is installed; free otherwise."""
+        tr = getattr(self.runtime, "trace", None)
+        if tr is not None:
+            tr(kind, payload)
+
+    def stats(self) -> Dict[str, Any]:
+        """Observability snapshot (the get_info/count_quorum analog
+        for the scale path)."""
+        return {
+            "flushes": self.flushes,
+            "ops_served": self.ops_served,
+            "corruptions_detected": self.corruptions,
+            "replicas_repaired": self.repairs,
+            "live_payloads": len(self.values),
+            "ensembles_with_leader": int((self.leader_np >= 0).sum()),
+            "membership_changes_in_flight": int(
+                (self._desired_mask | self._pending_mask
+                 | self._queued_mask).sum()),
+            "queued_ops": sum(len(q) for q in self.queues),
+        }
+
     def execute(self, kind: np.ndarray, slot: np.ndarray,
-                val: np.ndarray) -> Tuple[np.ndarray, np.ndarray,
-                                          np.ndarray, np.ndarray]:
+                val: np.ndarray,
+                exp_epoch: Optional[np.ndarray] = None,
+                exp_seq: Optional[np.ndarray] = None
+                ) -> Tuple[np.ndarray, np.ndarray,
+                           np.ndarray, np.ndarray]:
         """Bulk array API: run ``[K, E]`` op matrices through the
         service in one launch and return ``(committed, get_ok, found,
         value)`` as ``[K, E]`` arrays.
@@ -706,7 +739,11 @@ class BatchedEnsembleService:
                              "(int32 handles; 0 = tombstone/delete)")
         k = int(kind.shape[0])
         committed, get_ok, found, value, _ = self._launch(
-            kind, np.asarray(slot, np.int32), val, k, want_vsn=False)
+            kind, np.asarray(slot, np.int32), val, k, want_vsn=False,
+            exp_e=None if exp_epoch is None
+            else np.asarray(exp_epoch, np.int32),
+            exp_s=None if exp_seq is None
+            else np.asarray(exp_seq, np.int32))
         self.ops_served += int((np.asarray(kind) != eng.OP_NOOP).sum())
         return committed, get_ok, found, value
 

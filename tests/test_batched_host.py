@@ -506,3 +506,43 @@ def test_service_create_if_missing_on_recycled_slot():
     r = settle(runtime, svc.kupdate(0, "new", (0, 0), b"y"))
     assert r[0] == "ok", r
     assert settle(runtime, svc.kget(0, "new")) == ("ok", b"y")
+
+
+def test_service_stats_and_trace():
+    from riak_ensemble_tpu.utils.trace import Tracer
+
+    runtime, svc = make_service(n_ens=2, n_peers=3, n_slots=4)
+    tracer = Tracer(runtime).install()
+    assert settle(runtime, svc.kput(0, "k", b"v"))[0] == "ok"
+    assert settle(runtime, svc.kget(1, "k")) == ("ok", NOTFOUND)
+    st = svc.stats()
+    assert st["flushes"] >= 1 and st["ops_served"] >= 1
+    assert st["ensembles_with_leader"] == 2
+    assert st["live_payloads"] == 1
+    assert tracer.counters.get("svc_launch", 0) >= 1
+    tracer.uninstall()
+
+
+def test_service_execute_with_cas_planes():
+    """Bulk array API: CAS planes flow through execute()."""
+    runtime, svc = make_service(n_ens=4, n_peers=3, n_slots=8)
+    from riak_ensemble_tpu.ops import engine as eng2
+
+    kind = np.full((1, 4), eng2.OP_PUT, np.int32)
+    slot = np.zeros((1, 4), np.int32)
+    val = np.full((1, 4), 10, np.int32)
+    committed, *_ = svc.execute(kind, slot, val)
+    assert committed.all()
+    # CAS expecting (epoch=1, seq=1) after the first commit
+    kind[:] = eng2.OP_CAS
+    val[:] = 20
+    xe = np.ones((1, 4), np.int32)
+    xs = np.ones((1, 4), np.int32)
+    committed, *_ = svc.execute(kind, slot, val, exp_epoch=xe, exp_seq=xs)
+    assert committed.all()
+    # stale now
+    committed, *_ = svc.execute(kind, slot, val, exp_epoch=xe, exp_seq=xs)
+    assert not committed.any()
+    kind[:] = eng2.OP_GET
+    _, get_ok, found, value = svc.execute(kind, slot, np.zeros_like(val))
+    assert get_ok.all() and found.all() and (value == 20).all()
