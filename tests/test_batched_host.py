@@ -546,3 +546,33 @@ def test_service_execute_with_cas_planes():
     kind[:] = eng2.OP_GET
     _, get_ok, found, value = svc.execute(kind, slot, np.zeros_like(val))
     assert get_ok.all() and found.all() and (value == 20).all()
+
+
+def test_service_on_netruntime_asyncio():
+    """The engine-backed service runs on the real-time asyncio runtime
+    (NetRuntime) with wall-clock flush ticks — the single-host
+    production composition of the DCN/host half and the device
+    engine."""
+    import asyncio
+
+    from riak_ensemble_tpu.netruntime import NetRuntime
+
+    async def scenario():
+        runtime = NetRuntime("node0", {"node0": ("127.0.0.1", 0)})
+        runtime.loop = asyncio.get_running_loop()
+        svc = BatchedEnsembleService(runtime, 4, 3, n_slots=4,
+                                     tick=0.01,
+                                     config=fast_test_config())
+        r = await runtime.await_future(svc.kput(0, "k", b"v"), 10.0)
+        assert r[0] == "ok"
+        vsn = r[1]
+        r = await runtime.await_future(svc.kget(0, "k"), 10.0)
+        assert r == ("ok", b"v")
+        r = await runtime.await_future(
+            svc.kupdate(0, "k", vsn, b"v2"), 10.0)
+        assert r[0] == "ok"
+        r = await runtime.await_future(svc.kget(0, "k"), 10.0)
+        assert r == ("ok", b"v2")
+        svc.stop()
+
+    asyncio.run(scenario())
