@@ -28,7 +28,10 @@ L3    communication/quorum     msg, router, ops.quorum, ops.pallas_quorum
 L4    consensus core           peer, worker, lease, backend
 L5    cluster management       manager, root, state
 L6    client API               client, netnode (async)
---    batched TPU engine       ops.engine, parallel.mesh
+--    batched TPU engine       ops.engine, parallel.mesh,
+                               parallel.batched_host (the scale-path
+                               service), parallel.distributed
+--    wire safety              wire (restricted codec), funref
 --    testing/verification     testing, linearizability, utils.trace
 ====  =======================  ============================================
 """

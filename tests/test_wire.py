@@ -156,3 +156,44 @@ def test_decode_malformed_raises_wireerror_only():
     for payload in bad:
         with pytest.raises(wire.WireError):
             wire.decode(payload)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_roundtrip_random_structures(seed):
+    """Seeded structural fuzz: random nested allowlisted values must
+    round-trip exactly (type-preserving)."""
+    import numpy as _np
+
+    rng = _np.random.default_rng(seed)
+
+    def gen(depth=0):
+        choices = 10 if depth < 4 else 6  # leaves only when deep
+        c = int(rng.integers(choices))
+        if c == 0:
+            return None
+        if c == 1:
+            return bool(rng.integers(2))
+        if c == 2:
+            return int(rng.integers(-2**40, 2**40))
+        if c == 3:
+            return float(rng.normal())
+        if c == 4:
+            return bytes(rng.integers(0, 256, int(rng.integers(0, 12)),
+                                      dtype=_np.uint8))
+        if c == 5:
+            return "".join(chr(int(rng.integers(32, 1000)))
+                           for _ in range(int(rng.integers(0, 8))))
+        n = int(rng.integers(0, 4))
+        if c == 6:
+            return tuple(gen(depth + 1) for _ in range(n))
+        if c == 7:
+            return [gen(depth + 1) for _ in range(n)]
+        if c == 8:
+            return {int(rng.integers(100)): gen(depth + 1)
+                    for _ in range(n)}
+        return PeerId(int(rng.integers(10)), f"n{int(rng.integers(4))}")
+
+    for _ in range(200):
+        v = gen()
+        out = wire.decode(wire.encode(v))
+        assert out == v and type(out) is type(v)
