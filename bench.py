@@ -1,9 +1,7 @@
 """Headline benchmark: the END-TO-END service, plus the raw kernel.
 
 Scenario 3 of the BASELINE.md ladder: 10k ensembles x 5 peers of mixed
-kput/kget.  Two numbers, measured in this order (a d2h transfer
-permanently degrades dispatch on the tunneled chip, so the no-d2h
-kernel loop runs first):
+kput/kget.  Two numbers:
 
 1. ``engine_kernel_rounds_per_sec`` — raw ``kv_step_scan`` launches,
    device math only (ballots, quorum reduce, store, Merkle maintenance;
@@ -18,22 +16,52 @@ kernel loop runs first):
 The reference publishes no numbers (BASELINE.md); the driver north-star
 target of 1M linearizable ops/sec is the ``vs_baseline`` denominator.
 
+Resilience: the tunneled TPU backend intermittently wedges (observed:
+a compile that normally takes 26 s hanging > 10 min, with d2h
+transfers additionally degrading dispatch).  A hung bench would leave
+the round with NO number, so the orchestrator runs each stage in a
+subprocess under a hard timeout and falls back — full shapes → smaller
+shapes → forced-CPU — recording the platform and shape actually
+measured.  Numbers are never silently substituted: the metric name and
+``platform`` field say exactly what ran.
+
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "ops/sec", "vs_baseline": N,
    "p50_commit_latency_ms": ..., "p99_commit_latency_ms": ...,
-   "engine_kernel_rounds_per_sec": ...}
+   "engine_kernel_rounds_per_sec": ..., "platform": ...}
 
-``--smoke`` shrinks shapes for a CPU sanity run.
+``--smoke`` shrinks shapes for a CPU sanity run (single process).
+``--stage ...`` runs one stage in-process (the orchestrator's worker).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+
+def _setup_jax(force_cpu: bool) -> None:
+    """Per-stage JAX config: persistent compile cache (retries and
+    re-runs skip the 20-40 s compiles) and an optional CPU pin (the
+    environment's sitecustomize pins jax_platforms to the TPU tunnel,
+    so the pin must override the config, not just the env var)."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(os.path.dirname(
+                              os.path.abspath(__file__)), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # cache is best-effort; older jax may lack the knobs
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
 
 
 def run_service(n_ens: int, n_peers: int, n_slots: int, k: int,
@@ -235,6 +263,84 @@ def run_reconfig(seconds: float, smoke: bool) -> dict:
     }
 
 
+#: fallback ladder: (label, shapes, per-stage subprocess timeout).
+#: Full TPU shapes first; smaller shapes if the backend is too slow to
+#: compile/run the big ones; forced-CPU small shapes as the last
+#: resort so SOME honest number always lands.
+_ATTEMPTS = (
+    ("10k_ens_5_peers",
+     dict(n_ens=10_000, n_peers=5, n_slots=128, k=64), 420.0, False),
+    ("1k_ens_5_peers",
+     dict(n_ens=1_000, n_peers=5, n_slots=128, k=32), 300.0, False),
+    ("1k_ens_5_peers_cpu",
+     dict(n_ens=1_000, n_peers=5, n_slots=128, k=32), 300.0, True),
+)
+
+
+def _run_stage(stage: str, label: str, shapes: dict, seconds: float,
+               timeout: float, force_cpu: bool):
+    """Run one stage in a subprocess; parse its JSON line; None on
+    timeout/crash (a wedged TPU RPC ignores signals — only a
+    subprocess kill reliably unsticks the bench).
+
+    The budget scales with the requested measurement time (the
+    constant part covers compile + warmup + transfers).  The worker
+    runs in its own session and the whole process GROUP is killed on
+    timeout — a wedged tunnel helper holding the inherited stdout
+    pipe would otherwise block the drain forever.
+    """
+    import signal
+
+    timeout = timeout + max(0.0, (seconds - 3.0) * 4.0)
+    cmd = [sys.executable, os.path.abspath(__file__), "--stage", stage,
+           "--seconds", str(seconds)]
+    for f, v in shapes.items():
+        cmd += [f"--{f.replace('_', '-')}", str(v)]
+    if force_cpu:
+        cmd.append("--force-cpu")
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            out, err = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            out = err = ""
+        print(f"# stage {stage}@{label}: timeout after {timeout}s",
+              file=sys.stderr)
+        return None
+    if proc.returncode != 0:
+        print(f"# stage {stage}@{label}: rc={proc.returncode} "
+              f"{err[-400:]}", file=sys.stderr)
+        return None
+    for line in reversed(out.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def _stage_entry(args) -> None:
+    """Worker mode: one stage, one process, one JSON line on stdout."""
+    _setup_jax(args.force_cpu)
+    shapes = dict(n_ens=args.n_ens, n_peers=args.n_peers,
+                  n_slots=args.n_slots, k=args.k)
+    if args.stage == "kernel":
+        out = {"kernel_rounds_per_sec": run(seconds=args.seconds, **shapes)}
+    else:
+        out = run_service(seconds=args.seconds, **shapes)
+    import jax
+    out["platform"] = jax.devices()[0].platform
+    print(json.dumps(out))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -244,35 +350,83 @@ def main() -> None:
                     choices=("kv", "merkle", "reconfig"),
                     help="kv = headline (driver default); merkle / "
                          "reconfig = BASELINE.md ladder #4 / #5")
+    ap.add_argument("--stage", choices=("kernel", "service"),
+                    help="internal: run one stage in-process")
+    ap.add_argument("--n-ens", type=int, default=10_000)
+    ap.add_argument("--n-peers", type=int, default=5)
+    ap.add_argument("--n-slots", type=int, default=128)
+    ap.add_argument("--k", type=int, default=64)
+    ap.add_argument("--force-cpu", action="store_true")
     args = ap.parse_args()
 
+    if args.stage:
+        _stage_entry(args)
+        return
     if args.scenario == "merkle":
+        _setup_jax(False)
         print(json.dumps(run_merkle(args.seconds, args.smoke)))
         return
     if args.scenario == "reconfig":
+        _setup_jax(False)
         print(json.dumps(run_reconfig(args.seconds, args.smoke)))
         return
 
     if args.smoke:
+        _setup_jax(False)
         shapes = dict(n_ens=64, n_peers=5, n_slots=32, k=4)
         secs = min(args.seconds, 1.0)
+        kernel_rounds = run(seconds=secs, **shapes)
+        svc = run_service(seconds=secs, **shapes)
+        svc["kernel_rounds_per_sec"] = kernel_rounds
+        svc["platform"] = "smoke"
+        label = "64_ens_5_peers_smoke"
     else:
-        shapes = dict(n_ens=10_000, n_peers=5, n_slots=128, k=64)
-        secs = args.seconds
-    # Kernel first: it must run before any d2h (see module docstring).
-    kernel_rounds = run(seconds=secs, **shapes)
-    svc = run_service(seconds=secs, **shapes)
+        # Within a label the kernel stage runs FIRST: a d2h transfer
+        # degrades subsequent dispatch on the tunneled chip (measured
+        # 40x) and that state has outlived processes before, so the
+        # service stage (d2h every batch) must not precede the kernel
+        # measurement.  Both stages get the fallback ladder — the
+        # first label where the service (the headline) succeeds wins,
+        # and the kernel keeps falling back independently if its
+        # attempt at that label failed.
+        svc = kern = None
+        kern_label = None
+        for label, shapes, budget, force_cpu in _ATTEMPTS:
+            if kern is None:
+                kern = _run_stage("kernel", label, shapes, args.seconds,
+                                  budget, force_cpu)
+                if kern is not None:
+                    kern_label = label
+            svc = _run_stage("service", label, shapes, args.seconds,
+                             budget, force_cpu)
+            if svc is not None:
+                svc["kernel_rounds_per_sec"] = (
+                    kern["kernel_rounds_per_sec"] if kern else None)
+                svc["kernel_label"] = kern_label
+                break
+        if svc is None:
+            print(json.dumps({
+                "metric": "service_linearizable_kv_ops_per_sec",
+                "value": 0, "unit": "ops/sec", "vs_baseline": 0.0,
+                "error": "every stage attempt timed out or crashed "
+                         "(TPU backend unreachable?)",
+            }))
+            sys.exit(1)
 
     baseline = 1_000_000.0  # north-star target (BASELINE.md)
     print(json.dumps({
-        "metric": "service_linearizable_kv_ops_per_sec_10k_ens_5_peers",
+        "metric": f"service_linearizable_kv_ops_per_sec_{label}",
         "value": round(svc["ops_per_sec"], 1),
         "unit": "ops/sec",
         "vs_baseline": round(svc["ops_per_sec"] / baseline, 3),
         "p50_commit_latency_ms": round(svc["p50_ms"], 3),
         "p99_commit_latency_ms": round(svc["p99_ms"], 3),
         "latency_batches": svc["batches"],
-        "engine_kernel_rounds_per_sec": round(kernel_rounds, 1),
+        "engine_kernel_rounds_per_sec": (
+            round(svc["kernel_rounds_per_sec"], 1)
+            if svc.get("kernel_rounds_per_sec") else None),
+        "kernel_label": svc.get("kernel_label", label),
+        "platform": svc.get("platform", "unknown"),
     }))
 
 
