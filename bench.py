@@ -400,10 +400,24 @@ def main() -> None:
             svc = _run_stage("service", label, shapes, args.seconds,
                              budget, force_cpu)
             if svc is not None:
-                svc["kernel_rounds_per_sec"] = (
-                    kern["kernel_rounds_per_sec"] if kern else None)
-                svc["kernel_label"] = kern_label
                 break
+        if svc is not None and kern is None:
+            # The headline landed but the kernel attempt at (or
+            # before) that label wedged: keep walking the remaining
+            # smaller/CPU rungs for the kernel number alone.
+            start = next(i for i, a in enumerate(_ATTEMPTS)
+                         if a[0] == label)
+            for label2, shapes2, budget2, force_cpu2 in \
+                    _ATTEMPTS[start + 1:]:
+                kern = _run_stage("kernel", label2, shapes2,
+                                  args.seconds, budget2, force_cpu2)
+                if kern is not None:
+                    kern_label = label2
+                    break
+        if svc is not None:
+            svc["kernel_rounds_per_sec"] = (
+                kern["kernel_rounds_per_sec"] if kern else None)
+            svc["kernel_label"] = kern_label
         if svc is None:
             print(json.dumps({
                 "metric": "service_linearizable_kv_ops_per_sec",

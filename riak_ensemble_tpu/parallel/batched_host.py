@@ -752,6 +752,17 @@ class BatchedEnsembleService:
         k = min(self.max_k, max((len(q) for q in self.queues), default=0))
         if k == 0 and not self._election_inputs()[0].any():
             return 0
+        # Bucket the batch depth to the next power of two (capped at
+        # max_k): XLA compiles one program per distinct [K, E] shape,
+        # so under skewed load a raw longest-queue K would trigger a
+        # 20-40 s compile for every new depth seen.  Padding rounds
+        # are NOOPs — microseconds of device math vs seconds of
+        # compile churn; at most 1+log2(max_k) variants ever compile.
+        if k:
+            b = 1
+            while b < k:
+                b <<= 1
+            k = min(b, self.max_k)
 
         kind = np.zeros((k, self.n_ens), dtype=np.int32)
         slot = np.zeros((k, self.n_ens), dtype=np.int32)

@@ -305,6 +305,38 @@ def test_service_skewed_queues():
         assert settle(runtime, svc.kget(e, "hot")) == ("ok", NOTFOUND)
 
 
+def test_service_flush_depth_buckets_to_pow2():
+    """Distinct [K, E] shapes each cost an XLA compile; flush must
+    bucket the batch depth to powers of two so skewed/varying queue
+    lengths don't trigger compile churn (one program per depth)."""
+    from riak_ensemble_tpu.parallel.batched_host import _LocalEngine
+
+    seen = []
+
+    class RecordingEngine(_LocalEngine):
+        @staticmethod
+        def full_step(state, elect, cand, kind, slot, val, lease_ok,
+                      up, **kw):
+            seen.append(int(kind.shape[0]))
+            return _LocalEngine.full_step(
+                state, elect, cand, kind, slot, val, lease_ok, up, **kw)
+
+    runtime = Runtime(seed=50)
+    svc = BatchedEnsembleService(runtime, 8, 3, 16, tick=None,
+                                 config=fast_test_config(),
+                                 engine=RecordingEngine())
+    for depth in (1, 2, 3, 5, 7, 11, 13):
+        futs = [svc.kput(0, f"k{i}", b"v") for i in range(depth)]
+        while any(svc.queues):
+            svc.flush()
+        for f in futs:
+            assert f.done and f.value[0] == "ok"
+    assert seen, "no launches recorded"
+    assert all(k & (k - 1) == 0 for k in seen), seen  # powers of two
+    # 7 distinct raw depths collapse into at most 5 compiled shapes
+    assert len(set(seen)) <= 5, seen
+
+
 def test_service_update_members_blocked_collapse_lands_later():
     """Install commits under the old view while the NEW view lacks
     quorum, so the collapse blocks; after healing, a later call (pure
