@@ -115,12 +115,55 @@ def run_service(n_ens: int, n_peers: int, n_slots: int, k: int,
     assert ok.all(), "service bench: ops failed"
     assert (np.asarray(svc.state.leader) >= 0).all()
     lat_ms = np.asarray(lat) * 1000.0
-    return {
+    out = {
         "ops_per_sec": ops / elapsed,
         "p50_ms": float(np.percentile(lat_ms, 50)),
         "p99_ms": float(np.percentile(lat_ms, 99)),
         "batches": len(lat),
     }
+    out["keyed_ops_per_sec"] = run_keyed_service(
+        min(n_ens, 1000), n_peers, n_slots, min(k, 16), seconds)
+    return out
+
+
+def run_keyed_service(n_ens: int, n_peers: int, n_slots: int, k: int,
+                      seconds: float) -> float:
+    """The FUTURE-BASED keyed path: kput/kget client futures queued
+    per ensemble, resolved through flush() against the real host
+    payload store (values are Python bytes behind int32 handles).
+    This measures what a keyed client observes — per-op Python
+    bookkeeping included — as distinct from the bulk array surface.
+    """
+    from riak_ensemble_tpu.parallel.batched_host import (
+        BatchedEnsembleService, WallRuntime,
+    )
+
+    svc = BatchedEnsembleService(WallRuntime(), n_ens, n_peers, n_slots,
+                                 tick=None, max_ops_per_tick=k)
+    # Warm up: allocate slots, compile the flush shape, elect.
+    futs = [svc.kput(e, f"key{j}", b"w%d" % j)
+            for e in range(n_ens) for j in range(k)]
+    while any(svc.queues):
+        svc.flush()
+    assert all(f.done and f.value[0] == "ok" for f in futs)
+
+    ops = 0
+    t_end = time.perf_counter() + max(seconds, 1e-3)
+    t0 = time.perf_counter()
+    while time.perf_counter() < t_end or not ops:
+        futs = []
+        for e in range(n_ens):
+            for j in range(k // 2):
+                futs.append(svc.kput(e, f"key{j}", b"v%d" % j))
+            for j in range(k // 2, k):
+                futs.append(svc.kget(e, f"key{j}"))
+        while any(svc.queues):
+            svc.flush()
+        ops += len(futs)
+    elapsed = time.perf_counter() - t0
+    assert all(f.done and f.value[0] == "ok" for f in futs), \
+        "keyed bench: ops failed"
+    return ops / elapsed
 
 
 def run(n_ens: int, n_peers: int, n_slots: int, k: int,
@@ -449,6 +492,9 @@ def main() -> None:
             round(svc["kernel_rounds_per_sec"], 1)
             if svc.get("kernel_rounds_per_sec") else None),
         "kernel_label": svc.get("kernel_label", label),
+        "keyed_service_ops_per_sec": (
+            round(svc["keyed_ops_per_sec"], 1)
+            if svc.get("keyed_ops_per_sec") else None),
         "platform": svc.get("platform", "unknown"),
     }))
 

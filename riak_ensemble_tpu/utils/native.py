@@ -26,13 +26,22 @@ NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 SONAME = os.path.join(NATIVE_DIR, "libretpu_native.so")
 
 
-def _build() -> bool:
+def build_target(target: str, artifact: str) -> bool:
+    """Run make for one target in ``native/``; True iff the artifact
+    exists afterwards.  make is invoked even when the artifact already
+    exists — a fast no-op when fresh, a rebuild when its source
+    changed (stale .so files otherwise survive source edits forever).
+    Shared by the ctypes library below and wire.py's codec loader."""
     try:
-        proc = subprocess.run(["make", "-C", NATIVE_DIR],
+        proc = subprocess.run(["make", "-C", NATIVE_DIR, target],
                               capture_output=True, timeout=120)
-        return proc.returncode == 0 and os.path.exists(SONAME)
+        return proc.returncode == 0 and os.path.exists(artifact)
     except Exception:
-        return False
+        return os.path.exists(artifact)
+
+
+def _build() -> bool:
+    return build_target("all", SONAME)
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -43,7 +52,7 @@ def load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(SONAME) and not _build():
+        if not _build():
             return None
         try:
             lib = ctypes.CDLL(SONAME)

@@ -119,11 +119,26 @@ def _encode(out: List[bytes], v: Any, depth: int = 0) -> None:
         raise WireError(f"type {t.__name__} is not wire-encodable")
 
 
-def encode(v: Any) -> bytes:
-    """Serialize an allowlisted value to a wire frame payload."""
+def encode_py(v: Any) -> bytes:
+    """Pure-Python encoder (fallback + differential-test oracle for
+    the native codec)."""
     out: List[bytes] = []
     _encode(out, v)
     return b"".join(out)
+
+
+def encode(v: Any) -> bytes:
+    """Serialize an allowlisted value to a wire frame payload.
+
+    Routed through the C++ codec (``native/wirecodec.cc`` — the
+    disterl-term-codec-in-C role) when it builds; byte-exact with
+    :func:`encode_py`, so native and Python frames are
+    interchangeable on the wire.
+    """
+    native = _native_codec()
+    if native is not None:
+        return native.encode(v)
+    return encode_py(v)
 
 
 class _Reader:
@@ -207,11 +222,56 @@ def _decode(r: _Reader, depth: int) -> Any:
     raise WireError(f"unknown tag {tag!r}")
 
 
-def decode(payload: bytes) -> Any:
-    """Deserialize a frame payload; raises WireError on anything
-    malformed or outside the allowlist."""
+def decode_py(payload: bytes) -> Any:
+    """Pure-Python decoder (fallback + differential-test oracle)."""
     r = _Reader(payload)
     v = _decode(r, 0)
     if r.pos != len(payload):
         raise WireError("trailing bytes in frame")
     return v
+
+
+def decode(payload: bytes) -> Any:
+    """Deserialize a frame payload; raises WireError on anything
+    malformed or outside the allowlist.  Routed through the C++
+    codec when available (same allowlist property: the native decoder
+    constructs only plain containers and registered records)."""
+    native = _native_codec()
+    if native is not None:
+        return native.decode(payload)
+    return decode_py(payload)
+
+
+_NATIVE = None
+_NATIVE_TRIED = False
+
+
+def _native_codec():
+    """Lazily build/load/register the C++ codec; None -> pure Python.
+    ``RETPU_NO_NATIVE_WIRE=1`` pins the Python paths (used by the
+    differential tests and as an operational escape hatch)."""
+    global _NATIVE, _NATIVE_TRIED
+    if _NATIVE_TRIED:
+        return _NATIVE
+    _NATIVE_TRIED = True
+    import os
+
+    if os.environ.get("RETPU_NO_NATIVE_WIRE"):
+        return None
+    try:
+        import importlib.util
+
+        from riak_ensemble_tpu.utils.native import NATIVE_DIR, build_target
+
+        so = os.path.join(NATIVE_DIR, "_retpu_wire.so")
+        if not build_target("_retpu_wire.so", so):
+            return None
+        spec = importlib.util.spec_from_file_location("_retpu_wire", so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.register([(cls, fields) for cls, fields in _RECORDS],
+                     NOTFOUND, WireError)
+        _NATIVE = mod
+    except Exception:
+        _NATIVE = None
+    return _NATIVE

@@ -197,3 +197,151 @@ def test_fuzz_roundtrip_random_structures(seed):
         v = gen()
         out = wire.decode(wire.encode(v))
         assert out == v and type(out) is type(v)
+
+
+# -- native codec (native/wirecodec.cc) differential tests ---------------
+
+def _native():
+    mod = wire._native_codec()
+    if mod is None:
+        pytest.skip("native wire codec unavailable (no toolchain)")
+    return mod
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_native_encode_byte_exact_with_python(seed):
+    """Native and Python frames must be interchangeable on the wire:
+    identical bytes for identical values (same tags, varints, int
+    widths, container order)."""
+    import numpy as _np
+
+    mod = _native()
+    rng = _np.random.default_rng(seed)
+
+    def gen(depth=0):
+        choices = 11 if depth < 4 else 6
+        c = int(rng.integers(choices))
+        if c == 0:
+            return None
+        if c == 1:
+            return bool(rng.integers(2))
+        if c == 2:
+            # spans the small-int fast path, the 8-byte boundary, and
+            # the arbitrary-precision slow path
+            return int(rng.integers(-2**40, 2**40)) << int(rng.integers(40))
+        if c == 3:
+            return float(rng.normal())
+        if c == 4:
+            return bytes(rng.integers(0, 256, int(rng.integers(0, 12)),
+                                      dtype=_np.uint8))
+        if c == 5:
+            return "".join(chr(int(rng.integers(32, 1000)))
+                           for _ in range(int(rng.integers(0, 8))))
+        n = int(rng.integers(0, 4))
+        if c == 6:
+            return tuple(gen(depth + 1) for _ in range(n))
+        if c == 7:
+            return [gen(depth + 1) for _ in range(n)]
+        if c == 8:
+            return {int(rng.integers(100)): gen(depth + 1)
+                    for _ in range(n)}
+        if c == 9:
+            return frozenset(int(rng.integers(1000)) for _ in range(n))
+        return PeerId(int(rng.integers(10)), f"n{int(rng.integers(4))}")
+
+    for _ in range(300):
+        v = gen()
+        py = wire.encode_py(v)
+        assert mod.encode(v) == py, v
+        got = mod.decode(py)
+        assert got == v and type(got) is type(v)
+
+
+def test_native_int_edges_byte_exact():
+    mod = _native()
+    edges = [0, 1, -1, 127, 128, 129, -127, -128, -129, 255, 256,
+             2**31 - 1, 2**31, -2**31, -2**31 - 1, 2**62, 2**63 - 1,
+             2**63, -2**63, -2**63 - 1, 2**64, 2**200, -2**200]
+    for v in edges:
+        assert mod.encode(v) == wire.encode_py(v), v
+        assert mod.decode(wire.encode_py(v)) == v, v
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_native_decode_error_parity_on_random_bytes(seed):
+    """Hostile-input agreement: for random byte soup both decoders
+    either produce the same value or both raise WireError (and the
+    native one never raises anything else, segfaults excepted by
+    construction)."""
+    import numpy as _np
+
+    mod = _native()
+    rng = _np.random.default_rng(1000 + seed)
+    for _ in range(2000):
+        blob = bytes(rng.integers(0, 256, int(rng.integers(1, 40)),
+                                  dtype=_np.uint8))
+        try:
+            a = ("ok", wire.decode_py(blob))
+        except wire.WireError:
+            a = ("err",)
+        try:
+            b = ("ok", mod.decode(blob))
+        except wire.WireError:
+            b = ("err",)
+        if a[0] == b[0] == "ok":
+            assert wire.encode_py(a[1]) == wire.encode_py(b[1]), \
+                (blob.hex(), a, b)
+        else:
+            assert a[0] == b[0], (blob.hex(), a, b)
+
+
+def test_native_mutated_valid_frames_error_parity():
+    """Mutations of VALID frames (bit flips, truncation, extension)
+    hit deeper decode paths than raw byte soup."""
+    import numpy as _np
+
+    mod = _native()
+    rng = _np.random.default_rng(4242)
+    base = wire.encode_py(
+        {"k": (PeerId(1, "n1"), [1.5, NOTFOUND, -2**70, "déjà"],
+               frozenset({1, 2}), b"\x00\xff")})
+    for _ in range(3000):
+        blob = bytearray(base)
+        for _m in range(int(rng.integers(1, 4))):
+            op = int(rng.integers(3))
+            if op == 0 and blob:
+                blob[int(rng.integers(len(blob)))] ^= \
+                    1 << int(rng.integers(8))
+            elif op == 1 and len(blob) > 1:
+                del blob[int(rng.integers(len(blob))):]
+            else:
+                blob.extend(rng.integers(0, 256, 2, dtype=_np.uint8))
+        blob = bytes(blob)
+        try:
+            a = ("ok", wire.decode_py(blob))
+        except wire.WireError:
+            a = ("err",)
+        try:
+            b = ("ok", mod.decode(blob))
+        except wire.WireError:
+            b = ("err",)
+        # NaN-safe equivalence: compare canonical re-encodings (two
+        # separately built NaNs are != even inside equal structures)
+        if a[0] == b[0] == "ok":
+            assert wire.encode_py(a[1]) == wire.encode_py(b[1]), \
+                (blob.hex(), a, b)
+        else:
+            assert a[0] == b[0], (blob.hex(), a, b)
+
+
+def test_native_depth_limits_match():
+    mod = _native()
+    v = []
+    for _ in range(1000):
+        v = [v]
+    with pytest.raises(wire.WireError):
+        mod.encode(v)
+    deep = b"l\x01" * 40 + b"N"
+    for dec in (wire.decode_py, mod.decode):
+        with pytest.raises(wire.WireError):
+            dec(deep)
