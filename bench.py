@@ -382,6 +382,16 @@ def _run_stage(stage: str, label: str, shapes: dict, seconds: float,
 def _stage_entry(args) -> None:
     """Worker mode: one stage, one process, one JSON line on stdout."""
     _setup_jax(args.force_cpu)
+    if args.stage == "probe":
+        # Accelerator preflight: one tiny compiled op.  A dead/wedged
+        # tunnel hangs here (and only costs the probe's short budget)
+        # instead of burning every full-shape attempt's timeout.
+        import jax
+        import jax.numpy as jnp
+        x = jnp.ones((8, 128)) @ jnp.ones((128, 8))
+        jax.block_until_ready(x)
+        print(json.dumps({"platform": jax.devices()[0].platform}))
+        return
     shapes = dict(n_ens=args.n_ens, n_peers=args.n_peers,
                   n_slots=args.n_slots, k=args.k)
     if args.stage == "kernel":
@@ -402,7 +412,7 @@ def main() -> None:
                     choices=("kv", "merkle", "reconfig"),
                     help="kv = headline (driver default); merkle / "
                          "reconfig = BASELINE.md ladder #4 / #5")
-    ap.add_argument("--stage", choices=("kernel", "service"),
+    ap.add_argument("--stage", choices=("kernel", "service", "probe"),
                     help="internal: run one stage in-process")
     ap.add_argument("--n-ens", type=int, default=10_000)
     ap.add_argument("--n-peers", type=int, default=5)
@@ -441,9 +451,22 @@ def main() -> None:
         # first label where the service (the headline) succeeds wins,
         # and the kernel keeps falling back independently if its
         # attempt at that label failed.
+        # Preflight: if a tiny compiled op can't finish in 150s, the
+        # accelerator/tunnel is down — skip straight to the CPU rungs
+        # rather than burning every full-shape attempt's budget.
+        attempts = _ATTEMPTS
+        probe = _run_stage("probe", "preflight", {}, 0.0, 150.0, False)
+        if probe is None or probe.get("platform") == "cpu":
+            # Dead tunnel — or JAX silently fell back to CPU (no
+            # accelerator plugin): either way the full-shape
+            # accelerator rungs would just burn their budgets.
+            print("# accelerator preflight: "
+                  + ("failed" if probe is None else "cpu fallback")
+                  + "; CPU rungs only", file=sys.stderr)
+            attempts = tuple(a for a in _ATTEMPTS if a[3])
         svc = kern = None
         kern_label = None
-        for label, shapes, budget, force_cpu in _ATTEMPTS:
+        for label, shapes, budget, force_cpu in attempts:
             if kern is None:
                 kern = _run_stage("kernel", label, shapes, args.seconds,
                                   budget, force_cpu)
@@ -457,10 +480,10 @@ def main() -> None:
             # The headline landed but the kernel attempt at (or
             # before) that label wedged: keep walking the remaining
             # smaller/CPU rungs for the kernel number alone.
-            start = next(i for i, a in enumerate(_ATTEMPTS)
+            start = next(i for i, a in enumerate(attempts)
                          if a[0] == label)
             for label2, shapes2, budget2, force_cpu2 in \
-                    _ATTEMPTS[start + 1:]:
+                    attempts[start + 1:]:
                 kern = _run_stage("kernel", label2, shapes2,
                                   args.seconds, budget2, force_cpu2)
                 if kern is not None:
