@@ -455,7 +455,9 @@ def main() -> None:
         # accelerator/tunnel is down — skip straight to the CPU rungs
         # rather than burning every full-shape attempt's budget.
         attempts = _ATTEMPTS
-        probe = _run_stage("probe", "preflight", {}, 0.0, 150.0, False)
+        # 240s: ~10x the observed healthy cold-init+compile time (~26s
+        # through the tunnel), so only a genuinely dead backend trips it.
+        probe = _run_stage("probe", "preflight", {}, 0.0, 240.0, False)
         if probe is None or probe.get("platform") == "cpu":
             # Dead tunnel — or JAX silently fell back to CPU (no
             # accelerator plugin): either way the full-shape

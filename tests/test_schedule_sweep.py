@@ -159,3 +159,82 @@ def test_read_path_cas_races_under_chaos(seed):
     c.resume_peer("ens", leader)
     c.wait_stable("ens")
     assert c.kget_value("ens", "k") == last
+
+
+@pytest.mark.parametrize("seed", range(120, 126))
+def test_backend_death_under_chaos(seed):
+    """The handle_down → reset → step_down path while the permuter
+    reorders the recovery traffic: the leader's storage helper dies
+    mid-load, and the committed value must survive the reset +
+    re-election no matter how probes/votes/repair reads interleave
+    (module_handle_down, peer.erl:1919-1948)."""
+    from riak_ensemble_tpu.backend import BasicBackend, register_backend
+    from riak_ensemble_tpu.runtime import Actor
+
+    class _Store(Actor):
+        def handle(self, msg):
+            pass
+
+    class ChaosHelperBackend(BasicBackend):
+        def __init__(self, ensemble, peer_id, args=()):
+            super().__init__(ensemble, peer_id, ())
+            runtime, node = args
+            self.helper_name = ("cstore", ensemble, repr(peer_id))
+            if runtime.whereis(self.helper_name) is None:
+                _Store(runtime, self.helper_name, node)
+
+        def monitored(self):
+            return (self.helper_name,)
+
+        def handle_down(self, ref, pid, reason):
+            if ref == self.helper_name:
+                self.data = {}
+                return ("reset",)
+            return False
+
+    register_backend("chaos-helper", ChaosHelperBackend)
+    c = Cluster(seed=seed)
+    c.runtime.net.chaos(window=0.015, local=0.001)
+    peers = make_peers(3)
+    c.create_ensemble("ens", peers, backend="chaos-helper",
+                      backend_args=(c.runtime, peers[0].node))
+    leader = c.wait_stable("ens")
+    c.kput_ok("ens", "k", b"v1")
+
+    c.runtime.stop_actor(c.peer("ens", leader).mod.helper_name)
+    c.runtime.run_for(0.5)
+    c.wait_stable("ens")
+    c.read_until("ens", "k", b"v1")
+    c.kput_ok("ens", "k", b"v2")
+    assert c.kget_value("ens", "k") == b"v2", f"seed {seed}"
+
+
+@pytest.mark.parametrize("seed", range(130, 136))
+def test_partition_heal_under_chaos(seed):
+    """sc.erl's partition nemesis composed with the permuter: the
+    leader is isolated in a minority; the majority side must depose it
+    and keep serving; after heal, the old leader rejoins without
+    resurrecting stale state (partition_nodes/heal_nodes,
+    test/sc.erl:1012-1036)."""
+    c = Cluster(seed=seed)
+    c.runtime.net.chaos(window=0.01, local=0.001)
+    peers = make_peers(3)
+    c.create_ensemble("ens", peers)
+    leader = c.wait_stable("ens")
+    c.kput_ok("ens", "k", b"v1")
+
+    lead_node = leader.node
+    others = [p.node for p in peers if p.node != lead_node]
+    c.runtime.net.partition([lead_node], others)
+    assert c.runtime.run_until(
+        lambda: c.leader_id("ens") not in (None, leader), 90.0), \
+        f"seed {seed}: majority never elected"
+    c.wait_stable("ens")
+    c.read_until("ens", "k", b"v1")
+    c.kput_ok("ens", "k", b"v2")
+
+    c.runtime.net.heal()
+    c.wait_stable("ens")
+    c.read_until("ens", "k", b"v2")
+    c.kput_ok("ens", "k", b"v3")
+    assert c.kget_value("ens", "k") == b"v3", f"seed {seed}"
