@@ -396,6 +396,9 @@ def _stage_entry(args) -> None:
                   n_slots=args.n_slots, k=args.k)
     if args.stage == "kernel":
         out = {"kernel_rounds_per_sec": run(seconds=args.seconds, **shapes)}
+    elif args.stage == "merkle":
+        out = {"merkle_updates_per_sec":
+               run_merkle(args.seconds, smoke=False)["value"]}
     else:
         out = run_service(seconds=args.seconds, **shapes)
     import jax
@@ -412,7 +415,8 @@ def main() -> None:
                     choices=("kv", "merkle", "reconfig"),
                     help="kv = headline (driver default); merkle / "
                          "reconfig = BASELINE.md ladder #4 / #5")
-    ap.add_argument("--stage", choices=("kernel", "service", "probe"),
+    ap.add_argument("--stage",
+                    choices=("kernel", "service", "merkle", "probe"),
                     help="internal: run one stage in-process")
     ap.add_argument("--n-ens", type=int, default=10_000)
     ap.add_argument("--n-peers", type=int, default=5)
@@ -495,6 +499,12 @@ def main() -> None:
             svc["kernel_rounds_per_sec"] = (
                 kern["kernel_rounds_per_sec"] if kern else None)
             svc["kernel_label"] = kern_label
+            # BASELINE ladder #4 (1M-segment incremental Merkle
+            # updates) on whatever platform the headline landed on.
+            merk = _run_stage("merkle", label, {}, args.seconds,
+                              300.0, force_cpu)
+            svc["merkle_updates_per_sec"] = (
+                merk["merkle_updates_per_sec"] if merk else None)
         if svc is None:
             print(json.dumps({
                 "metric": "service_linearizable_kv_ops_per_sec",
@@ -520,6 +530,9 @@ def main() -> None:
         "keyed_service_ops_per_sec": (
             round(svc["keyed_ops_per_sec"], 1)
             if svc.get("keyed_ops_per_sec") else None),
+        "merkle_updates_per_sec_1M_segments": (
+            round(svc["merkle_updates_per_sec"], 1)
+            if svc.get("merkle_updates_per_sec") else None),
         "platform": svc.get("platform", "unknown"),
     }))
 
