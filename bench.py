@@ -397,8 +397,11 @@ def _stage_entry(args) -> None:
     if args.stage == "kernel":
         out = {"kernel_rounds_per_sec": run(seconds=args.seconds, **shapes)}
     elif args.stage == "merkle":
-        out = {"merkle_updates_per_sec":
-               run_merkle(args.seconds, smoke=False)["value"]}
+        m = run_merkle(args.seconds, smoke=False)
+        out = {"ladder_metric": m["metric"], "ladder_value": m["value"]}
+    elif args.stage == "reconfig":
+        m = run_reconfig(args.seconds, smoke=False)
+        out = {"ladder_metric": m["metric"], "ladder_value": m["value"]}
     else:
         out = run_service(seconds=args.seconds, **shapes)
     import jax
@@ -416,7 +419,8 @@ def main() -> None:
                     help="kv = headline (driver default); merkle / "
                          "reconfig = BASELINE.md ladder #4 / #5")
     ap.add_argument("--stage",
-                    choices=("kernel", "service", "merkle", "probe"),
+                    choices=("kernel", "service", "merkle", "reconfig",
+                             "probe"),
                     help="internal: run one stage in-process")
     ap.add_argument("--n-ens", type=int, default=10_000)
     ap.add_argument("--n-peers", type=int, default=5)
@@ -501,10 +505,15 @@ def main() -> None:
             svc["kernel_label"] = kern_label
             # BASELINE ladder #4 (1M-segment incremental Merkle
             # updates) on whatever platform the headline landed on.
-            merk = _run_stage("merkle", label, {}, args.seconds,
-                              300.0, force_cpu)
-            svc["merkle_updates_per_sec"] = (
-                merk["merkle_updates_per_sec"] if merk else None)
+            # BASELINE ladder #4 (Merkle) and #5 (reconfig churn),
+            # keyed by the runner's OWN metric string so the reported
+            # shape can never drift from the measured one.
+            svc["ladder"] = {}
+            for stage in ("merkle", "reconfig"):
+                r = _run_stage(stage, label, {}, args.seconds,
+                               300.0, force_cpu)
+                if r is not None:
+                    svc["ladder"][r["ladder_metric"]] = r["ladder_value"]
         if svc is None:
             print(json.dumps({
                 "metric": "service_linearizable_kv_ops_per_sec",
@@ -530,9 +539,7 @@ def main() -> None:
         "keyed_service_ops_per_sec": (
             round(svc["keyed_ops_per_sec"], 1)
             if svc.get("keyed_ops_per_sec") else None),
-        "merkle_updates_per_sec_1M_segments": (
-            round(svc["merkle_updates_per_sec"], 1)
-            if svc.get("merkle_updates_per_sec") else None),
+        **{k: round(v, 1) for k, v in svc.get("ladder", {}).items()},
         "platform": svc.get("platform", "unknown"),
     }))
 
