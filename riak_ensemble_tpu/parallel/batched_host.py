@@ -633,6 +633,25 @@ class BatchedEnsembleService:
         now = self.runtime.now
         lease_ok = self.lease_until > now
 
+        # Under async dispatch a device failure surfaces at the d2h
+        # fetch BELOW, after self.state has been replaced with the
+        # failed computation's poisoned arrays; without rolling back,
+        # every later launch would consume the poison and fail
+        # forever.  Snapshot and restore on any error (JAX arrays are
+        # immutable, so the snapshot stays valid).
+        state_snapshot = self.state
+        try:
+            return self._launch_inner(elect, cand, now, lease_ok, kind,
+                                      slot, val, k, want_vsn, exp_e,
+                                      exp_s)
+        except BaseException:
+            self.state = state_snapshot
+            raise
+
+    def _launch_inner(self, elect, cand, now, lease_ok, kind, slot,
+                      val, k, want_vsn, exp_e, exp_s):
+        jnp = self._jnp
+
         # h2d slimming (the tunnel link is the throughput ceiling in
         # both directions): the lease plane uploads as [E] and
         # broadcasts to [K, E] device-side; the up mask uploads only
@@ -826,8 +845,45 @@ class BatchedEnsembleService:
                 val[j, e] = op.handle
                 exp_e[j, e], exp_s[j, e] = op.exp
 
-        committed, get_ok, found, value, vsn = self._launch(
-            kind, slot, val, k, want_vsn=True, exp_e=exp_e, exp_s=exp_s)
+        try:
+            planes = self._launch(kind, slot, val, k, want_vsn=True,
+                                  exp_e=exp_e, exp_s=exp_s)
+        except BaseException:
+            # A failed device launch (XLA error, OOM, dead backend)
+            # must not orphan the taken ops: clients would block on
+            # their futures forever.  Fail them all — the reference's
+            # request_failed path (worker crash -> step_down,
+            # peer.erl:1274-1275) — then let the error propagate to
+            # whoever drives flush().  _launch already rolled the
+            # device state back, so the next flush starts clean.  The
+            # catch covers ONLY the launch: an exception from a
+            # client's future-waiter inside the resolve loop must not
+            # fail ops that committed on device.
+            for e, ops in enumerate(taken):
+                for op in ops:
+                    self._fail_op(e, op)
+            raise
+        return self._resolve_flush(taken, planes)
+
+    def _fail_op(self, e: int, op: _PendingOp) -> None:
+        """Resolve one queued op as failed, releasing a put's payload
+        and queueing its slot for recycling (shared by the resolve
+        loop's uncommitted branch and the launch-failure path)."""
+        if op.fut.done:
+            return
+        if op.kind in (eng.OP_PUT, eng.OP_CAS):
+            self._release_handle(op.handle)
+            # A failed put that was the slot's last queued write may
+            # leave it holding nothing committed (fresh slot, or a
+            # tombstone whose delete-side recycle was skipped because
+            # this put bumped the generation): queue it for recycling
+            # or the slot leaks until the key is deleted.
+            if op.key is not None:
+                self._recycle_pending[e].append((op.key, op.slot, op.gen))
+        op.fut.resolve("failed")
+
+    def _resolve_flush(self, taken, planes) -> int:
+        committed, get_ok, found, value, vsn = planes
 
         # Per-op resolve loop: convert the result planes to plain
         # Python lists ONCE (C-speed bulk conversion) — per-op numpy
@@ -863,17 +919,7 @@ class BatchedEnsembleService:
                             slot_handle[op.slot] = op.handle
                         op.fut.resolve(("ok", tuple(vsn_l[j][e])))
                     else:
-                        self._release_handle(op.handle)
-                        # A failed put that was the slot's last queued
-                        # write may leave it holding nothing committed
-                        # (fresh slot, or a tombstone whose delete-side
-                        # recycle was skipped because this put bumped
-                        # the generation): queue it for recycling or
-                        # the slot leaks until the key is deleted.
-                        if op.key is not None:
-                            self._recycle_pending[e].append(
-                                (op.key, op.slot, op.gen))
-                        op.fut.resolve("failed")
+                        self._fail_op(e, op)
                 else:
                     if get_ok_l[j][e]:
                         v = value_l[j][e]
@@ -886,7 +932,7 @@ class BatchedEnsembleService:
                         op.fut.resolve(("ok", out, tuple(vsn_l[j][e]))
                                        if op.want_vsn else ("ok", out))
                     else:
-                        op.fut.resolve("failed")
+                        self._fail_op(e, op)
         self.ops_served += served
         self._drain_recycles()
         return served

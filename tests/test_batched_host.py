@@ -608,3 +608,97 @@ def test_service_on_netruntime_asyncio():
         svc.stop()
 
     asyncio.run(scenario())
+
+
+def test_launch_failure_fails_ops_instead_of_orphaning():
+    """A device launch that raises (XLA error, dead backend) must fail
+    every op taken for that flush — clients would otherwise block on
+    their futures forever — and the service must keep working once
+    the device recovers (request_failed analog, peer.erl:1274-1275)."""
+    from riak_ensemble_tpu.parallel.batched_host import _LocalEngine
+
+    class FlakyEngine(_LocalEngine):
+        fail_next = False
+
+        @classmethod
+        def full_step(cls, *a, **kw):
+            if cls.fail_next:
+                cls.fail_next = False
+                raise RuntimeError("injected device failure")
+            return _LocalEngine.full_step(*a, **kw)
+
+    runtime = Runtime(seed=50)
+    svc = BatchedEnsembleService(runtime, 4, 3, 8, tick=None,
+                                 config=fast_test_config(),
+                                 engine=FlakyEngine())
+    ok = svc.kput(0, "a", b"1")
+    svc.flush()
+    assert ok.done and ok.value[0] == "ok"
+
+    FlakyEngine.fail_next = True
+    f1 = svc.kput(0, "b", b"2")
+    f2 = svc.kget(0, "a")
+    with pytest.raises(RuntimeError, match="injected"):
+        svc.flush()
+    assert f1.done and f1.value == "failed"
+    assert f2.done and f2.value == "failed"
+    # payload of the failed put released, slot queued for recycle
+    assert len(svc.values) == 1  # only "a"'s committed payload
+
+    # The device "recovers": the service keeps serving.
+    f3 = svc.kput(0, "b", b"3")
+    while any(svc.queues):
+        svc.flush()
+    assert f3.done and f3.value[0] == "ok"
+    assert svc.kget(0, "b").done is False
+    while any(svc.queues):
+        svc.flush()
+
+
+def test_async_launch_failure_rolls_back_state():
+    """Under async dispatch a real device failure surfaces at the d2h
+    fetch, AFTER self.state was replaced with the failed launch's
+    poisoned arrays; the launch path must roll back to the pre-launch
+    state or every subsequent flush consumes the poison and fails
+    forever."""
+    from riak_ensemble_tpu.parallel.batched_host import _LocalEngine
+
+    class AsyncPoisonEngine(_LocalEngine):
+        poison_next = False
+
+        @classmethod
+        def full_step(cls, *a, **kw):
+            state, won, res = _LocalEngine.full_step(*a, **kw)
+            if cls.poison_next:
+                cls.poison_next = False
+                # The returned state LOOKS fine (it replaces
+                # svc.state), but the result fetch blows up — the
+                # async-dispatch failure shape.
+                res = res._replace(value="poisoned-not-an-array")
+            return state, won, res
+
+    runtime = Runtime(seed=50)
+    svc = BatchedEnsembleService(runtime, 4, 3, 8, tick=None,
+                                 config=fast_test_config(),
+                                 engine=AsyncPoisonEngine())
+    assert_ok = svc.kput(0, "a", b"1")
+    svc.flush()
+    assert assert_ok.done and assert_ok.value[0] == "ok"
+    good_state = svc.state
+
+    AsyncPoisonEngine.poison_next = True
+    f1 = svc.kput(0, "b", b"2")
+    with pytest.raises(Exception):
+        svc.flush()
+    assert f1.done and f1.value == "failed"
+    assert svc.state is good_state, "poisoned state was not rolled back"
+
+    # Clean state: the service serves again immediately.
+    f2 = svc.kput(0, "b", b"3")
+    while any(svc.queues):
+        svc.flush()
+    assert f2.done and f2.value[0] == "ok"
+    r = svc.kget(0, "a")
+    while any(svc.queues):
+        svc.flush()
+    assert r.done and r.value == ("ok", b"1")
