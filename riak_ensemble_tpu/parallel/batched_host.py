@@ -377,24 +377,34 @@ class BatchedEnsembleService:
         leader_ok[has] = self.up[idx[has], leader[has]]
         propose = self._desired_mask & ~self._pending_mask & leader_ok
         dv_j = jnp.asarray(self._desired_view_np)
-        state, installed, collapsed1 = self.engine.reconfig_step(
-            self.state, jnp.asarray(propose), dv_j, up_j)
-        # Launch 2 only exists to collapse views launch 1 freshly
-        # installed (launch 1's transition half already attempted
-        # every leftover); skip the device round trip if nothing
-        # could have installed.
-        if propose.any():
-            state, _, collapsed2 = self.engine.reconfig_step(
-                state, jnp.zeros((self.n_ens,), bool), dv_j, up_j)
-            collapsed2 = np.asarray(collapsed2)
-        else:
-            collapsed2 = np.zeros((self.n_ens,), bool)
-        self.state = state
-        installed_now = propose & np.asarray(installed)
+        # Same rollback discipline as _launch: an async device failure
+        # surfaces at the np.asarray fetches below, after self.state
+        # was replaced — restore the pre-launch state so the request
+        # stays queued/desired and a later call retries cleanly.
+        state_snapshot = self.state
+        try:
+            state, installed, collapsed1 = self.engine.reconfig_step(
+                self.state, jnp.asarray(propose), dv_j, up_j)
+            # Launch 2 only exists to collapse views launch 1 freshly
+            # installed (launch 1's transition half already attempted
+            # every leftover); skip the device round trip if nothing
+            # could have installed.
+            if propose.any():
+                state, _, collapsed2 = self.engine.reconfig_step(
+                    state, jnp.zeros((self.n_ens,), bool), dv_j, up_j)
+                collapsed2 = np.asarray(collapsed2)
+            else:
+                collapsed2 = np.zeros((self.n_ens,), bool)
+            self.state = state
+            installed_now = propose & np.asarray(installed)
+            collapsed1 = np.asarray(collapsed1)
+        except BaseException:
+            self.state = state_snapshot
+            raise
         # Collapses land in EITHER launch: joint views left over from
         # earlier calls transition during launch 1 (its ~propose
         # half), fresh installs during launch 2.
-        collapsed = np.asarray(collapsed1) | collapsed2
+        collapsed = collapsed1 | collapsed2
 
         # Host mirrors.  Installs move desired -> pending; a collapse
         # promotes its pending view to the live membership and lets a
@@ -628,7 +638,6 @@ class BatchedEnsembleService:
         exchange.  Returns np result arrays (vsn None unless asked —
         it is the largest transfer and bulk callers rarely need it).
         """
-        jnp = self._jnp
         elect, cand = self._election_inputs()
         now = self.runtime.now
         lease_ok = self.lease_until > now
@@ -637,15 +646,24 @@ class BatchedEnsembleService:
         # fetch BELOW, after self.state has been replaced with the
         # failed computation's poisoned arrays; without rolling back,
         # every later launch would consume the poison and fail
-        # forever.  Snapshot and restore on any error (JAX arrays are
-        # immutable, so the snapshot stays valid).
+        # forever.  The host mirrors roll back with it: the inner body
+        # applies leader/lease updates before its LAST device fetch
+        # (the corruption-exchange one), and a mirror claiming a
+        # leader the restored device state doesn't have would suppress
+        # re-election forever.  (JAX arrays are immutable, so the
+        # state snapshot stays valid; lease_until is mutated in place,
+        # so it needs a copy.)
         state_snapshot = self.state
+        leader_snapshot = self.leader_np
+        lease_snapshot = self.lease_until.copy()
         try:
             return self._launch_inner(elect, cand, now, lease_ok, kind,
                                       slot, val, k, want_vsn, exp_e,
                                       exp_s)
         except BaseException:
             self.state = state_snapshot
+            self.leader_np = leader_snapshot
+            self.lease_until = lease_snapshot
             raise
 
     def _launch_inner(self, elect, cand, now, lease_ok, kind, slot,
@@ -865,6 +883,17 @@ class BatchedEnsembleService:
             raise
         return self._resolve_flush(taken, planes)
 
+    def _safe_resolve(self, fut: Future, result: Any) -> None:
+        """Resolve a client future, containing waiter exceptions:
+        ``Future.resolve`` runs waiters synchronously, and a client
+        callback that raises must not abort the resolve loop — that
+        would orphan every later op in the batch (and, on the failure
+        path, mask the original device error)."""
+        try:
+            fut.resolve(result)
+        except BaseException as exc:  # client bug, not ours: trace it
+            self._emit("svc_waiter_error", {"error": repr(exc)})
+
     def _fail_op(self, e: int, op: _PendingOp) -> None:
         """Resolve one queued op as failed, releasing a put's payload
         and queueing its slot for recycling (shared by the resolve
@@ -880,7 +909,7 @@ class BatchedEnsembleService:
             # or the slot leaks until the key is deleted.
             if op.key is not None:
                 self._recycle_pending[e].append((op.key, op.slot, op.gen))
-        op.fut.resolve("failed")
+        self._safe_resolve(op.fut, "failed")
 
     def _resolve_flush(self, taken, planes) -> int:
         committed, get_ok, found, value, vsn = planes
@@ -917,7 +946,8 @@ class BatchedEnsembleService:
                             self._release_handle(old)
                         if op.handle:
                             slot_handle[op.slot] = op.handle
-                        op.fut.resolve(("ok", tuple(vsn_l[j][e])))
+                        self._safe_resolve(
+                            op.fut, ("ok", tuple(vsn_l[j][e])))
                     else:
                         self._fail_op(e, op)
                 else:
@@ -929,8 +959,9 @@ class BatchedEnsembleService:
                         # vsn is the object's — a tombstone's real
                         # version rides along with NOTFOUND, so CAS
                         # chains (ksafe_delete → kupdate) work.
-                        op.fut.resolve(("ok", out, tuple(vsn_l[j][e]))
-                                       if op.want_vsn else ("ok", out))
+                        self._safe_resolve(
+                            op.fut, ("ok", out, tuple(vsn_l[j][e]))
+                            if op.want_vsn else ("ok", out))
                     else:
                         self._fail_op(e, op)
         self.ops_served += served

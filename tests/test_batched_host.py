@@ -702,3 +702,19 @@ def test_async_launch_failure_rolls_back_state():
     while any(svc.queues):
         svc.flush()
     assert r.done and r.value == ("ok", b"1")
+
+
+def test_raising_client_waiter_does_not_orphan_batch():
+    """Future.resolve runs waiters synchronously; a client callback
+    that raises must not abort the resolve loop (orphaning later ops)
+    nor mask a device error on the failure path."""
+    runtime = Runtime(seed=50)
+    svc = BatchedEnsembleService(runtime, 2, 3, 8, tick=None,
+                                 config=fast_test_config())
+    bad = svc.kput(0, "a", b"1")
+    bad.add_waiter(lambda _r: (_ for _ in ()).throw(ValueError("client bug")))
+    good = svc.kput(1, "b", b"2")
+    while any(svc.queues):
+        svc.flush()   # must not raise: client bug is traced, not fatal
+    assert bad.done and bad.value[0] == "ok"
+    assert good.done and good.value[0] == "ok"
