@@ -891,8 +891,10 @@ class BatchedEnsembleService:
         path, mask the original device error)."""
         try:
             fut.resolve(result)
-        except BaseException as exc:  # client bug, not ours: trace it
-            self._emit("svc_waiter_error", {"error": repr(exc)})
+        except Exception:  # client bug, not ours: trace it with the
+            import traceback  # traceback (KeyboardInterrupt/SystemExit
+            self._emit("svc_waiter_error",  # propagate)
+                       {"error": traceback.format_exc(limit=8)})
 
     def _fail_op(self, e: int, op: _PendingOp) -> None:
         """Resolve one queued op as failed, releasing a put's payload

@@ -46,8 +46,20 @@ class Future:
         self.done = True
         self.value = value
         waiters, self._waiters = self._waiters, []
+        # Every waiter runs even if an earlier one raises (the list is
+        # already swapped out, so a skipped waiter could never fire);
+        # the first error re-raises afterwards so the bug stays
+        # visible to whoever resolved.  KeyboardInterrupt/SystemExit
+        # abort immediately.
+        first: Optional[BaseException] = None
         for w in waiters:
-            w(value)
+            try:
+                w(value)
+            except Exception as exc:
+                if first is None:
+                    first = exc
+        if first is not None:
+            raise first
 
     def add_waiter(self, fn: Callable[[Any], None]) -> None:
         if self.done:

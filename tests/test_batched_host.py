@@ -718,3 +718,20 @@ def test_raising_client_waiter_does_not_orphan_batch():
         svc.flush()   # must not raise: client bug is traced, not fatal
     assert bad.done and bad.value[0] == "ok"
     assert good.done and good.value[0] == "ok"
+
+
+def test_all_waiters_run_despite_raising_waiter():
+    """Future.resolve must run every waiter even when an earlier one
+    raises — the waiter list is swapped out before iterating, so a
+    skipped waiter could never fire again."""
+    from riak_ensemble_tpu.runtime import Future
+
+    ran = []
+    f = Future()
+    f.add_waiter(lambda _r: ran.append("a"))
+    f.add_waiter(lambda _r: (_ for _ in ()).throw(ValueError("bug")))
+    f.add_waiter(lambda _r: ran.append("b"))
+    with pytest.raises(ValueError, match="bug"):
+        f.resolve("x")
+    assert ran == ["a", "b"]
+    assert f.done and f.value == "x"

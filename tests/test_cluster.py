@@ -198,3 +198,57 @@ def test_failover_with_managed_cluster():
         r = mc.client("node1").kget("ens1", "k", timeout=5.0)
         return r[0] == "ok" and r[1].value == b"v1"
     assert mc.runtime.run_until(readable, 60.0, poll=0.5)
+
+
+def test_crashed_local_peer_restarted_by_reconciliation():
+    """The peer-supervisor role (riak_ensemble_peer_sup, restarted by
+    manager state_changed/check_peers, manager.erl:610-641,697-715):
+    a local peer actor that dies is restarted by the manager's
+    reconciliation pass, reloads its fact, re-probes, and the ensemble
+    keeps serving."""
+    from riak_ensemble_tpu.peer import peer_name
+    from riak_ensemble_tpu.testing import ManagedCluster
+    from riak_ensemble_tpu.types import PeerId
+
+    mc = ManagedCluster(seed=23)
+    mc.ens_start(3)
+    assert mc.kput("k", b"v")[0] == "ok"
+
+    victim = PeerId(2, mc.node0)
+    name = peer_name("root", victim)
+    assert mc.runtime.whereis(name) is not None
+    mc.runtime.stop_actor(name)  # crash (no clean shutdown)
+    assert mc.runtime.whereis(name) is None
+
+    # Reconciliation notices wanted-but-missing and restarts it.
+    assert mc.runtime.run_until(
+        lambda: mc.runtime.whereis(name) is not None, 30.0), \
+        "manager never restarted the crashed peer"
+    mc.wait_stable("root")
+    r = mc.kget("k")
+    assert r[0] == "ok" and r[1].value == b"v"
+    assert mc.kput("k", b"v2")[0] == "ok"
+
+
+def test_crashed_leader_restarted_and_ensemble_recovers():
+    """Crashing the LEADER actor: remaining peers elect (follower
+    timeout -> probe -> election), reconciliation restarts the dead
+    actor from its persisted fact, and it rejoins without clobbering
+    the new leadership (restart -> reload_fact -> probe,
+    peer.erl:2185-2195, 1842-1860)."""
+    from riak_ensemble_tpu.peer import peer_name
+    from riak_ensemble_tpu.testing import ManagedCluster
+
+    mc = ManagedCluster(seed=29)
+    mc.ens_start(3)
+    assert mc.kput("k", b"v")[0] == "ok"
+    leader = mc.wait_leader("root")
+    name = peer_name("root", leader)
+    mc.runtime.stop_actor(name)
+
+    assert mc.runtime.run_until(
+        lambda: mc.runtime.whereis(name) is not None, 30.0)
+    assert mc.wait_stable("root") is not None
+    r = mc.kget("k")
+    assert r[0] == "ok" and r[1].value == b"v", r
+    assert mc.kput("k", b"v2")[0] == "ok"
