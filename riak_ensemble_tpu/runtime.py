@@ -48,18 +48,19 @@ class Future:
         waiters, self._waiters = self._waiters, []
         # Every waiter runs even if an earlier one raises (the list is
         # already swapped out, so a skipped waiter could never fire);
-        # the first error re-raises afterwards so the bug stays
-        # visible to whoever resolved.  KeyboardInterrupt/SystemExit
-        # abort immediately.
-        first: Optional[BaseException] = None
+        # the errors re-raise afterwards — all of them, as a group
+        # when there are several — so no bug loses its signal.
+        # KeyboardInterrupt/SystemExit abort immediately.
+        errs: List[Exception] = []
         for w in waiters:
             try:
                 w(value)
             except Exception as exc:
-                if first is None:
-                    first = exc
-        if first is not None:
-            raise first
+                errs.append(exc)
+        if len(errs) == 1:
+            raise errs[0]
+        if errs:
+            raise ExceptionGroup("future waiter errors", errs)
 
     def add_waiter(self, fn: Callable[[Any], None]) -> None:
         if self.done:

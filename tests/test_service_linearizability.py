@@ -31,13 +31,22 @@ N_KEYS = 3
 ROUNDS = 35
 
 
-def _drain(svc, runtime, pending, max_flushes=10):
+def _drain(svc, runtime, pending, max_flushes=10, tolerate=None,
+           on_tolerated=None):
     """Flush until every submitted future resolves (queued ops past
-    max_ops_per_tick ride later launches)."""
+    max_ops_per_tick ride later launches).  ``tolerate`` is a
+    substring of flush errors to survive (the launch-failure nemesis);
+    ``on_tolerated`` is called for each one."""
     for _ in range(max_flushes):
         if all(fut.done for _, _, _, fut, _ in pending):
             return
-        svc.flush()
+        try:
+            svc.flush()
+        except RuntimeError as exc:
+            if tolerate is None or tolerate not in str(exc):
+                raise
+            if on_tolerated is not None:
+                on_tolerated()
         runtime.run_for(0.001)
     raise AssertionError("ops never resolved")
 
@@ -184,3 +193,100 @@ def test_service_linearizable_under_nemesis(seed):
                  for ev in m.history if ev[0] == "read")
     assert served >= len(models), "quiesced read-back did not complete"
     assert svc.flushes >= ROUNDS
+
+
+@pytest.mark.parametrize("seed", [801, 802, 803, 804])
+def test_service_linearizable_across_launch_failures(seed):
+    """Device-launch failures (XLA error / dead backend shapes) join
+    the nemesis: a seeded ~15% of full_step launches raise, the
+    service fails that flush's ops and rolls the engine state + host
+    mirrors back, and the surviving history must STILL be
+    linearizable — a rollback that resurrected or dropped a committed
+    write would surface as a Violation on read-back."""
+    from riak_ensemble_tpu.parallel.batched_host import _LocalEngine
+
+    inject_rng = np.random.default_rng(seed + 50_000)
+
+    class FailingEngine(_LocalEngine):
+        def full_step(self, *a, **kw):
+            if inject_rng.random() < 0.15:
+                raise RuntimeError("injected-launch-failure")
+            return _LocalEngine.full_step(*a, **kw)
+
+    rng = np.random.default_rng(seed)
+    runtime = Runtime(seed=seed)
+    config = fast_test_config()
+    svc = BatchedEnsembleService(runtime, N_ENS, N_PEERS, n_slots=8,
+                                 tick=None, max_ops_per_tick=8,
+                                 config=config, engine=FailingEngine())
+    models = {(e, k): KeyModel(f"{e}/key{k}")
+              for e in range(N_ENS) for k in range(N_KEYS)}
+    vals = itertools.count(1)
+    down = {}
+    failures = 0
+
+    def bump():
+        nonlocal failures
+        failures += 1
+
+    def drain(pending):
+        _drain(svc, runtime, pending, max_flushes=25,
+               tolerate="injected-launch-failure", on_tolerated=bump)
+
+    for _round in range(ROUNDS):
+        r = rng.random()
+        if r < 0.3 and down:
+            e = list(down)[int(rng.integers(len(down)))]
+            svc.set_peer_up(e, down.pop(e), True)
+        elif r < 0.6:
+            e = int(rng.integers(N_ENS))
+            if e not in down and svc.leader_np[e] >= 0:
+                p = int(svc.leader_np[e])
+                svc.set_peer_up(e, p, False)
+                down[e] = p
+
+        pending = []
+        for _ in range(int(rng.integers(2, 8))):
+            e = int(rng.integers(N_ENS))
+            k = int(rng.integers(N_KEYS))
+            m = models[(e, k)]
+            key = f"key{k}"
+            op = rng.random()
+            if op < 0.45:
+                payload = f"{seed}-{next(vals)}".encode()
+                op_id = m.invoke_write(payload)
+                fut = svc.kput(e, key, payload)
+                if fut.done and fut.value == "failed":
+                    m.fail_write(op_id)
+                else:
+                    pending.append(("put", m, op_id, fut, payload))
+            elif op < 0.85:
+                pending.append(("get", m, None, svc.kget(e, key), None))
+            else:
+                op_id = m.invoke_write(NOTFOUND)
+                fut = svc.kdelete(e, key)
+                if fut.done:
+                    m.ack_write(op_id)
+                else:
+                    pending.append(("del", m, op_id, fut, None))
+
+        if rng.random() < 0.3:
+            runtime.run_for(config.lease() * 2.5)
+        drain(pending)
+        _apply_outcomes(pending)
+
+    # quiesce: heal everything, then read back every key — the
+    # model raises Violation on any stale/lost/resurrected value.
+    for e, p in list(down.items()):
+        svc.set_peer_up(e, p, True)
+    for _ in range(10):
+        try:
+            svc.flush()
+            break
+        except RuntimeError:
+            failures += 1
+    pending = [("get", m, None, svc.kget(e, f"key{k}"), None)
+               for (e, k), m in models.items()]
+    drain(pending)
+    _apply_outcomes(pending)
+    assert failures > 0, "nemesis never fired; weaken the seed gate"
