@@ -1,0 +1,283 @@
+"""Network front-end for the batched (TPU) service path.
+
+The actor stack has :mod:`riak_ensemble_tpu.netnode` as its one-node
+process entry; this module is the same thing for the SCALE path: an
+asyncio TCP server exposing a :class:`BatchedEnsembleService` — the
+engine-backed thousands-of-ensembles K/V plane — to remote clients.
+It is the piece that turns "host service around a device engine" into
+"service reachable over DCN", the role the reference's client API
+played over disterl (riak_ensemble_client.erl via gen_fsm sends).
+
+Protocol: length-prefixed frames in the restricted wire codec
+(:mod:`riak_ensemble_tpu.wire` — no code execution on decode; the
+same trust model as the cluster transport).  Requests are
+``(req_id, op, args...)`` tuples; each gets one ``(req_id, result)``
+response, resolved when the op's flush lands, so a client can pipeline
+requests and correlate out-of-order completions:
+
+    ("kput", ens, key, value)        -> ("ok", (epoch, seq)) | "failed"
+    ("kget", ens, key)               -> ("ok", value|NOTFOUND) | "failed"
+    ("kget_vsn", ens, key)           -> ("ok", value, vsn) | "failed"
+    ("kupdate", ens, key, vsn, val)  -> ("ok", new_vsn) | "failed"
+    ("kdelete", ens, key)            -> ("ok", vsn) | ("ok", NOTFOUND
+                                        when no such key) | "failed"
+    ("ksafe_delete", ens, key, vsn)  -> ("ok", new_vsn) | "failed"
+    ("stats",)                       -> dict
+
+Malformed or non-allowlisted frames drop the connection (the codec
+cannot construct anything outside the protocol types).
+
+    python -m riak_ensemble_tpu.svcnode --port 7601 \
+        --n-ens 1024 --n-peers 5 --n-slots 128 [--fast]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import itertools
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+from riak_ensemble_tpu import wire
+from riak_ensemble_tpu.config import Config, fast_test_config
+from riak_ensemble_tpu.netruntime import NetRuntime
+from riak_ensemble_tpu.parallel.batched_host import BatchedEnsembleService
+
+_HDR = struct.Struct(">I")
+_MAX_FRAME = 16 << 20
+
+
+class ServiceServer:
+    """TCP front-end around one BatchedEnsembleService."""
+
+    def __init__(self, svc: BatchedEnsembleService,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.svc = svc
+        self.host, self.port = host, port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> Tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._on_client, self.host, self.port)
+        addr = self._server.sockets[0].getsockname()
+        self.host, self.port = addr[0], addr[1]
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        self.svc.stop()
+
+    def _dispatch(self, op: str, args: tuple):
+        svc = self.svc
+        if args:
+            # The ensemble index comes from the network: reject
+            # anything outside [0, n_ens) — Python negative indexing
+            # would otherwise alias ens=-1 onto ensemble n_ens-1,
+            # crossing the trust boundary.
+            ens = args[0]
+            if type(ens) is not int or not 0 <= ens < svc.n_ens:
+                raise ValueError(f"bad ensemble index {ens!r}")
+        if op == "kput":
+            return svc.kput(*args)
+        if op == "kget":
+            return svc.kget(*args)
+        if op == "kget_vsn":
+            return svc.kget_vsn(*args)
+        if op == "kupdate":
+            return svc.kupdate(*args)
+        if op == "kdelete":
+            return svc.kdelete(*args)
+        if op == "ksafe_delete":
+            return svc.ksafe_delete(*args)
+        return None
+
+    async def _on_client(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        loop = asyncio.get_running_loop()
+
+        def send(req_id: Any, result: Any) -> None:
+            try:
+                payload = wire.encode((req_id, result))
+            except wire.WireError:
+                payload = wire.encode((req_id, "failed"))
+            writer.write(_HDR.pack(len(payload)) + payload)
+
+        try:
+            while True:
+                head = await reader.readexactly(_HDR.size)
+                (length,) = _HDR.unpack(head)
+                if length > _MAX_FRAME:
+                    break  # hostile length: drop the connection
+                frame = await reader.readexactly(length)
+                try:
+                    msg = wire.decode(frame)
+                    req_id, op = msg[0], msg[1]
+                    args = tuple(msg[2:])
+                except (wire.WireError, IndexError, TypeError):
+                    break  # malformed: drop the connection
+                if op == "stats":
+                    send(req_id, self.svc.stats())
+                    continue
+                try:
+                    fut = self._dispatch(op, args)
+                except Exception:
+                    # wrong arity / types from a hostile or buggy
+                    # client: answer, don't let the task die with an
+                    # unhandled traceback
+                    send(req_id, ("error", "bad-request"))
+                    continue
+                if fut is None:
+                    send(req_id, ("error", "unknown-op"))
+                    continue
+                # Resolution happens inside a flush on this same
+                # loop; the waiter writes the response directly.
+                fut.add_waiter(
+                    lambda result, rid=req_id: send(rid, result))
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+
+class ServiceClient:
+    """Pipelined client: awaitable ops correlated by request id."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.host, self.port = host, port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._ids = itertools.count(1)
+        self._pump: Optional[asyncio.Task] = None
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port)
+        self._pump = asyncio.get_running_loop().create_task(
+            self._read_loop())
+
+    #: result for ops whose outcome is UNKNOWN (connection lost before
+    #: the response arrived): distinct from the protocol's "failed",
+    #: which is a definitive rejection — conflating them would let a
+    #: retry loop double-apply a write that actually committed.
+    DISCONNECTED = ("error", "disconnected")
+
+    async def close(self) -> None:
+        if self._pump is not None:
+            self._pump.cancel()
+        if self._writer is not None:
+            self._writer.close()
+        self._fail_pending()
+
+    def _fail_pending(self) -> None:
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_result(self.DISCONNECTED)
+        self._pending.clear()
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                head = await self._reader.readexactly(_HDR.size)
+                (length,) = _HDR.unpack(head)
+                frame = await self._reader.readexactly(length)
+                req_id, result = wire.decode(frame)
+                fut = self._pending.pop(req_id, None)
+                if fut is not None and not fut.done():
+                    fut.set_result(result)
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError, wire.WireError):
+            self._fail_pending()
+
+    async def call(self, op: str, *args: Any, timeout: float = 30.0):
+        req_id = next(self._ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        payload = wire.encode((req_id, op) + args)
+        self._writer.write(_HDR.pack(len(payload)) + payload)
+        await self._writer.drain()
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(req_id, None)  # a long-lived pipelined
+            raise                            # client must not leak ids
+
+    # convenience wrappers
+    async def kput(self, ens, key, value, **kw):
+        return await self.call("kput", ens, key, value, **kw)
+
+    async def kget(self, ens, key, **kw):
+        return await self.call("kget", ens, key, **kw)
+
+    async def kget_vsn(self, ens, key, **kw):
+        return await self.call("kget_vsn", ens, key, **kw)
+
+    async def kupdate(self, ens, key, vsn, value, **kw):
+        return await self.call("kupdate", ens, key, vsn, value, **kw)
+
+    async def kdelete(self, ens, key, **kw):
+        return await self.call("kdelete", ens, key, **kw)
+
+    async def ksafe_delete(self, ens, key, vsn, **kw):
+        return await self.call("ksafe_delete", ens, key, vsn, **kw)
+
+    async def stats(self, **kw):
+        return await self.call("stats", **kw)
+
+
+async def serve(n_ens: int, n_peers: int, n_slots: int,
+                host: str = "127.0.0.1", port: int = 0,
+                tick: float = 0.005,
+                config: Optional[Config] = None,
+                engine: Any = None) -> ServiceServer:
+    """Bring up runtime + service + server; returns the started
+    server (call ``await server.stop()`` to tear down)."""
+    runtime = NetRuntime("svc", {"svc": (host, 0)})
+    runtime.loop = asyncio.get_running_loop()
+    svc = BatchedEnsembleService(
+        runtime, n_ens, n_peers, n_slots, tick=tick,
+        config=config if config is not None else Config(),
+        engine=engine)
+    server = ServiceServer(svc, host, port)
+    await server.start()
+    return server
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=7601)
+    ap.add_argument("--n-ens", type=int, default=1024)
+    ap.add_argument("--n-peers", type=int, default=5)
+    ap.add_argument("--n-slots", type=int, default=128)
+    ap.add_argument("--tick", type=float, default=0.005)
+    ap.add_argument("--fast", action="store_true",
+                    help="fast_test_config timeouts")
+    args = ap.parse_args(argv)
+
+    async def run() -> None:
+        server = await serve(
+            args.n_ens, args.n_peers, args.n_slots, args.host,
+            args.port, args.tick,
+            config=fast_test_config() if args.fast else None)
+        print(f"svcnode serving {args.n_ens} ensembles on "
+              f"{server.host}:{server.port}", flush=True)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await server.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

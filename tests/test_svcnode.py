@@ -1,0 +1,100 @@
+"""Network front-end for the batched service (svcnode): remote
+clients reach the engine-backed K/V plane over TCP with the
+restricted wire codec — the scale-path analog of netnode."""
+
+import asyncio
+import struct
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from riak_ensemble_tpu import svcnode  # noqa: E402
+from riak_ensemble_tpu.config import fast_test_config  # noqa: E402
+from riak_ensemble_tpu.types import NOTFOUND  # noqa: E402
+
+
+def test_svcnode_end_to_end():
+    async def scenario():
+        server = await svcnode.serve(4, 3, 8, port=0,
+                                     config=fast_test_config())
+        c = svcnode.ServiceClient(server.host, server.port)
+        await c.connect()
+
+        r = await c.kput(0, "k", b"v1")
+        assert r[0] == "ok"
+        vsn = tuple(r[1])
+        assert await c.kget(0, "k") == ("ok", b"v1")
+        r = await c.kupdate(0, "k", vsn, b"v2")
+        assert r[0] == "ok"
+        assert await c.kget(0, "k") == ("ok", b"v2")
+        r = await c.kget_vsn(0, "k")
+        assert r[0] == "ok" and r[1] == b"v2"
+        r = await c.ksafe_delete(0, "k", tuple(r[2]))
+        assert r[0] == "ok"  # CAS-to-tombstone acks with the new vsn
+        assert await c.kget(0, "k") == ("ok", NOTFOUND)
+        assert await c.kdelete(0, "nope") == ("ok", NOTFOUND)
+
+        # pipelining: many in-flight ops, out-of-order-safe by req id
+        puts = [c.kput(e, f"p{i}", b"x%d" % i)
+                for e in range(4) for i in range(5)]
+        results = await asyncio.gather(*puts)
+        assert all(r[0] == "ok" for r in results)
+        gets = [c.kget(e, f"p{i}") for e in range(4) for i in range(5)]
+        results = await asyncio.gather(*gets)
+        assert [r[1] for r in results] == \
+            [b"x%d" % i for _e in range(4) for i in range(5)]
+
+        st = await c.stats()
+        assert st["ops_served"] > 0 and st["ensembles_with_leader"] >= 1
+
+        # unknown op answers, connection stays usable
+        assert await c.call("bogus-op") == ("error", "unknown-op")
+        assert await c.kget(1, "p0") == ("ok", b"x0")
+
+        # ensemble index is untrusted input: negative (would alias
+        # via Python indexing) and out-of-range reject cleanly, as
+        # does wrong arity — and the connection survives all three
+        assert await c.call("kput", -1, "k", b"v") == \
+            ("error", "bad-request")
+        assert await c.call("kput", 99, "k", b"v") == \
+            ("error", "bad-request")
+        assert await c.call("kput", 0) == ("error", "bad-request")
+        assert await c.kget(1, "p0") == ("ok", b"x0")
+
+        await c.close()
+        await server.stop()
+
+    asyncio.run(scenario())
+
+
+def test_svcnode_hostile_frames_drop_connection_only():
+    async def scenario():
+        server = await svcnode.serve(2, 3, 4, port=0,
+                                     config=fast_test_config())
+        # hostile: garbage payload -> server drops THIS connection
+        reader, writer = await asyncio.open_connection(
+            server.host, server.port)
+        junk = b"\x93\x01\x02pickle-ish\xff"
+        writer.write(struct.pack(">I", len(junk)) + junk)
+        await writer.drain()
+        assert await reader.read(1) == b""  # server closed it
+        writer.close()
+
+        # hostile: absurd length prefix -> dropped without allocation
+        reader, writer = await asyncio.open_connection(
+            server.host, server.port)
+        writer.write(struct.pack(">I", (1 << 31) - 1))
+        await writer.drain()
+        assert await reader.read(1) == b""
+        writer.close()
+
+        # a well-behaved client is unaffected
+        c = svcnode.ServiceClient(server.host, server.port)
+        await c.connect()
+        assert (await c.kput(0, "k", b"v"))[0] == "ok"
+        assert await c.kget(0, "k") == ("ok", b"v")
+        await c.close()
+        await server.stop()
+
+    asyncio.run(scenario())
