@@ -137,3 +137,53 @@ def test_synctree_on_native_backend(tmp_path):
     assert t2.get(42) == (420).to_bytes(8, "big")
     assert t2.verify()
     be2.close()
+
+
+@needs_native
+@pytest.mark.parametrize("seed", range(3))
+def test_store_randomized_against_dict_model(tmp_path, seed):
+    """Property sweep for the C++ store: random puts/overwrites/
+    deletes interleaved with sync, compaction, and full close/reopen
+    cycles must match a plain dict model exactly — keys, values, and
+    counts (the synctree_eqc-style differential check for the
+    eleveldb-role component)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    path = str(tmp_path / f"prop{seed}.db")
+    be = native_store.NativeBackend(path)
+    model = {}
+    keyspace = [("k", int(i)) for i in range(40)]
+
+    for step in range(600):
+        r = rng.random()
+        key = keyspace[int(rng.integers(len(keyspace)))]
+        if r < 0.55:
+            val = {"v": bytes(rng.integers(0, 256, int(rng.integers(0, 24)),
+                                           dtype=np.uint8)),
+                   "n": int(rng.integers(1 << 30))}
+            be.store(key, val)
+            model[key] = val
+        elif r < 0.75:
+            be.delete(key)
+            model.pop(key, None)
+        elif r < 0.85:
+            be.sync()
+        elif r < 0.93:
+            be.compact()
+        else:
+            be.close()
+            be = native_store.NativeBackend(path)  # reopen: WAL replay
+
+        if step % 97 == 0:  # periodic full-state comparison
+            assert be.count() == len(model)
+            for k in keyspace:
+                assert be.fetch(k) == model.get(k), (seed, step, k)
+
+    be.close()
+    be = native_store.NativeBackend(path)
+    assert be.count() == len(model)
+    assert sorted(map(repr, be.keys())) == sorted(map(repr, model))
+    for k, v in model.items():
+        assert be.fetch(k) == v
+    be.close()
