@@ -25,7 +25,7 @@ from riak_ensemble_tpu.testing import Cluster, ManagedCluster, make_peers
 from riak_ensemble_tpu.types import NOTFOUND, PeerId
 
 
-@pytest.mark.parametrize("seed", range(60, 76))
+@pytest.mark.parametrize("seed", range(60, 80))
 def test_failover_under_schedule_fuzzing(seed):
     c = Cluster(seed=seed)
     # Widen the delivery window with the seed: up to 20x the default
@@ -51,7 +51,7 @@ def test_failover_under_schedule_fuzzing(seed):
     assert c.kget_value("ens", "k") == b"v2"
 
 
-@pytest.mark.parametrize("seed", range(80, 88))
+@pytest.mark.parametrize("seed", range(80, 90))
 def test_failover_under_chaos_permuter(seed):
     """The failover story again, but with the true permuter on: a
     20 ms reorder window (vs 0.5 ms normal latency, under the 50 ms
@@ -75,7 +75,7 @@ def test_failover_under_chaos_permuter(seed):
     assert c.kget_value("ens", "k") == b"v2"
 
 
-@pytest.mark.parametrize("seed", range(90, 96))
+@pytest.mark.parametrize("seed", range(90, 98))
 def test_membership_churn_under_chaos(seed):
     """update_members add→remove cycles racing client writes with the
     permuter on: the joint-consensus dance (pending/views vsns, the
@@ -105,7 +105,7 @@ def test_membership_churn_under_chaos(seed):
         assert r[0] == "ok" and r[1].value == b"v%d" % i, (seed, i, r)
 
 
-@pytest.mark.parametrize("seed", range(100, 106))
+@pytest.mark.parametrize("seed", range(100, 108))
 def test_corruption_exchange_under_chaos(seed):
     """Synctree corruption detected and healed while the exchange's
     level-batched round trips are being reordered by the permuter; the
@@ -127,7 +127,7 @@ def test_corruption_exchange_under_chaos(seed):
     assert mc.runtime.run_until(never_notfound, 60.0), f"seed {seed}"
 
 
-@pytest.mark.parametrize("seed", range(110, 116))
+@pytest.mark.parametrize("seed", range(110, 118))
 def test_read_path_cas_races_under_chaos(seed):
     """Interleaved CAS updates, deletes, and reads with the permuter
     on and a mid-run leader freeze: every CAS outcome must be
@@ -161,7 +161,7 @@ def test_read_path_cas_races_under_chaos(seed):
     assert c.kget_value("ens", "k") == last
 
 
-@pytest.mark.parametrize("seed", range(120, 126))
+@pytest.mark.parametrize("seed", range(120, 128))
 def test_backend_death_under_chaos(seed):
     """The handle_down → reset → step_down path while the permuter
     reorders the recovery traffic: the leader's storage helper dies
@@ -209,7 +209,7 @@ def test_backend_death_under_chaos(seed):
     assert c.kget_value("ens", "k") == b"v2", f"seed {seed}"
 
 
-@pytest.mark.parametrize("seed", range(130, 136))
+@pytest.mark.parametrize("seed", range(130, 138))
 def test_partition_heal_under_chaos(seed):
     """sc.erl's partition nemesis composed with the permuter: the
     leader is isolated in a minority; the majority side must depose it
