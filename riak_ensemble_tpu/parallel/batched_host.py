@@ -201,6 +201,7 @@ class BatchedEnsembleService:
         #: counts only residual divergence the sweep fixed)
         self.repairs = 0
         self._timer: Optional[Timer] = None
+        self._kick_pending = False  # burst flush queued (see _maybe_kick)
         self._jnp = jnp
         self._schedule()
 
@@ -220,6 +221,7 @@ class BatchedEnsembleService:
         self.slot_gen[ens][slot] = gen
         self.queues[ens].append(
             _PendingOp(eng.OP_PUT, slot, handle, fut, key, gen))
+        self._maybe_kick(ens)
         return fut
 
     def kget(self, ens: int, key: Any) -> Future:
@@ -231,6 +233,7 @@ class BatchedEnsembleService:
             fut.resolve(("ok", NOTFOUND))
             return fut
         self.queues[ens].append(_PendingOp(eng.OP_GET, slot, 0, fut))
+        self._maybe_kick(ens)
         return fut
 
     def kget_vsn(self, ens: int, key: Any) -> Future:
@@ -246,6 +249,7 @@ class BatchedEnsembleService:
             return fut
         self.queues[ens].append(
             _PendingOp(eng.OP_GET, slot, 0, fut, want_vsn=True))
+        self._maybe_kick(ens)
         return fut
 
     def kupdate(self, ens: int, key: Any, expected_vsn: Tuple[int, int],
@@ -268,6 +272,7 @@ class BatchedEnsembleService:
         self.queues[ens].append(
             _PendingOp(eng.OP_CAS, slot, handle, fut, key, gen,
                        exp=(int(expected_vsn[0]), int(expected_vsn[1]))))
+        self._maybe_kick(ens)
         return fut
 
     def ksafe_delete(self, ens: int, key: Any,
@@ -283,6 +288,7 @@ class BatchedEnsembleService:
                         exp=(int(expected_vsn[0]), int(expected_vsn[1])))
         self.queues[ens].append(op)
         self._recycle_on_ok(fut, ens, key, slot)
+        self._maybe_kick(ens)
         return fut
 
     def kdelete(self, ens: int, key: Any) -> Future:
@@ -296,6 +302,7 @@ class BatchedEnsembleService:
         op = _PendingOp(eng.OP_PUT, slot, handle, fut)
         self.queues[ens].append(op)
         self._recycle_on_ok(fut, ens, key, slot)
+        self._maybe_kick(ens)
         return fut
 
     def _recycle_on_ok(self, fut: Future, ens: int, key: Any,
@@ -601,6 +608,32 @@ class BatchedEnsembleService:
                 # else: the slot was re-used meanwhile — drop the stale
                 # recycle request
             self._recycle_pending[e] = keep
+
+    def _maybe_kick(self, ens: int) -> None:
+        """Burst trigger: a queue that just reached a full launch's
+        depth flushes NOW (deferred to the next runtime turn, never
+        reentrant inside an enqueue) instead of waiting out the tick —
+        batching is for amortization, not added latency.  Only in
+        timer-driven mode; caller-driven services control their own
+        flush points."""
+        if self.tick is None or self._kick_pending:
+            return
+        if len(self.queues[ens]) < self.max_k:
+            return
+        self._kick_pending = True
+
+        def kick() -> None:
+            self._kick_pending = False
+            self.flush()
+            # One flush serves max_k per ensemble; a burst deeper
+            # than that (its later enqueues hit the _kick_pending
+            # guard) keeps draining — including its sub-threshold
+            # tail, which is part of the same burst, not a fresh
+            # trickle that should wait for the tick.
+            if any(self.queues):
+                self._kick_pending = True
+                self.runtime.defer(kick)
+        self.runtime.defer(kick)
 
     def _schedule(self) -> None:
         if self.tick is None:

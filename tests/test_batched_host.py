@@ -735,3 +735,26 @@ def test_all_waiters_run_despite_raising_waiter():
         f.resolve("x")
     assert ran == ["a", "b"]
     assert f.done and f.value == "x"
+
+
+def test_burst_flush_does_not_wait_for_tick():
+    """A queue reaching a full launch's depth flushes on the next
+    runtime turn instead of waiting out the tick — batching must
+    amortize, not add latency."""
+    runtime = Runtime(seed=50)
+    svc = BatchedEnsembleService(runtime, 2, 3, 16, tick=10.0,
+                                 max_ops_per_tick=4,
+                                 config=fast_test_config())
+    futs = [svc.kput(0, f"k{i}", b"v") for i in range(4)]  # = max_k
+    runtime.run_for(0.01)  # far less than the 10s tick
+    assert all(f.done and f.value[0] == "ok" for f in futs), \
+        "burst did not trigger an early flush"
+    # a burst DEEPER than max_k drains fully too (chained kicks)
+    deep = [svc.kput(0, f"d{i}", b"v") for i in range(11)]
+    runtime.run_for(0.01)
+    assert all(f.done and f.value[0] == "ok" for f in deep), \
+        "multi-launch burst left a residue waiting for the tick"
+    # below the threshold: ops wait for the (huge) tick — still queued
+    f = svc.kput(1, "x", b"v")
+    runtime.run_for(0.01)
+    assert not f.done
