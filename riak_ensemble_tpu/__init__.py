@@ -27,11 +27,13 @@ L2    integrity                synctree.tree, synctree.peer_tree,
 L3    communication/quorum     msg, router, ops.quorum, ops.pallas_quorum
 L4    consensus core           peer, worker, lease, backend
 L5    cluster management       manager, root, state
-L6    client API               client, netnode (async)
+L6    client API               client, netnode (async), svcnode
+                               (scale-path TCP front-end + client)
 --    batched TPU engine       ops.engine, parallel.mesh,
                                parallel.batched_host (the scale-path
                                service), parallel.distributed
---    wire safety              wire (restricted codec), funref
+--    wire safety              wire (restricted codec + native/
+                               wirecodec.cc C++ extension), funref
 --    testing/verification     testing, linearizability, utils.trace
 ====  =======================  ============================================
 """
