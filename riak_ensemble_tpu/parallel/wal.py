@@ -1,0 +1,248 @@
+"""Write-ahead durability for the batched service's acked writes.
+
+The reference never acks a write that is not on disk: the basic
+backend saves synchronously on every put
+(``riak_ensemble_basic_backend.erl:120-125``, ``save_data:181-187``)
+and facts coalesce to disk within 50 ms
+(``riak_ensemble_storage.erl:86-103``).  The batched service acks from
+device+host memory, so between explicit checkpoints it needs exactly
+this: a log of committed client writes that is forced to disk BEFORE
+the client futures resolve, and replayed over the latest checkpoint on
+restart.
+
+The store is *latest-record-per-(ensemble, slot)* — not a strictly
+ordered log — because that is all recovery needs: the newest committed
+(epoch, seq, payload) per slot, plus committed membership rows.  The
+C++ treestore (``native/treestore.cc``: CRC-framed append log +
+in-memory ordered index + snapshot compaction) provides those
+semantics natively and is used when the toolchain is available;
+:class:`PyLogStore` is the byte-compatible-enough pure-Python fallback
+(same interface, its own CRC-framed append log).
+
+WAL generations pair with checkpoint generations: checkpoint ``n``
+subsumes every record in ``wal.<n-...>``, so each :meth:`rotate`
+starts a fresh ``wal.<n>`` directory and deletes the old ones — there
+is no in-place truncate to get wrong.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import zlib
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+#: sync modes: "fsync" forces records to stable storage before the ack
+#: (power-loss safe — the basic_backend put contract); "buffer" writes
+#: through the OS page cache without fsync (process-crash safe; an OS
+#: crash can lose the tail — the coalesced-facts RPO rationale,
+#: storage.erl:21-39).
+SYNC_MODES = ("fsync", "buffer")
+
+
+class PyLogStore:
+    """Pure-Python latest-per-key store over a CRC-framed append log.
+
+    Interface-compatible subset of
+    :class:`riak_ensemble_tpu.synctree.native_store.NativeBackend`:
+    ``store/delete/fetch/keys/count/sync/close``.  Torn or corrupt
+    tail records are dropped at replay (the crash happened mid-append;
+    everything acked before it had already been synced).
+    """
+
+    _MAGIC = b"RWAL"
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._map: Dict[bytes, bytes] = {}
+        good = self._replay()
+        if good is not None:
+            # Truncate the torn/corrupt tail BEFORE appending: records
+            # appended after garbage would be unreachable at every
+            # future replay — acked writes silently lost on the second
+            # crash (the replay correctly stops at the tear, so the
+            # bytes past `good` were never acked data we could keep).
+            with open(self.path, "r+b") as f:
+                f.truncate(good)
+        self._f = open(path, "ab")
+
+    def _replay(self) -> Optional[int]:
+        """Rebuild the map from the log.  Returns the byte offset of
+        the first bad record (caller truncates there), or None when
+        the whole file parsed clean."""
+        try:
+            f = open(self.path, "rb")
+        except FileNotFoundError:
+            return None
+        with f:
+            head4 = f.read(4)
+            if head4 == b"":
+                return None
+            if head4 != self._MAGIC:
+                # Foreign/corrupt prefix: nothing here is replayable,
+                # and appending after it would hide every future
+                # record too.  Preserve the bytes for forensics and
+                # start a fresh log.
+                f.close()
+                os.replace(self.path, self.path + ".corrupt")
+                return None
+            off = 4
+            while True:
+                head = f.read(8)
+                if len(head) < 8:
+                    return off if head else None
+                crc, ln = struct.unpack(">II", head)
+                body = f.read(ln)
+                if len(body) < ln or zlib.crc32(body) != crc or ln < 5:
+                    return off  # torn tail
+                op = body[0]
+                klen = struct.unpack(">I", body[1:5])[0]
+                if 5 + klen > ln:
+                    return off
+                key = body[5:5 + klen]
+                if op == 1:
+                    self._map[key] = body[5 + klen:]
+                elif op == 2:
+                    self._map.pop(key, None)
+                else:
+                    return off
+                off += 8 + ln
+
+    def _append(self, op: int, key: bytes, val: bytes) -> None:
+        if self._f.tell() == 0:
+            self._f.write(self._MAGIC)
+        body = bytes([op]) + struct.pack(">I", len(key)) + key + val
+        self._f.write(struct.pack(">II", zlib.crc32(body), len(body))
+                      + body)
+
+    def store(self, key: Any, value: Any) -> None:
+        k, v = pickle.dumps(key, protocol=4), pickle.dumps(value,
+                                                           protocol=4)
+        self._map[k] = v
+        self._append(1, k, v)
+
+    def delete(self, key: Any) -> None:
+        k = pickle.dumps(key, protocol=4)
+        self._map.pop(k, None)
+        self._append(2, k, b"")
+
+    def fetch(self, key: Any, default: Any = None) -> Any:
+        v = self._map.get(pickle.dumps(key, protocol=4))
+        return default if v is None else pickle.loads(v)
+
+    def keys(self) -> Iterable[Any]:
+        return [pickle.loads(k) for k in self._map]
+
+    def count(self) -> int:
+        return len(self._map)
+
+    def sync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def flush(self) -> None:
+        """Push buffered records to the OS page cache (no fsync) —
+        the process-crash durability floor of buffer mode."""
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+            self._f.close()
+            self._f = None
+
+
+def _open_store(path: str):
+    """Native treestore when buildable, Python log otherwise."""
+    from riak_ensemble_tpu.synctree import native_store
+
+    if native_store.available():
+        return native_store.NativeBackend(path)
+    return PyLogStore(path)
+
+
+class ServiceWAL:
+    """One WAL generation: committed write records under ``dir_path``.
+
+    Record keys/values (pickled by the store layer):
+
+    - ``("kv", ens, slot)`` → ``(key_obj, handle, epoch, seq, payload,
+      inline)`` — a committed client write.  ``payload`` is the host
+      payload-store bytes behind ``handle`` (None for tombstones);
+      ``inline=True`` marks bulk-array writes whose int32 value IS the
+      payload (no handle indirection).
+    - ``("mem", ens)`` → ``list[bool]`` — a committed membership row.
+    """
+
+    def __init__(self, dir_path: str, sync_mode: str = "fsync") -> None:
+        assert sync_mode in SYNC_MODES, sync_mode
+        os.makedirs(dir_path, exist_ok=True)
+        self.dir_path = dir_path
+        self.sync_mode = sync_mode
+        self._store = _open_store(os.path.join(dir_path, "wal"))
+
+    def log(self, records: List[Tuple[Any, Any]]) -> None:
+        """Append a batch and make it durable per the sync mode.  MUST
+        complete before the writes it covers are acked."""
+        for key, value in records:
+            self._store.store(key, value)
+        if self.sync_mode == "fsync":
+            self._store.sync()
+        else:
+            # buffer mode promises PROCESS-crash safety: the records
+            # must at least reach the kernel before the ack — a
+            # userspace io buffer dies with the process.
+            self._flush_store()
+
+    def _flush_store(self) -> None:
+        flush = getattr(self._store, "flush", None)
+        if flush is not None:
+            flush()
+        else:  # pragma: no cover - older store without flush-only
+            self._store.sync()
+
+    def delete(self, keys: List[Any]) -> None:
+        """Remove records (e.g. a destroyed ensemble's kv entries)
+        with the same durability barrier as :meth:`log`."""
+        for key in keys:
+            self._store.delete(key)
+        if self.sync_mode == "fsync":
+            self._store.sync()
+
+    def records(self) -> List[Tuple[Any, Any]]:
+        return [(k, self._store.fetch(k)) for k in self._store.keys()]
+
+    @property
+    def count(self) -> int:
+        return self._store.count()
+
+    def close(self) -> None:
+        self._store.close()
+
+    # -- generation management -------------------------------------------
+
+    @staticmethod
+    def gen_path(base_dir: str, gen: int) -> str:
+        return os.path.join(base_dir, f"wal.{gen}")
+
+    @classmethod
+    def open_gen(cls, base_dir: str, gen: int,
+                 sync_mode: str = "fsync") -> "ServiceWAL":
+        return cls(cls.gen_path(base_dir, gen), sync_mode)
+
+    @classmethod
+    def rotate(cls, base_dir: str, new_gen: int, old: "ServiceWAL",
+               sync_mode: str = "fsync") -> "ServiceWAL":
+        """Start generation ``new_gen`` (its records begin empty) and
+        drop every older generation — call only AFTER checkpoint
+        ``new_gen`` is fully committed (CURRENT flipped)."""
+        import shutil
+
+        old.close()
+        nw = cls.open_gen(base_dir, new_gen, sync_mode)
+        for name in os.listdir(base_dir):
+            if name.startswith("wal.") and name != f"wal.{new_gen}":
+                shutil.rmtree(os.path.join(base_dir, name),
+                              ignore_errors=True)
+        return nw

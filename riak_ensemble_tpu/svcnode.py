@@ -24,6 +24,16 @@ requests and correlate out-of-order completions:
     ("ksafe_delete", ens, key, vsn)  -> ("ok", new_vsn) | "failed"
     ("stats",)                       -> dict
 
+Dynamic-lifecycle ops (service constructed with ``dynamic=True``;
+the runtime create/destroy surface of
+``riak_ensemble_manager:create_ensemble``, manager.erl:157-166):
+
+    ("create_ensemble", name[, view]) -> ("ok", ens_id) |
+                                         ("error", "no-capacity")
+    ("destroy_ensemble", name)        -> ("ok",) | ("error", "unknown")
+    ("resolve_ensemble", name)        -> ("ok", ens_id) |
+                                         ("error", "unknown")
+
 Malformed or non-allowlisted frames drop the connection (the codec
 cannot construct anything outside the protocol types).
 
@@ -46,6 +56,14 @@ from riak_ensemble_tpu.parallel.batched_host import BatchedEnsembleService
 
 _HDR = struct.Struct(">I")
 _MAX_FRAME = 16 << 20
+#: per-connection backpressure bounds: a client may pipeline at most
+#: this many unresolved ops (further frames stay in the TCP receive
+#: path — flow control rides the transport), and a client that stops
+#: READING while the server responds is dropped once the send buffer
+#: passes the cap (it can reconnect; unbounded buffering cannot be
+#: taken back).
+_MAX_INFLIGHT = 1024
+_MAX_WRITE_BUF = 8 << 20
 
 
 class ServiceServer:
@@ -95,16 +113,59 @@ class ServiceServer:
             return svc.ksafe_delete(*args)
         return None
 
+    def _lifecycle(self, op: str, args: tuple):
+        """Synchronous dynamic-ensemble ops (no flush involved).
+
+        Event-loop note: create/destroy dispatch one ``reset_rows``
+        launch.  Its XLA program is compiled at SERVICE CONSTRUCTION
+        (the dynamic=True constructor issues a same-shape reset over
+        all rows), so these handlers never pay the tens-of-seconds
+        first-compile on the loop — only an async device dispatch.
+        """
+        try:
+            name = args[0]
+            if op == "create_ensemble":
+                view = None
+                if len(args) > 1 and args[1] is not None:
+                    import numpy as np
+                    view = np.asarray(args[1], bool)
+                row = self.svc.create_ensemble(name, view)
+                return (("ok", row) if row is not None
+                        else ("error", "no-capacity"))
+            if op == "destroy_ensemble":
+                return (("ok",) if self.svc.destroy_ensemble(name)
+                        else ("error", "unknown"))
+            row = self.svc.resolve_ensemble(name)
+            return ("ok", row) if row is not None \
+                else ("error", "unknown")
+        except Exception:
+            return ("error", "bad-request")
+
     async def _on_client(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
-        loop = asyncio.get_running_loop()
+        # Per-connection op budget: the read loop blocks once
+        # _MAX_INFLIGHT ops are unresolved, so a pipelining client
+        # can't grow the queues/pending maps without bound (the
+        # VERDICT/advisor backpressure finding).
+        inflight = asyncio.Semaphore(_MAX_INFLIGHT)
 
         def send(req_id: Any, result: Any) -> None:
+            # Responses are written from flush-context future waiters
+            # too — never after close, and never into an unbounded
+            # buffer for a client that stopped reading (advisor: drain
+            # is only awaited on the request path).
+            if writer.is_closing():
+                return
             try:
                 payload = wire.encode((req_id, result))
             except wire.WireError:
                 payload = wire.encode((req_id, "failed"))
             writer.write(_HDR.pack(len(payload)) + payload)
+            transport = writer.transport
+            if (transport is not None
+                    and transport.get_write_buffer_size()
+                    > _MAX_WRITE_BUF):
+                transport.abort()
 
         try:
             while True:
@@ -122,21 +183,31 @@ class ServiceServer:
                 if op == "stats":
                     send(req_id, self.svc.stats())
                     continue
+                if op in ("create_ensemble", "destroy_ensemble",
+                          "resolve_ensemble"):
+                    send(req_id, self._lifecycle(op, args))
+                    continue
+                await inflight.acquire()
                 try:
                     fut = self._dispatch(op, args)
                 except Exception:
                     # wrong arity / types from a hostile or buggy
                     # client: answer, don't let the task die with an
                     # unhandled traceback
+                    inflight.release()
                     send(req_id, ("error", "bad-request"))
                     continue
                 if fut is None:
+                    inflight.release()
                     send(req_id, ("error", "unknown-op"))
                     continue
+
                 # Resolution happens inside a flush on this same
                 # loop; the waiter writes the response directly.
-                fut.add_waiter(
-                    lambda result, rid=req_id: send(rid, result))
+                def on_done(result: Any, rid: Any = req_id) -> None:
+                    inflight.release()
+                    send(rid, result)
+                fut.add_waiter(on_done)
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
@@ -195,12 +266,22 @@ class ServiceClient:
             self._fail_pending()
 
     async def call(self, op: str, *args: Any, timeout: float = 30.0):
+        # Never-connected or already-closed clients get the documented
+        # DISCONNECTED result, not an AttributeError (advisor finding).
+        if self._writer is None or self._writer.is_closing():
+            return self.DISCONNECTED
         req_id = next(self._ids)
-        fut = asyncio.get_running_loop().create_future()
+        payload = wire.encode((req_id, op) + args)  # WireError = caller
+        fut = asyncio.get_running_loop().create_future()  # bug: raise
         self._pending[req_id] = fut
-        payload = wire.encode((req_id, op) + args)
-        self._writer.write(_HDR.pack(len(payload)) + payload)
-        await self._writer.drain()
+        try:
+            self._writer.write(_HDR.pack(len(payload)) + payload)
+            await self._writer.drain()
+        except (ConnectionError, OSError):
+            # The write raced a connection loss: the future must not
+            # leak in _pending (advisor finding).
+            self._pending.pop(req_id, None)
+            return self.DISCONNECTED
         try:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
@@ -229,12 +310,22 @@ class ServiceClient:
     async def stats(self, **kw):
         return await self.call("stats", **kw)
 
+    async def create_ensemble(self, name, view=None, **kw):
+        return await self.call("create_ensemble", name, view, **kw)
+
+    async def destroy_ensemble(self, name, **kw):
+        return await self.call("destroy_ensemble", name, **kw)
+
+    async def resolve_ensemble(self, name, **kw):
+        return await self.call("resolve_ensemble", name, **kw)
+
 
 async def serve(n_ens: int, n_peers: int, n_slots: int,
                 host: str = "127.0.0.1", port: int = 0,
                 tick: float = 0.005,
                 config: Optional[Config] = None,
-                engine: Any = None) -> ServiceServer:
+                engine: Any = None, dynamic: bool = False,
+                data_dir: Optional[str] = None) -> ServiceServer:
     """Bring up runtime + service + server; returns the started
     server (call ``await server.stop()`` to tear down)."""
     runtime = NetRuntime("svc", {"svc": (host, 0)})
@@ -242,7 +333,7 @@ async def serve(n_ens: int, n_peers: int, n_slots: int,
     svc = BatchedEnsembleService(
         runtime, n_ens, n_peers, n_slots, tick=tick,
         config=config if config is not None else Config(),
-        engine=engine)
+        engine=engine, dynamic=dynamic, data_dir=data_dir)
     server = ServiceServer(svc, host, port)
     await server.start()
     return server
@@ -258,13 +349,20 @@ def main(argv=None) -> int:
     ap.add_argument("--tick", type=float, default=0.005)
     ap.add_argument("--fast", action="store_true",
                     help="fast_test_config timeouts")
+    ap.add_argument("--dynamic", action="store_true",
+                    help="start with zero ensembles; clients create/"
+                         "destroy them at runtime")
+    ap.add_argument("--data-dir", default=None,
+                    help="durability root (WAL + checkpoints); acked "
+                         "writes survive crashes")
     args = ap.parse_args(argv)
 
     async def run() -> None:
         server = await serve(
             args.n_ens, args.n_peers, args.n_slots, args.host,
             args.port, args.tick,
-            config=fast_test_config() if args.fast else None)
+            config=fast_test_config() if args.fast else None,
+            dynamic=args.dynamic, data_dir=args.data_dir)
         print(f"svcnode serving {args.n_ens} ensembles on "
               f"{server.host}:{server.port}", flush=True)
         try:

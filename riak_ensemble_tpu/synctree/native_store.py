@@ -93,6 +93,10 @@ class NativeBackend:
     def sync(self) -> None:
         self._lib.retpu_store_sync(self._handle)
 
+    def flush(self) -> None:
+        """Flush-only (no fsync): the process-crash durability floor."""
+        self._lib.retpu_store_flush(self._handle)
+
     def compact(self) -> None:
         self._lib.retpu_store_compact(self._handle)
 

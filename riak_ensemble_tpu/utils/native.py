@@ -28,16 +28,42 @@ SONAME = os.path.join(NATIVE_DIR, "libretpu_native.so")
 
 def build_target(target: str, artifact: str) -> bool:
     """Run make for one target in ``native/``; True iff the artifact
-    exists afterwards.  make is invoked even when the artifact already
-    exists — a fast no-op when fresh, a rebuild when its source
-    changed (stale .so files otherwise survive source edits forever).
-    Shared by the ctypes library below and wire.py's codec loader."""
+    exists afterwards AND is confirmed fresh.  make is invoked even
+    when the artifact already exists — a fast no-op when fresh, a
+    rebuild when its source changed (stale .so files otherwise survive
+    source edits forever).  Shared by the ctypes library below and
+    wire.py's codec loader.
+
+    Failure discipline (advisor r2): every path on which make could
+    NOT confirm the artifact (nonzero rc, make missing, timeout)
+    refuses an existing artifact unless its mtime already postdates
+    every source in ``native/`` — a stale codec .so diverging from the
+    Python oracle is strictly worse than the pure-Python fallback.
+    """
     try:
         proc = subprocess.run(["make", "-C", NATIVE_DIR, target],
                               capture_output=True, timeout=120)
         return proc.returncode == 0 and os.path.exists(artifact)
     except Exception:
-        return os.path.exists(artifact)
+        return _artifact_fresh(artifact)
+
+
+def _artifact_fresh(artifact: str) -> bool:
+    """True iff ``artifact`` exists and is newer than every source
+    file in ``native/`` (the no-toolchain freshness check)."""
+    try:
+        art_m = os.path.getmtime(artifact)
+    except OSError:
+        return False
+    try:
+        for name in os.listdir(NATIVE_DIR):
+            if name.endswith((".cc", ".c", ".h")) or name == "Makefile":
+                if os.path.getmtime(
+                        os.path.join(NATIVE_DIR, name)) > art_m:
+                    return False
+    except OSError:
+        return False
+    return True
 
 
 def _build() -> bool:
@@ -84,6 +110,7 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
             ctypes.c_uint64]
         lib.retpu_store_sync.argtypes = [ctypes.c_void_p]
+        lib.retpu_store_flush.argtypes = [ctypes.c_void_p]
         lib.retpu_store_compact.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib

@@ -244,17 +244,22 @@ def test_process_kill_and_restart(tmp_path):
 
         threading.Thread(target=reader, daemon=True).start()
 
-        assert marks["WROTE_V1"].wait(90), f"no first write: {lines!r}"
+        # Deadlines are sized for a heavily loaded machine (the r2
+        # full-suite run tripped a 90 s wait that passes in
+        # isolation): the in-script retry loops dominate, and a
+        # generous driver wait only costs time when the test is
+        # genuinely broken.
+        assert marks["WROTE_V1"].wait(240), f"no first write: {lines!r}"
         # kill the leader-hint node's process
         procs["node1"].kill()
         procs["node1"].wait(timeout=10)
 
-        assert marks["SURVIVED_KILL"].wait(90), \
+        assert marks["SURVIVED_KILL"].wait(300), \
             f"no service after kill: {lines!r}"
 
         # restart node1 from its persisted data root
         procs["node1_restarted"] = spawn("node1", scripts["node1r"])
-        assert marks["RESULT_OK"].wait(120), \
+        assert marks["RESULT_OK"].wait(300), \
             f"restarted node never rejoined: {lines!r}"
     finally:
         for p in procs.values():

@@ -98,3 +98,111 @@ def test_svcnode_hostile_frames_drop_connection_only():
         await server.stop()
 
     asyncio.run(scenario())
+
+
+def _frame(msg):
+    from riak_ensemble_tpu import wire
+
+    payload = wire.encode(msg)
+    return struct.pack(">I", len(payload)) + payload
+
+
+def test_svcnode_inflight_backpressure_bounds_queued_ops(monkeypatch):
+    """A client pipelining thousands of ops can never hold more than
+    _MAX_INFLIGHT unresolved at the server (the read loop blocks on
+    the semaphore; TCP flow control pushes back) — and the pipeline
+    still completes exactly."""
+    monkeypatch.setattr(svcnode, "_MAX_INFLIGHT", 8)
+
+    async def scenario():
+        server = await svcnode.serve(2, 3, 64, port=0,
+                                     config=fast_test_config())
+        svc = server.svc
+        orig_flush = svc.flush
+        seen = []
+
+        def spy_flush():
+            seen.append(sum(len(q) for q in svc.queues))
+            return orig_flush()
+        svc.flush = spy_flush
+
+        reader, writer = await asyncio.open_connection(
+            server.host, server.port)
+        n = 400
+        for i in range(n):
+            writer.write(_frame((i, "kput", i % 2, f"k{i % 16}",
+                                 b"v%d" % i)))
+        await writer.drain()
+        # read every response (order may interleave; correlate by id)
+        got = set()
+        while len(got) < n:
+            head = await asyncio.wait_for(
+                reader.readexactly(4), timeout=30)
+            (length,) = struct.unpack(">I", head)
+            frame = await asyncio.wait_for(
+                reader.readexactly(length), timeout=30)
+            from riak_ensemble_tpu import wire
+            req_id, result = wire.decode(frame)
+            assert result[0] == "ok", (req_id, result)
+            got.add(req_id)
+        assert got == set(range(n))
+        # the cap held at every flush
+        assert seen and max(seen) <= 8, max(seen)
+        writer.close()
+        await server.stop()
+
+    asyncio.run(scenario())
+
+
+def test_svcnode_nonreading_client_dropped_not_buffered(monkeypatch):
+    """A client that pipelines reads but never drains its socket is
+    disconnected once the server-side write buffer passes the cap —
+    bounded memory — while a well-behaved client stays served."""
+    monkeypatch.setattr(svcnode, "_MAX_WRITE_BUF", 4096)
+
+    async def scenario():
+        server = await svcnode.serve(1, 3, 4, port=0,
+                                     config=fast_test_config())
+        good = svcnode.ServiceClient(server.host, server.port)
+        await good.connect()
+        big = b"x" * 8192
+        assert (await good.kput(0, "k", big))[0] == "ok"
+
+        reader, writer = await asyncio.open_connection(
+            server.host, server.port)
+        # hostile: request far more response bytes than the cap and
+        # never read them
+        for i in range(2000):
+            writer.write(_frame((i, "kget", 0, "k")))
+        try:
+            await writer.drain()
+        except ConnectionError:
+            pass  # already dropped mid-send: that's the point
+        # Don't read while the responses pile up: give the server time
+        # to exceed the cap and abort (RST discards the kernel receive
+        # queue; only the small already-pulled StreamReader buffer can
+        # still hand out bytes), THEN drain until the reset/EOF
+        # surfaces.
+        await asyncio.sleep(10)
+        dropped = False
+        for _ in range(60):
+            try:
+                b = await asyncio.wait_for(reader.read(1 << 20),
+                                           timeout=2.0)
+            except asyncio.TimeoutError:
+                continue
+            except ConnectionError:
+                dropped = True
+                break
+            if b == b"":
+                dropped = True
+                break
+        assert dropped, "non-reading client was never disconnected"
+        writer.close()
+
+        # the good client is unaffected
+        assert await good.kget(0, "k") == ("ok", big)
+        await good.close()
+        await server.stop()
+
+    asyncio.run(scenario())

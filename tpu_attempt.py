@@ -1,0 +1,151 @@
+"""Opportunistic TPU measurement harness (VERDICT r2 directive #1).
+
+The tunneled TPU backend has died mid-session in both prior rounds,
+so waiting until round-end bench time risks closing another round
+with zero TPU evidence.  This script is run repeatedly through the
+session: each invocation probes the accelerator with a tiny compiled
+op under a hard timeout; if (and only if) the chip answers, it runs
+the full bench ladder — service ops/s + p50/p99, kernel rounds/s,
+Pallas quorum A/B, Merkle + reconfig ladder — and PERSISTS the result
+immediately (``BENCH_TPU_attempt.json``) so a later tunnel death
+cannot erase it.  Every attempt (dead or alive) appends to
+``.attempts/tpu_probe_log.txt``.
+
+Exit code: 0 = measured and persisted, 2 = chip dead, 3 = probe ok
+but a later stage failed (partial results persisted).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+LOG = os.path.join(HERE, ".attempts", "tpu_probe_log.txt")
+OUT = os.path.join(HERE, "BENCH_TPU_attempt.json")
+
+
+def note(msg: str) -> None:
+    os.makedirs(os.path.dirname(LOG), exist_ok=True)
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+    with open(LOG, "a") as f:
+        f.write(f"{stamp} {msg}\n")
+    print(f"{stamp} {msg}", flush=True)
+
+
+def run_stage(args, timeout):
+    """One bench stage in a killable subprocess (a wedged TPU RPC
+    ignores signals; only a process-group kill unsticks it)."""
+    import signal
+
+    cmd = [sys.executable, os.path.join(HERE, "bench.py")] + args
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            pass
+        return None, "timeout"
+    if proc.returncode != 0:
+        return None, f"rc={proc.returncode} {err[-300:]}"
+    for line in reversed(out.strip().splitlines()):
+        try:
+            return json.loads(line), None
+        except json.JSONDecodeError:
+            continue
+    return None, "no json"
+
+
+def main() -> int:
+    probe_budget = float(os.environ.get("TPU_PROBE_BUDGET", "300"))
+    res, err = run_stage(["--stage", "probe"], probe_budget)
+    if res is None or res.get("platform") == "cpu":
+        note(f"probe dead ({err or 'cpu fallback'})")
+        return 2
+    note(f"probe ALIVE platform={res['platform']} — running full ladder")
+
+    results = {"platform": res["platform"],
+               "probe_time": datetime.datetime.now(
+                   datetime.timezone.utc).isoformat()}
+
+    def persist() -> None:
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1)
+
+    # Stage order mirrors bench.py: kernel FIRST (d2h degrades later
+    # dispatch on the tunneled chip), then service, ladder, A/B.
+    shapes = ["--n-ens", "10000", "--n-peers", "5", "--n-slots", "128",
+              "--k", "64"]
+    stages = [
+        ("kernel", ["--stage", "kernel", "--seconds", "3"] + shapes, 480),
+        ("service", ["--stage", "service", "--seconds", "3"] + shapes, 480),
+        ("merkle", ["--stage", "merkle", "--seconds", "3"], 420),
+        ("reconfig", ["--stage", "reconfig", "--seconds", "3"], 420),
+    ]
+    ok = True
+    for name, args, budget in stages:
+        r, err = run_stage(args, budget)
+        if r is None:
+            note(f"stage {name} FAILED ({err})")
+            results[name] = {"error": err}
+            ok = False
+            # Fall back to the 1k shape once for the big stages.
+            if name in ("kernel", "service"):
+                small = ["--n-ens", "1000", "--n-peers", "5",
+                         "--n-slots", "128", "--k", "32"]
+                r2, err2 = run_stage(
+                    ["--stage", name, "--seconds", "3"] + small, 360)
+                if r2 is not None:
+                    results[name] = {"shape": "1k_ens_5_peers", **r2}
+                    note(f"stage {name} ok at 1k fallback")
+        else:
+            results[name] = r
+            note(f"stage {name} ok: {json.dumps(r)[:200]}")
+        persist()
+
+    # Pallas quorum A/B: the same kernel stage with the Pallas reduce
+    # flag — the delta promised since round 1.
+    env = dict(os.environ, RETPU_PALLAS_QUORUM="1")
+    cmd = [sys.executable, os.path.join(HERE, "bench.py"), "--stage",
+           "kernel", "--seconds", "3"] + shapes
+    import signal
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            env=env, start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=480)
+        for line in reversed(out.strip().splitlines()):
+            try:
+                results["kernel_pallas_quorum"] = json.loads(line)
+                note("pallas A/B ok: "
+                     + json.dumps(results['kernel_pallas_quorum'])[:200])
+                break
+            except json.JSONDecodeError:
+                continue
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        note("pallas A/B timeout")
+        results["kernel_pallas_quorum"] = {"error": "timeout"}
+        ok = False
+    persist()
+    note(f"ladder complete ok={ok} -> {OUT}")
+    return 0 if ok else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
