@@ -126,8 +126,10 @@ def run_service(n_ens: int, n_peers: int, n_slots: int, k: int,
             c: round(v["p50_ms"], 3)
             for c, v in svc.latency_breakdown().items()},
     }
-    out["keyed_ops_per_sec"] = run_keyed_service(
+    keyed = run_keyed_service(
         min(n_ens, 1000), n_peers, n_slots, min(k, 16), seconds)
+    out["keyed_ops_per_sec"] = keyed["scalar"]
+    out["keyed_batched_ops_per_sec"] = keyed["batched"]
     return out
 
 
@@ -168,7 +170,28 @@ def run_keyed_service(n_ens: int, n_peers: int, n_slots: int, k: int,
     elapsed = time.perf_counter() - t0
     assert all(f.done and f.value[0] == "ok" for f in futs), \
         "keyed bench: ops failed"
-    return ops / elapsed
+    scalar_rate = ops / elapsed
+
+    # The VECTORIZED keyed surface (kput_many/kget_many): same keyed
+    # semantics, struct-of-arrays queue entries, one future per batch.
+    keys = [f"key{j}" for j in range(k)]
+    vals = [b"v%d" % j for j in range(k // 2)]
+    ops = 0
+    t_end = time.perf_counter() + max(seconds, 1e-3)
+    t0 = time.perf_counter()
+    while time.perf_counter() < t_end or not ops:
+        futs = []
+        for e in range(n_ens):
+            futs.append(svc.kput_many(e, keys[:k // 2], vals))
+            futs.append(svc.kget_many(e, keys[k // 2:]))
+        while any(svc.queues):
+            svc.flush()
+        ops += n_ens * k
+        # same parity check as the scalar phase: EVERY batch op acked
+        assert all(f.done and all(r[0] == "ok" for r in f.value)
+                   for f in futs), "keyed_many bench: ops failed"
+    elapsed = time.perf_counter() - t0
+    return {"scalar": scalar_rate, "batched": ops / elapsed}
 
 
 def run(n_ens: int, n_peers: int, n_slots: int, k: int,
@@ -547,6 +570,9 @@ def main() -> None:
         "keyed_service_ops_per_sec": (
             round(svc["keyed_ops_per_sec"], 1)
             if svc.get("keyed_ops_per_sec") else None),
+        "keyed_batched_ops_per_sec": (
+            round(svc["keyed_batched_ops_per_sec"], 1)
+            if svc.get("keyed_batched_ops_per_sec") else None),
         "latency_breakdown_p50_ms": svc.get("latency_breakdown"),
         **{k: round(v, 1) for k, v in svc.get("ladder", {}).items()},
         "platform": svc.get("platform", "unknown"),

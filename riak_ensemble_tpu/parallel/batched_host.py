@@ -122,6 +122,74 @@ class _PendingOp:
     want_vsn: bool = False
     #: enqueue timestamp (perf_counter) — queue-wait latency component
     t_enq: float = 0.0
+    #: rounds this entry occupies in the [K, E] op matrix
+    n: int = 1
+
+
+@dataclass(slots=True)
+class _PendingBatch:
+    """A struct-of-arrays batch of keyed ops for ONE ensemble sharing
+    one Future — the vectorized keyed path (kput_many/kget_many).
+
+    Arrays are COMPACT: keys with no slot never queue a device round —
+    their results are pre-filled into the accumulator at submit time —
+    and ``pos`` maps each compact row back to its position in the
+    caller's key order.  Packing into the flush's [K, E] planes is an
+    array slice (no per-op Python), and resolution is positional
+    assembly into the shared accumulator.  ``kind`` is uniform per
+    batch (all-put or all-get).
+    """
+
+    kind: int
+    slot: Any          # np.int32 [n] compact
+    handle: Any        # np.int32 [n] (puts; zeros for gets)
+    fut: Future
+    pos: Any = None    # np.int32 [n] position in the caller's order
+    keys: Any = None   # list of key objects (puts: for WAL/recycle)
+    gen: Any = None    # np.int32 [n] slot generations (puts)
+    accum: Any = None  # shared _BatchAccum across splits
+    want_vsn: bool = False
+    t_enq: float = 0.0
+    n: int = 0
+
+    def split(self, head_n: int) -> Tuple["_PendingBatch", "_PendingBatch"]:
+        """Split into (head, tail) when a flush's K cap lands inside
+        the batch; both halves share the Future and accumulator — it
+        resolves once the whole batch's results accumulated."""
+        def cut(x, a, b):
+            return None if x is None else x[a:b]
+        h = _PendingBatch(self.kind, self.slot[:head_n],
+                          self.handle[:head_n], self.fut,
+                          self.pos[:head_n], cut(self.keys, 0, head_n),
+                          cut(self.gen, 0, head_n), self.accum,
+                          self.want_vsn, self.t_enq, head_n)
+        t = _PendingBatch(self.kind, self.slot[head_n:],
+                          self.handle[head_n:], self.fut,
+                          self.pos[head_n:], cut(self.keys, head_n, None),
+                          cut(self.gen, head_n, None), self.accum,
+                          self.want_vsn, self.t_enq, self.n - head_n)
+        return h, t
+
+
+class _BatchAccum:
+    """Positional result assembly for a (possibly split) batch: each
+    chunk fills its rows by original position; the shared Future
+    resolves once every position is filled."""
+
+    __slots__ = ("remaining", "results")
+
+    def __init__(self, total: int) -> None:
+        self.remaining = total
+        self.results: List[Any] = [None] * total
+
+    def fill(self, fut: Future, positions: List[int],
+             chunk: List[Any], resolver) -> None:
+        res = self.results
+        for i, r in zip(positions, chunk):
+            res[i] = r
+        self.remaining -= len(chunk)
+        if self.remaining <= 0 and not fut.done:
+            resolver(fut, res)
 
 
 class BatchedEnsembleService:
@@ -196,7 +264,10 @@ class BatchedEnsembleService:
         self.values: Dict[int, Any] = {}
         self._free_handles: List[int] = []
         self._next_handle = 1
-        self.queues: List[List[_PendingOp]] = [[] for _ in range(n_ens)]
+        self.queues: List[List[Any]] = [[] for _ in range(n_ens)]
+        #: queued device ROUNDS per ensemble (a batch entry occupies
+        #: entry.n rounds) — drives flush depth and the burst trigger
+        self._queue_rounds: List[int] = [0] * n_ens
         #: leader leases, host-side: ensemble -> expiry (runtime.now)
         self.lease_until = np.zeros((n_ens,), dtype=float)
         self.flushes = 0
@@ -311,8 +382,9 @@ class BatchedEnsembleService:
             return False
         del self._row_name[row]
         for op in self.queues[row]:
-            self._fail_op(row, op)
+            self._fail_entry(row, op)
         self.queues[row] = []
+        self._queue_rounds[row] = 0
         mask = np.zeros((self.n_ens,), bool)
         mask[row] = True
         jnp = self._jnp
@@ -382,6 +454,89 @@ class BatchedEnsembleService:
         self.slot_gen[ens][slot] = gen
         self._push(ens, _PendingOp(eng.OP_PUT, slot, handle, fut,
                                    key, gen))
+        return fut
+
+    def kput_many(self, ens: int, keys: List[Any],
+                  values: List[Any]) -> Future:
+        """Vectorized keyed writes: N puts for one ensemble behind ONE
+        future, resolving to a list of per-key results (('ok', vsn) |
+        'failed') in key order.  The queue entry is struct-of-arrays —
+        flush packs it into the [K, E] planes as array slices and
+        resolves it with sliced result columns, so the per-op Python
+        cost of the scalar kput path (Future + op object + per-op
+        resolve) is amortized over the batch.  Duplicate keys
+        serialize in order (sequential device rounds); keys that can't
+        get a slot resolve 'failed' immediately and consume no device
+        round."""
+        fut = Future()
+        n = len(keys)
+        assert n == len(values)
+        if self._dead(ens) or n == 0:
+            fut.resolve(["failed"] * n)
+            return fut
+        accum = _BatchAccum(n)
+        slot = np.zeros((n,), np.int32)
+        handle = np.zeros((n,), np.int32)
+        gen = np.zeros((n,), np.int32)
+        pos = np.zeros((n,), np.int32)
+        live_keys: List[Any] = []
+        miss_pos: List[int] = []
+        m = 0
+        sg = self.slot_gen[ens]
+        for i, (key, value) in enumerate(zip(keys, values)):
+            s = self._slot_for(ens, key, allocate=True)
+            if s is None:
+                miss_pos.append(i)       # capacity-fail: no round
+                continue
+            h = self._alloc_handle()
+            self.values[h] = value
+            g = sg.get(s, 0) + 1
+            sg[s] = g
+            slot[m], handle[m], gen[m], pos[m] = s, h, g, i
+            live_keys.append(key)
+            m += 1
+        if miss_pos:
+            accum.fill(fut, miss_pos, ["failed"] * len(miss_pos),
+                       self._safe_resolve)
+        if m:
+            self._push(ens, _PendingBatch(
+                eng.OP_PUT, slot[:m], handle[:m], fut, pos[:m],
+                live_keys, gen[:m], accum, n=m))
+        return fut
+
+    def kget_many(self, ens: int, keys: List[Any],
+                  want_vsn: bool = False) -> Future:
+        """Vectorized keyed reads: one future resolving to a list of
+        (('ok', value|NOTFOUND) | 'failed') in key order (with
+        ``want_vsn`` each hit is ('ok', value, (epoch, seq)) — the
+        kget_vsn contract).  Unknown keys resolve ('ok', NOTFOUND)
+        immediately and consume no device round."""
+        fut = Future()
+        n = len(keys)
+        if self._dead(ens) or n == 0:
+            fut.resolve(["failed"] * n)
+            return fut
+        accum = _BatchAccum(n)
+        slot = np.zeros((n,), np.int32)
+        pos = np.zeros((n,), np.int32)
+        miss_pos: List[int] = []
+        m = 0
+        for i, key in enumerate(keys):
+            s = self._slot_for(ens, key, allocate=False)
+            if s is None:
+                miss_pos.append(i)
+            else:
+                slot[m], pos[m] = s, i
+                m += 1
+        if miss_pos:
+            nf = (("ok", NOTFOUND, (0, 0)) if want_vsn
+                  else ("ok", NOTFOUND))
+            accum.fill(fut, miss_pos, [nf] * len(miss_pos),
+                       self._safe_resolve)
+        if m:
+            self._push(ens, _PendingBatch(
+                eng.OP_GET, slot[:m], np.zeros((m,), np.int32), fut,
+                pos[:m], accum=accum, want_vsn=want_vsn, n=m))
         return fut
 
     def kget(self, ens: int, key: Any) -> Future:
@@ -963,7 +1118,12 @@ class BatchedEnsembleService:
             pend = self._recycle_pending[e]
             if not pend:
                 continue
-            busy = {op.slot for op in self.queues[e]}
+            busy = set()
+            for op in self.queues[e]:
+                if isinstance(op, _PendingBatch):
+                    busy.update(op.slot.tolist())
+                else:
+                    busy.add(op.slot)
             keep = []
             for key, slot, gen in pend:
                 if slot in busy:
@@ -977,11 +1137,12 @@ class BatchedEnsembleService:
                 # recycle request
             self._recycle_pending[e] = keep
 
-    def _push(self, ens: int, op: _PendingOp) -> None:
-        """Enqueue one pending op (timestamped for the queue-wait
+    def _push(self, ens: int, op) -> None:
+        """Enqueue one pending entry (timestamped for the queue-wait
         latency component) and arm the burst trigger."""
         op.t_enq = time.perf_counter()
         self.queues[ens].append(op)
+        self._queue_rounds[ens] += op.n
         self._maybe_kick(ens)
 
     def _maybe_kick(self, ens: int) -> None:
@@ -993,7 +1154,7 @@ class BatchedEnsembleService:
         flush points."""
         if self.tick is None or self._kick_pending:
             return
-        if len(self.queues[ens]) < self.max_k:
+        if self._queue_rounds[ens] < self.max_k:
             return
         self._kick_pending = True
 
@@ -1232,7 +1393,7 @@ class BatchedEnsembleService:
             "membership_changes_in_flight": int(
                 (self._desired_mask | self._pending_mask
                  | self._queued_mask).sum()),
-            "queued_ops": sum(len(q) for q in self.queues),
+            "queued_ops": sum(self._queue_rounds),
         }
 
     def execute(self, kind: np.ndarray, slot: np.ndarray,
@@ -1305,7 +1466,7 @@ class BatchedEnsembleService:
 
     def flush(self) -> int:
         """One device launch for everything queued; returns ops served."""
-        k = min(self.max_k, max((len(q) for q in self.queues), default=0))
+        k = min(self.max_k, max(self._queue_rounds, default=0))
         if k == 0 and not self._election_inputs()[0].any():
             return 0
         # Bucket the batch depth to the next power of two (capped at
@@ -1325,16 +1486,43 @@ class BatchedEnsembleService:
         val = np.zeros((k, self.n_ens), dtype=np.int32)
         exp_e = np.zeros((k, self.n_ens), dtype=np.int32)
         exp_s = np.zeros((k, self.n_ens), dtype=np.int32)
-        taken: List[List[_PendingOp]] = []
+        taken: List[List[Any]] = []
         for e in range(self.n_ens):
-            ops = self.queues[e][:k]
-            self.queues[e] = self.queues[e][k:]
+            q = self.queues[e]
+            ops: List[Any] = []
+            rounds = idx = 0
+            while idx < len(q) and rounds < k:
+                op = q[idx]
+                if rounds + op.n <= k:
+                    ops.append(op)
+                    rounds += op.n
+                    idx += 1
+                else:
+                    # K cap lands inside a batch: take the head rounds
+                    # now; the tail (same Future/accumulator) leads
+                    # the next flush.
+                    head, tail = op.split(k - rounds)
+                    ops.append(head)
+                    rounds = k
+                    q[idx] = tail
+                    break
+            self.queues[e] = q[idx:]
+            self._queue_rounds[e] -= rounds
             taken.append(ops)
-            for j, op in enumerate(ops):
-                kind[j, e] = op.kind
-                slot[j, e] = op.slot
-                val[j, e] = op.handle
-                exp_e[j, e], exp_s[j, e] = op.exp
+            j = 0
+            for op in ops:
+                if isinstance(op, _PendingBatch):
+                    n = op.n
+                    kind[j:j + n, e] = op.kind
+                    slot[j:j + n, e] = op.slot
+                    val[j:j + n, e] = op.handle
+                    j += n
+                else:
+                    kind[j, e] = op.kind
+                    slot[j, e] = op.slot
+                    val[j, e] = op.handle
+                    exp_e[j, e], exp_s[j, e] = op.exp
+                    j += 1
 
         try:
             planes = self._launch(kind, slot, val, k, want_vsn=True,
@@ -1352,7 +1540,7 @@ class BatchedEnsembleService:
             # fail ops that committed on device.
             for e, ops in enumerate(taken):
                 for op in ops:
-                    self._fail_op(e, op)
+                    self._fail_entry(e, op)
             raise
         # Durability barrier: committed writes reach the WAL (synced
         # per wal_sync) BEFORE any future resolves — the never-ack-
@@ -1408,7 +1596,23 @@ class BatchedEnsembleService:
         puts = (eng.OP_PUT, eng.OP_CAS)
         recs = []
         for e, ops in enumerate(taken):
-            for j, op in enumerate(ops):
+            j = -1
+            for op in ops:
+                if isinstance(op, _PendingBatch):
+                    if op.kind == eng.OP_PUT:
+                        comm = committed[j + 1:j + 1 + op.n, e]
+                        vs2 = vsn[j + 1:j + 1 + op.n, e]
+                        for i in np.nonzero(comm)[0]:
+                            h = int(op.handle[i])
+                            recs.append((
+                                ("kv", e, int(op.slot[i])),
+                                (op.keys[i], h, int(vs2[i, 0]),
+                                 int(vs2[i, 1]),
+                                 self.values.get(h) if h else None,
+                                 False)))
+                    j += op.n
+                    continue
+                j += 1
                 if op.kind in puts and committed_l[j][e]:
                     payload = (self.values.get(op.handle)
                                if op.handle else None)
@@ -1432,6 +1636,29 @@ class BatchedEnsembleService:
             self._emit("svc_waiter_error",  # propagate)
                        {"error": traceback.format_exc(limit=8)})
 
+    def _fail_entry(self, e: int, op) -> None:
+        """Fail one queue entry (scalar op or batch) — launch
+        failures and ensemble destruction."""
+        if isinstance(op, _PendingBatch):
+            self._fail_batch(e, op)
+        else:
+            self._fail_op(e, op)
+
+    def _fail_batch(self, e: int, op: _PendingBatch) -> None:
+        if op.fut.done:
+            return
+        if op.kind == eng.OP_PUT:
+            slot_l = op.slot.tolist()
+            handle_l = op.handle.tolist()
+            gen_l = op.gen.tolist()
+            for i in range(op.n):
+                self._release_handle(handle_l[i])
+                if op.keys is not None:
+                    self._recycle_pending[e].append(
+                        (op.keys[i], slot_l[i], gen_l[i]))
+        op.accum.fill(op.fut, op.pos.tolist(), ["failed"] * op.n,
+                      self._safe_resolve)
+
     def _fail_op(self, e: int, op: _PendingOp) -> None:
         """Resolve one queued op as failed, releasing a put's payload
         and queueing its slot for recycling (shared by the resolve
@@ -1448,6 +1675,54 @@ class BatchedEnsembleService:
             if op.key is not None:
                 self._recycle_pending[e].append((op.key, op.slot, op.gen))
         self._safe_resolve(op.fut, "failed")
+
+    def _resolve_batch(self, e: int, j: int, op: _PendingBatch,
+                       planes, ack: bool) -> None:
+        """Resolve one batch entry from result-plane column slices —
+        the vectorized counterpart of the per-op resolve loop."""
+        committed, get_ok, found, value, vsn = planes
+        n = op.n
+        results: List[Any] = []
+        if op.kind == eng.OP_PUT:
+            comm_l = committed[j:j + n, e].tolist()
+            vs_l = vsn[j:j + n, e].tolist()
+            slot_l = op.slot.tolist()
+            handle_l = op.handle.tolist()
+            gen_l = op.gen.tolist()
+            slot_handle = self.slot_handle[e]
+            for i in range(n):
+                if not comm_l[i]:
+                    self._release_handle(handle_l[i])
+                    if op.keys is not None:
+                        self._recycle_pending[e].append(
+                            (op.keys[i], slot_l[i], gen_l[i]))
+                    results.append("failed")
+                    continue
+                s, h = slot_l[i], handle_l[i]
+                old = slot_handle.pop(s, 0)
+                if old != h:
+                    self._release_handle(old)
+                if h:
+                    slot_handle[s] = h
+                results.append(("ok", tuple(vs_l[i])) if ack
+                               else "failed")
+        else:  # OP_GET batch
+            ok_l = get_ok[j:j + n, e].tolist()
+            found_l = found[j:j + n, e].tolist()
+            val_l = value[j:j + n, e].tolist()
+            vs_l = vsn[j:j + n, e].tolist() if op.want_vsn else None
+            values = self.values
+            for i in range(n):
+                if ok_l[i]:
+                    v = val_l[i]
+                    out = (values.get(v, NOTFOUND)
+                           if found_l[i] and v != 0 else NOTFOUND)
+                    results.append(("ok", out, tuple(vs_l[i]))
+                                   if op.want_vsn else ("ok", out))
+                else:
+                    results.append("failed")
+        op.accum.fill(op.fut, op.pos.tolist(), results,
+                      self._safe_resolve)
 
     def _resolve_flush(self, taken, planes, ack: bool = True) -> int:
         """Resolve every taken op from the result planes.  With
@@ -1475,9 +1750,16 @@ class BatchedEnsembleService:
             ops = taken[e]
             if not ops:
                 continue
-            served += len(ops)
             slot_handle = self.slot_handle[e]
-            for j, op in enumerate(ops):
+            j = -1
+            for op in ops:
+                if isinstance(op, _PendingBatch):
+                    self._resolve_batch(e, j + 1, op, planes, ack)
+                    served += op.n
+                    j += op.n
+                    continue
+                j += 1
+                served += 1
                 if op.kind in puts:
                     if committed_l[j][e]:
                         # Release the payload this write superseded

@@ -1,0 +1,190 @@
+"""Multi-process SPMD: the 'ens' axis spanning OS processes.
+
+The reference's core premise is peers on machines with independent
+failure domains over disterl (``riak_ensemble_msg.erl:132-142``).  The
+TPU-native analog (ARCHITECTURE §7): ``jax.distributed`` + a global
+mesh whose 'ens' dim spans processes/hosts; every process runs the
+SAME engine launch sequence (single-program, multiple-data), ensembles
+never need cross-process collectives, and each host's service shard
+owns its local ensembles.
+
+This test runs that story for real: two OS processes × 4 virtual CPU
+devices each form one 8-device global mesh; both execute the full
+protocol sequence (elections → K/V → failover → joint-consensus
+reconfig → reads) through ``ShardedEngine`` over the global mesh, and
+every process checks its ADDRESSABLE shards bit-for-bit against an
+unsharded single-process oracle of the same scenario.  A second phase
+runs one independent ``BatchedEnsembleService`` per process over its
+ensemble shard — the documented multi-host service deployment shape.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+pid = int(sys.argv[1]); coord = sys.argv[2]
+try:
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=2, process_id=pid)
+except Exception as exc:
+    print("SKIP:", exc); raise SystemExit(0)
+
+assert jax.device_count() == 8, jax.device_count()
+assert jax.local_device_count() == 4
+
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from riak_ensemble_tpu.ops import engine as eng
+from riak_ensemble_tpu.parallel import distributed
+from riak_ensemble_tpu.parallel.mesh import ShardedEngine
+
+mesh = distributed.global_mesh(n_peer=1)
+assert dict(mesh.shape) == {{"ens": 8, "peer": 1}}, mesh.shape
+se = ShardedEngine(mesh)
+
+E, M, S, K = 16, 3, 8, 4
+
+def put(x, spec):
+    return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
+
+# Deterministic scenario inputs (identical in every process).
+rng = np.random.default_rng(7)
+kind = rng.choice([eng.OP_PUT, eng.OP_GET], (K, E)).astype(np.int32)
+slot = rng.integers(0, S, (K, E)).astype(np.int32)
+val = rng.integers(1, 1 << 20, (K, E)).astype(np.int32)
+lease = np.zeros((K, E), bool)
+up0 = np.ones((E, M), bool)
+up1 = up0.copy(); up1[:, 0] = False        # peer 0 dies everywhere
+elect = np.ones((E,), bool)
+cand0 = np.zeros((E,), np.int32)
+cand1 = np.ones((E,), np.int32)            # failover to peer 1
+shrink = np.ones((E, M), bool); shrink[:, 0] = False
+noprop = np.zeros((E,), bool)
+
+def scenario(engine, state, place):
+    out = {{}}
+    state, won = engine.elect_step(state, place(elect, P("ens")),
+                                   place(cand0, P("ens")),
+                                   place(up0, P("ens", "peer")))
+    out["won0"] = won
+    state, res = engine.kv_step_scan(
+        state, place(kind, P(None, "ens")), place(slot, P(None, "ens")),
+        place(val, P(None, "ens")), place(lease, P(None, "ens")),
+        place(up0, P("ens", "peer")))
+    out["committed"] = res.committed
+    state, won = engine.elect_step(state, place(elect, P("ens")),
+                                   place(cand1, P("ens")),
+                                   place(up1, P("ens", "peer")))
+    out["won1"] = won
+    state, inst, _ = engine.reconfig_step(
+        state, place(elect, P("ens")), place(shrink, P("ens", "peer")),
+        place(up1, P("ens", "peer")))
+    state, _, coll = engine.reconfig_step(
+        state, place(noprop, P("ens")), place(shrink, P("ens", "peer")),
+        place(up1, P("ens", "peer")))
+    out["installed"], out["collapsed"] = inst, coll
+    gk = np.full((K, E), eng.OP_GET, np.int32)
+    state, res = engine.kv_step_scan(
+        state, place(gk, P(None, "ens")), place(slot, P(None, "ens")),
+        place(np.zeros((K, E), np.int32), P(None, "ens")),
+        place(lease, P(None, "ens")), place(up1, P("ens", "peer")))
+    out["get_ok"], out["value"] = res.get_ok, res.value
+    out["epoch"], out["obj_val"] = state.epoch, state.obj_val
+    return out
+
+# Sharded run over the cross-process mesh.
+sharded = scenario(se, se.init_state(E, M, S), put)
+
+# Unsharded oracle, local devices only.
+class _Local:
+    elect_step = staticmethod(eng.elect_step)
+    kv_step_scan = staticmethod(eng.kv_step_scan)
+    reconfig_step = staticmethod(eng.reconfig_step)
+oracle = scenario(_Local, eng.init_state(E, M, S),
+                  lambda x, spec: jnp.asarray(x))
+
+# Every ADDRESSABLE shard must equal the oracle slice: the SPMD run
+# across processes computes exactly the single-process semantics.
+checked = 0
+for name in sharded:
+    want = np.asarray(oracle[name])
+    for sh in sharded[name].addressable_shards:
+        np.testing.assert_array_equal(
+            np.asarray(sh.data), want[sh.index], err_msg=name)
+        checked += 1
+assert checked > 0
+print(f"ENGINE-EQUIV ok ({{checked}} shards checked)")
+
+# Phase 2: the service deployment shape — one independent service per
+# process over its ensemble shard (ensembles are independent; client
+# traffic routes by ensemble id; no cross-host coordination outside
+# the kernels).
+from riak_ensemble_tpu.config import fast_test_config
+from riak_ensemble_tpu.parallel.batched_host import BatchedEnsembleService
+from riak_ensemble_tpu.runtime import Runtime
+
+rt = Runtime(seed=pid)
+svc = BatchedEnsembleService(rt, 8, 3, 8, tick=0.005,
+                             config=fast_test_config())
+futs = [svc.kput(e, "k", b"p%d-e%d" % (pid, e)) for e in range(8)]
+for e, f in enumerate(futs):
+    assert rt.await_future(f, 10.0)[0] == "ok", (e, f.value)
+for e in range(8):
+    assert rt.await_future(svc.kget(e, "k"), 10.0) == \
+        ("ok", b"p%d-e%d" % (pid, e))
+svc.stop()
+print("SERVICE-SHARD ok")
+print("MPOK")
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_two_process_spmd_engine_equivalence(tmp_path):
+    script = tmp_path / "child.py"
+    script.write_text(CHILD.format(repo=REPO))
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), coord],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        if "SKIP:" in out:
+            pytest.skip(f"jax.distributed unavailable: {out[-300:]}")
+        assert p.returncode == 0, f"proc {i}:\n{out[-3000:]}"
+        assert "MPOK" in out, f"proc {i}:\n{out[-3000:]}"
+        assert "ENGINE-EQUIV ok" in out
+        assert "SERVICE-SHARD ok" in out

@@ -29,6 +29,8 @@ from riak_ensemble_tpu.parallel.wal import (  # noqa: E402
 from riak_ensemble_tpu.runtime import Runtime  # noqa: E402
 from riak_ensemble_tpu.types import NOTFOUND  # noqa: E402
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def make_durable(tmp_path, n_ens=4, n_peers=3, n_slots=4, **kw):
     runtime = Runtime(seed=11)
@@ -392,3 +394,97 @@ def test_recycled_row_inherits_no_pipeline_or_down_marks(tmp_path):
     assert (svc.member_np[e2] == np.ones(3, bool)).all(), \
         "dead tenant's membership change applied to the new tenant"
     svc.stop()
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_crash_point_fuzz_no_acked_write_lost(tmp_path, seed):
+    """Randomized crash-point fuzz: a child process runs a random
+    keyed workload (puts/deletes/batch puts, interleaved across
+    ensembles), appends every ACKED op to its own fsync'd side log
+    the instant the future resolves, and os._exit()s at a random op
+    count.  The parent restores from the data dir and asserts the
+    final acked state of every key is exactly what the restored
+    service serves — the sc.erl 'Data loss!' check (test/sc.erl:
+    835-880) applied to crash recovery."""
+    data = str(tmp_path / "data")
+    acklog = str(tmp_path / "acks")
+    child = textwrap.dedent(f"""
+        import os, pickle, sys
+        sys.path.insert(0, {REPO!r})
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        from riak_ensemble_tpu.config import fast_test_config
+        from riak_ensemble_tpu.parallel.batched_host import (
+            BatchedEnsembleService)
+        from riak_ensemble_tpu.runtime import Runtime
+
+        rng = np.random.default_rng({seed})
+        rt = Runtime(seed={seed})
+        svc = BatchedEnsembleService(rt, 3, 3, 8, tick=0.005,
+                                     config=fast_test_config(),
+                                     data_dir={data!r})
+        ack_f = open({acklog!r}, "ab")
+        def record(op, e, key, val):
+            ack_f.write(pickle.dumps((op, e, key, val)))
+            ack_f.flush(); os.fsync(ack_f.fileno())
+
+        stop_at = int(rng.integers(5, 40))
+        done = 0
+        while done < stop_at:
+            e = int(rng.integers(3))
+            r = rng.random()
+            if r < 0.5:
+                key = f"k{{int(rng.integers(5))}}"
+                val = b"v%d" % int(rng.integers(1000))
+                if rt.await_future(svc.kput(e, key, val),
+                                   10.0)[0] == "ok":
+                    record("put", e, key, val)
+            elif r < 0.7:
+                keys = [f"b{{i}}" for i in range(3)]
+                vals = [b"w%d" % int(rng.integers(1000))
+                        for _ in range(3)]
+                res = rt.await_future(
+                    svc.kput_many(e, keys, vals), 10.0)
+                for kk, vv, rr in zip(keys, vals, res):
+                    if rr[0] == "ok":
+                        record("put", e, kk, vv)
+            else:
+                key = f"k{{int(rng.integers(5))}}"
+                rr = rt.await_future(svc.kdelete(e, key), 10.0)
+                if isinstance(rr, tuple) and rr[0] == "ok":
+                    record("del", e, key, None)
+            done += 1
+        print("CRASHED_AT", done, flush=True)
+        os._exit(1)
+    """)
+    proc = subprocess.run([sys.executable, "-c", child],
+                          capture_output=True, text=True, timeout=300)
+    assert "CRASHED_AT" in proc.stdout, proc.stderr[-2000:]
+
+    # final acked value per (ens, key), in ack order
+    import pickle
+    expect = {}
+    with open(acklog, "rb") as f:
+        while True:
+            try:
+                op, e, key, val = pickle.load(f)
+            except EOFError:
+                break
+            if op == "put":
+                expect[(e, key)] = val
+            else:
+                expect[(e, key)] = None
+
+    rt2 = Runtime(seed=seed + 100)
+    svc2 = BatchedEnsembleService.restore(
+        rt2, data, tick=0.005, config=fast_test_config(),
+        data_dir=data)
+    for (e, key), val in expect.items():
+        got = settle(rt2, svc2.kget(e, key))
+        assert got[0] == "ok", (e, key, got)
+        want = NOTFOUND if val is None else val
+        assert got[1] == want, \
+            f"acked write lost/stale at {(e, key)}: {got[1]!r} != {want!r}"
+    svc2.stop()
