@@ -1,0 +1,87 @@
+"""The scale plane in the cluster directory (one cluster story).
+
+Registration of a batched service flows through the root ensemble's
+consensus (create_ensemble, manager.erl:157-166) and gossip
+replicates it; any node resolves the service address from its local
+directory; reconciliation starts NO actor peers for directory-only
+entries; and the resolved address really dials a live svcnode.
+"""
+
+import asyncio
+
+import numpy as np  # noqa: F401
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from riak_ensemble_tpu import service_directory as sd  # noqa: E402
+from riak_ensemble_tpu import svcnode  # noqa: E402
+from riak_ensemble_tpu.config import fast_test_config  # noqa: E402
+from riak_ensemble_tpu.testing import ManagedCluster  # noqa: E402
+from riak_ensemble_tpu.types import PeerId  # noqa: E402
+
+
+def test_registration_propagates_and_starts_no_peers():
+    mc = ManagedCluster(seed=9, nodes=("node0", "node1", "node2"))
+    mc.enable("node0")
+    mc.join("node1", "node0")
+    mc.join("node2", "node0")
+
+    r = sd.register_service(mc.mgr("node0"), mc.runtime, "kvplane",
+                            "10.0.0.7", 7601, (10_000, 5, 128))
+    assert r == "ok", r
+
+    # every node resolves once the root push/gossip lands
+    ok = mc.runtime.run_until(
+        lambda: all(sd.resolve_service(mc.mgr(n), "kvplane")
+                    is not None
+                    for n in ("node0", "node1", "node2")), 60.0)
+    assert ok, "service registration never gossiped"
+    assert sd.resolve_service(mc.mgr("node0"), "kvplane") == {
+        "host": "10.0.0.7", "port": 7601, "shape": (10_000, 5, 128)}
+    assert sd.list_services(mc.mgr("node2")) == {
+        "kvplane": {"host": "10.0.0.7", "port": 7601,
+                    "shape": (10_000, 5, 128)}}
+
+    # directory-only: reconciliation must start no actor peers for it
+    mc.runtime.run_for(5.0)
+    for n in ("node0", "node1", "node2"):
+        assert not any(ens == sd.service_id("kvplane")
+                       for ens, _pid in mc.mgr(n).local_peers), \
+            "actor peers started for a directory-only ensemble"
+
+    # unknown names resolve None; actor-plane ensembles don't alias
+    assert sd.resolve_service(mc.mgr("node0"), "nope") is None
+    peers = [PeerId(i, f"node{i}") for i in range(3)]
+    mc.create_ensemble("actor-ens", peers)
+    assert sd.resolve_service(mc.mgr("node0"), "actor-ens") is None
+
+
+def test_resolved_address_dials_a_live_svcnode():
+    """End to end across the planes: register the REAL address of a
+    live svcnode in the simulated cluster's directory, resolve it on
+    another node, dial it, and run K/V traffic."""
+
+    async def scenario():
+        server = await svcnode.serve(4, 3, 8, port=0,
+                                     config=fast_test_config())
+        # cluster (virtual time) registers the real TCP endpoint
+        mc = ManagedCluster(seed=10, nodes=("node0", "node1"))
+        mc.enable("node0")
+        mc.join("node1", "node0")
+        assert sd.register_service(mc.mgr("node0"), mc.runtime, "plane",
+                                   server.host, server.port,
+                                   (4, 3, 8)) == "ok"
+        assert mc.runtime.run_until(
+            lambda: sd.resolve_service(mc.mgr("node1"), "plane")
+            is not None, 60.0)
+        addr = sd.resolve_service(mc.mgr("node1"), "plane")
+
+        c = svcnode.ServiceClient(addr["host"], addr["port"])
+        await c.connect()
+        assert (await c.kput(0, "k", b"v"))[0] == "ok"
+        assert await c.kget(0, "k") == ("ok", b"v")
+        await c.close()
+        await server.stop()
+
+    asyncio.run(scenario())
