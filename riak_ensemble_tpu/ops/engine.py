@@ -234,24 +234,39 @@ def _write_path(tree_leaf: jax.Array, tree_node: jax.Array,
     synchronous write-path hash update (``update_hash`` +
     ``update_path``, peer.erl:1731-1738, synctree.erl:201-209).
     Non-writing replicas' nodes are untouched (a recompute would
-    silently alter a corrupted-but-unwritten tree)."""
+    silently alter a corrupted-but-unwritten tree).
+
+    HBM discipline: updates are SCATTERS at the touched (slot, path)
+    positions, not full-plane ``where`` rewrites — per round only
+    O(E·M·height·LANES) elements move, not the whole
+    ``[E, M, S(+U), LANES]`` tree (inside the kv scan the carried
+    buffers alias, so the scatter lowers to an in-place update).
+    Masked-off replicas scatter their CURRENT value back (a no-op
+    write) rather than being excluded — the indices stay dense.
+    """
+    e, ml = mask.shape
     s = tree_leaf.shape[-2]
     offs, _ = _tree_offsets(s)
     sizes = tree_sizes(s)
-    sel = (jnp.arange(s, dtype=jnp.int32)[None, :] == slot[:, None])
-    upd = mask[:, :, None, None] & sel[:, None, :, None]
-    tree_leaf = jnp.where(upd, new_leaf[:, None, None, :], tree_leaf)
+    eidx = jnp.arange(e, dtype=jnp.int32)[:, None]           # [E, 1]
+    midx = jnp.arange(ml, dtype=jnp.int32)[None, :]          # [1, Ml]
+    cur_leaf = jnp.take_along_axis(
+        tree_leaf, slot[:, None, None, None], axis=2)[..., 0, :]
+    leaf_vals = jnp.where(mask[:, :, None],
+                          new_leaf[:, None, :], cur_leaf)    # [E, Ml, L]
+    tree_leaf = tree_leaf.at[eidx, midx, slot[:, None]].set(leaf_vals)
     child_arr, child_n, idx = tree_leaf, s, slot
     node = tree_node
     for off, n in zip(offs, sizes):
         pidx = idx // TREE_WIDTH
         parent = hashk.fold(_gather_children(child_arr, pidx, child_n))
-        psel = (jnp.arange(n, dtype=jnp.int32)[None, :] == pidx[:, None])
-        pupd = mask[:, :, None, None] & psel[:, None, :, None]
-        level = jax.lax.slice_in_dim(node, off, off + n, axis=2)
-        level = jnp.where(pupd, parent[:, :, None, :], level)
-        node = jax.lax.dynamic_update_slice_in_dim(node, level, off, axis=2)
-        child_arr, child_n, idx = level, n, pidx
+        stored = jnp.take_along_axis(
+            node, (off + pidx)[:, None, None, None], axis=2)[..., 0, :]
+        vals = jnp.where(mask[:, :, None], parent, stored)
+        node = node.at[eidx, midx, (off + pidx)[:, None]].set(vals)
+        child_arr, child_n = (
+            jax.lax.slice_in_dim(node, off, off + n, axis=2), n)
+        idx = pidx
     return tree_leaf, node
 
 
@@ -482,14 +497,18 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
     slot_valid = (slot >= 0) & (slot < s)
     slot_c = jnp.clip(slot, 0, s - 1)
 
-    # Per-replica object at the slot (one-hot row is zero for invalid
-    # slots, reading the absent object).
-    slot_oh = ((jnp.arange(s, dtype=jnp.int32)[None, :] == slot_c[:, None])
-               & slot_valid[:, None]).astype(jnp.int32)
-    sel = slot_oh[:, None, :]                                # [E, 1, S]
-    pe = (state.obj_epoch * sel).sum(-1)                     # [E, Ml]
-    ps = (state.obj_seq * sel).sum(-1)
-    pv = (state.obj_val * sel).sum(-1)
+    # Per-replica object at the slot: ONE gather per plane (invalid
+    # slots read the absent object — raw values kept for the
+    # write-back scatter, which must not damage the clipped slot).
+    def at_slot(plane):
+        return jnp.take_along_axis(
+            plane, slot_c[:, None, None], axis=2)[..., 0]    # [E, Ml]
+    pe_raw, ps_raw, pv_raw = (at_slot(state.obj_epoch),
+                              at_slot(state.obj_seq),
+                              at_slot(state.obj_val))
+    pe = jnp.where(slot_valid[:, None], pe_raw, 0)
+    ps = jnp.where(slot_valid[:, None], ps_raw, 0)
+    pv = jnp.where(slot_valid[:, None], pv_raw, 0)
 
     # Integrity gate (tree-is-truth, synctree.erl:44-73): the object
     # must match its leaf, and the slot's root-ward path must verify.
@@ -577,12 +596,28 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
     w_epoch = jnp.where(commit, lead_epoch, rd_epoch)        # [E]
     w_seq = jnp.where(commit, new_seq, rd_seq)
     w_val = jnp.where(commit, wval, rd_val)
+    # do_write is always False for invalid slots (commit/repair both
+    # require slot_valid through their gates), so the scatter at the
+    # CLIPPED slot writes the raw current value back — a no-op.
     do_write = (commit[:, None] & heard) | repair            # [E, Ml]
 
-    wmask = (do_write[:, :, None] & (slot_oh[:, None, :] > 0))
-    obj_epoch = jnp.where(wmask, w_epoch[:, None, None], state.obj_epoch)
-    obj_seq = jnp.where(wmask, w_seq[:, None, None], state.obj_seq)
-    obj_val = jnp.where(wmask, w_val[:, None, None], state.obj_val)
+    # Scatter, not full-plane where: per round only the touched slot
+    # column moves through HBM (in place inside the kv scan's carry).
+    eidx = jnp.arange(state.obj_epoch.shape[0],
+                      dtype=jnp.int32)[:, None]
+    midx = jnp.arange(state.obj_epoch.shape[1],
+                      dtype=jnp.int32)[None, :]
+    sl2 = slot_c[:, None]
+
+    def set_slot(plane, new, raw):
+        """at_slot's scatter twin: write `new` on do_write replicas,
+        the gathered current value back otherwise (no-op)."""
+        return plane.at[eidx, midx, sl2].set(
+            jnp.where(do_write, new[:, None], raw))
+
+    obj_epoch = set_slot(state.obj_epoch, w_epoch, pe_raw)
+    obj_seq = set_slot(state.obj_seq, w_seq, ps_raw)
+    obj_val = set_slot(state.obj_val, w_val, pv_raw)
     obj_seq_ctr = jnp.where(commit, new_seq, state.obj_seq_ctr)
 
     # Synchronous tree maintenance: leaf + root-ward path, same round.
