@@ -683,8 +683,23 @@ def kv_step(state: EngineState, kind: jax.Array, slot: jax.Array,
       :1568-1584) — all batched across ensembles.
     """
     ctx = _kv_context(state, up, axis_name)
-    return _kv_round(state, ctx, kind, slot, val, lease_ok, axis_name,
-                     exp_epoch, exp_seq)
+    state, res = _kv_round(state, ctx, kind, slot, val, lease_ok,
+                           axis_name, exp_epoch, exp_seq)
+    return _adopt_epochs(state, ctx), res
+
+
+def _adopt_epochs(state: EngineState, ctx: _KvCtx) -> EngineState:
+    """Follower epoch catch-up — the ``following({commit, Fact})``
+    adoption (peer.erl:794-836): a heard member whose ballot epoch
+    trails a live leader's adopts it at the END of the launch (it was
+    a nack for THIS launch's quorums, exactly like a stale follower
+    nacking until the commit round reaches it, and acks from the
+    next).  Without this a peer returning from downtime would stay a
+    permanent nack until the next election."""
+    heal = (ctx.heard & ctx.leader_up[:, None]
+            & (state.epoch < ctx.lead_epoch[:, None]))
+    return state._replace(
+        epoch=jnp.where(heal, ctx.lead_epoch[:, None], state.epoch))
 
 
 @functools.partial(jax.jit, static_argnames=("axis_name",))
@@ -718,8 +733,9 @@ def kv_step_scan(state: EngineState, kind: jax.Array, slot: jax.Array,
         st2, r = _kv_round(st, ctx, k, sl, v, lz, axis_name, xe, xs)
         return st2, r
 
-    return jax.lax.scan(body, state,
-                        (kind, slot, val, lease_ok, exp_epoch, exp_seq))
+    state, res = jax.lax.scan(
+        body, state, (kind, slot, val, lease_ok, exp_epoch, exp_seq))
+    return _adopt_epochs(state, ctx), res
 
 
 # ---------------------------------------------------------------------------

@@ -295,6 +295,8 @@ class BatchedEnsembleService:
             self.state = self.engine.reset_rows(
                 self.state, jnp.ones((n_ens,), bool),
                 jnp.zeros((n_ens, n_peers), bool))
+        #: leader-status watchers per ensemble (watch_leader)
+        self._leader_watchers: Dict[int, List[Any]] = {}
         self._timer: Optional[Timer] = None
         self._kick_pending = False  # burst flush queued (see _maybe_kick)
         self._jnp = jnp
@@ -424,6 +426,9 @@ class BatchedEnsembleService:
         self.slot_gen[row] = {}
         self.slot_handle[row] = {}
         self._recycle_pending[row] = []
+        # a recycled row starts with no watchers (the reference cleans
+        # up watchers with their watched peer)
+        self._leader_watchers.pop(row, None)
         self._desired_mask[row] = False
         self._queued_mask[row] = False
         self._pending_mask[row] = False
@@ -646,6 +651,48 @@ class BatchedEnsembleService:
                 self._recycle_pending[ens].append((key, slot, gen))
         fut.add_waiter(recycle)
 
+    def watch_leader(self, ens: int, fn) -> None:
+        """Leader-status watcher for one ensemble — the scale-path
+        ``watch_leader_status`` (peer.erl:212-218, 2070-2075):
+        ``fn(ens, old_leader, new_leader)`` fires immediately with the
+        CURRENT status at registration (old == new, the reference's
+        initial notify) and then after any flush or membership change
+        that moved the leader (-1 = none).  Watcher exceptions are
+        contained and traced like client waiters; remove with
+        :meth:`unwatch_leader` (the stop_watching counterpart)."""
+        self._leader_watchers.setdefault(ens, []).append(fn)
+        cur = int(self.leader_np[ens])
+        try:
+            fn(ens, cur, cur)
+        except Exception:
+            import traceback
+            self._emit("svc_watcher_error",
+                       {"error": traceback.format_exc(limit=8)})
+
+    def unwatch_leader(self, ens: int, fn) -> bool:
+        """Deregister a leader watcher (stop_watching,
+        peer.erl:220-226); True iff it was registered."""
+        fns = self._leader_watchers.get(ens)
+        if fns is None or fn not in fns:
+            return False
+        fns.remove(fn)
+        if not fns:
+            del self._leader_watchers[ens]
+        return True
+
+    def _notify_leader_changes(self, old: np.ndarray) -> None:
+        if not self._leader_watchers:
+            return
+        changed = np.nonzero(old != self.leader_np)[0]
+        for e in changed.tolist():
+            for fn in self._leader_watchers.get(e, ()):
+                try:
+                    fn(e, int(old[e]), int(self.leader_np[e]))
+                except Exception:
+                    import traceback
+                    self._emit("svc_watcher_error",
+                               {"error": traceback.format_exc(limit=8)})
+
     def set_peer_up(self, ens: int, peer: int, up: bool) -> None:
         """Failure-detector input (the host's nodedown/suspend signal)."""
         self.up[ens, peer] = up
@@ -772,6 +819,7 @@ class BatchedEnsembleService:
         dropped = changed & has & ~still_ok
         self.leader_np = np.where(dropped, -1, leader)
         self.lease_until[dropped] = 0.0
+        self._notify_leader_changes(leader)
         # Durability: committed membership rows persist before the
         # caller observes `changed` (the fact-save-on-meaningful-change
         # discipline, peer.erl:2201-2228).
@@ -1234,6 +1282,11 @@ class BatchedEnsembleService:
             self.leader_np = leader_snapshot
             self.lease_until = lease_snapshot
             raise
+        # Leader changes (won elections) notify watchers only on a
+        # SUCCESSFUL launch — the except path above rolled the mirror
+        # back, and a watcher told of a rolled-back leader would act
+        # on state the device never kept.
+        self._notify_leader_changes(leader_snapshot)
         # Launch-side latency record; flush() augments the same dict
         # with queue_wait/wal/resolve (bulk execute() callers get the
         # launch components alone).

@@ -758,3 +758,50 @@ def test_burst_flush_does_not_wait_for_tick():
     f = svc.kput(1, "x", b"v")
     runtime.run_for(0.01)
     assert not f.done
+
+
+def test_service_leader_watchers():
+    """watch_leader (the scale-path watch_leader_status,
+    peer.erl:212-218): fires on election-driven changes and
+    membership-driven depositions; watcher exceptions are contained."""
+    runtime, svc = make_service(n_ens=2, n_peers=3)
+    events = []
+    svc.watch_leader(0, lambda e, old, new: events.append((e, old, new)))
+    svc.watch_leader(0, lambda e, old, new: 1 / 0)  # hostile watcher
+    # registration notifies the CURRENT status immediately
+    assert events == [(0, -1, -1)]
+
+    assert settle(runtime, svc.kput(0, "k", b"v"))[0] == "ok"
+    assert events[1] == (0, -1, int(svc.leader_np[0]))
+
+    # leader dies -> next flush elects a new one -> watcher fires
+    old_leader = int(svc.leader_np[0])
+    svc.set_peer_up(0, old_leader, False)
+    assert settle(runtime, svc.kget(0, "k")) == ("ok", b"v")
+    assert events[-1][1] == old_leader
+    assert events[-1][2] == int(svc.leader_np[0]) != old_leader
+
+    # membership change that drops the leader deposes it (-1) before
+    # the re-election flush.  The returned peer needs one commit round
+    # to adopt the current epoch (the following({commit, Fact})
+    # catch-up) before it counts toward the collapse quorum.
+    svc.set_peer_up(0, old_leader, True)
+    assert settle(runtime, svc.kput(0, "k", b"v2"))[0] == "ok"
+    n = len(events)
+    nv = np.ones((2, 3), bool)
+    nv[0, int(svc.leader_np[0])] = False
+    sel = np.zeros(2, bool)
+    sel[0] = True
+    assert svc.update_members(sel, nv)[0]
+    assert any(ev[2] == -1 for ev in events[n:])
+    # other-ensemble watchers never fired (no watcher on ens 1)
+    assert all(ev[0] == 0 for ev in events)
+
+    # unwatch: no further events after deregistration
+    fn = svc._leader_watchers[0][0]
+    assert svc.unwatch_leader(0, fn)
+    assert not svc.unwatch_leader(0, fn)   # idempotent: already gone
+    n2 = len(events)
+    assert settle(runtime, svc.kget(0, "k"))[0] == "ok"  # re-elects
+    assert len(events) == n2
+    svc.stop()

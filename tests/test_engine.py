@@ -554,3 +554,41 @@ class TestCasIntegrity:
         st, r = self._one(st, up, e, eng.OP_CAS, 3, 1, exp=(0, 0))
         assert not r.committed.any(), \
             "CAS overwrote data the integrity gate had excluded"
+
+
+def test_returned_peer_adopts_epoch_and_rejoins_quorum():
+    """following({commit, Fact}) catch-up (peer.erl:794-836): a peer
+    whose ballot epoch trails the leader's nacks the launch it
+    returns in, adopts the epoch at its end, and counts toward
+    quorums from the next launch — without requiring an election."""
+    e, m, s = 4, 3, 4
+    state = eng.init_state(e, m, s)
+    up = jnp.ones((e, m), bool)
+    state, won = eng.elect_step(state, jnp.ones((e,), bool),
+                                jnp.zeros((e,), jnp.int32), up)
+    assert bool(np.asarray(won).all())
+
+    # peer 2 "was down": regress its epoch to 0 everywhere
+    state = state._replace(
+        epoch=state.epoch.at[:, 2].set(0))
+
+    # with peers 0+1 only a 2/3 quorum holds; a put commits, and the
+    # launch's tail heals peer 2's epoch
+    kind = jnp.full((e,), eng.OP_PUT, jnp.int32)
+    state, res = eng.kv_step(state, kind, jnp.zeros((e,), jnp.int32),
+                             jnp.full((e,), 7, jnp.int32),
+                             jnp.zeros((e,), bool), up)
+    assert bool(np.asarray(res.committed).all())
+    lead_epoch = np.asarray(state.epoch)[:, 0]
+    np.testing.assert_array_equal(np.asarray(state.epoch)[:, 2],
+                                  lead_epoch)
+
+    # now a quorum needing peer 2 succeeds: drop peer 1 — 0+2 form
+    # the majority only if 2's epoch matches
+    up2 = np.ones((e, m), bool)
+    up2[:, 1] = False
+    state, res = eng.kv_step(state, kind, jnp.zeros((e,), jnp.int32),
+                             jnp.full((e,), 8, jnp.int32),
+                             jnp.zeros((e,), bool), jnp.asarray(up2))
+    assert bool(np.asarray(res.committed).all()), \
+        "healed peer did not count toward the quorum"

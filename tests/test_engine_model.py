@@ -171,9 +171,22 @@ class ScalarModel:
         ctx = self._context(up)
         if exps is None:
             exps = [(0, 0)] * len(kinds)
-        return [self.kv(k, sl, v, lz, up, ctx, xp)
-                for k, sl, v, lz, xp in zip(kinds, slots, vals, leases,
-                                            exps)]
+        out = [self.kv(k, sl, v, lz, up, ctx, xp)
+               for k, sl, v, lz, xp in zip(kinds, slots, vals, leases,
+                                           exps)]
+        self.adopt_epochs(ctx)
+        return out
+
+    def adopt_epochs(self, ctx):
+        """following({commit, Fact}) catch-up at the END of a launch:
+        heard members trailing a live leader's epoch adopt it (they
+        nacked THIS launch, ack from the next)."""
+        heard, leader_up, lead_epoch, _ = ctx
+        if not leader_up:
+            return
+        for p in range(self.m):
+            if heard[p] and self.epoch[p] < lead_epoch:
+                self.epoch[p] = lead_epoch
 
 
 def _random_views(rng, m):
