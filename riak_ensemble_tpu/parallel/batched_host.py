@@ -475,7 +475,12 @@ class BatchedEnsembleService:
         round."""
         fut = Future()
         n = len(keys)
-        assert n == len(values)
+        if n != len(values):
+            # trust-boundary check (this surface is network-exposed
+            # via svcnode): zip truncation would leave accumulator
+            # positions unfillable and hang the batch future forever
+            raise ValueError(
+                f"kput_many: {n} keys vs {len(values)} values")
         if self._dead(ens) or n == 0:
             fut.resolve(["failed"] * n)
             return fut
@@ -662,12 +667,7 @@ class BatchedEnsembleService:
         :meth:`unwatch_leader` (the stop_watching counterpart)."""
         self._leader_watchers.setdefault(ens, []).append(fn)
         cur = int(self.leader_np[ens])
-        try:
-            fn(ens, cur, cur)
-        except Exception:
-            import traceback
-            self._emit("svc_watcher_error",
-                       {"error": traceback.format_exc(limit=8)})
+        self._safe_notify(fn, ens, cur, cur)
 
     def unwatch_leader(self, ens: int, fn) -> bool:
         """Deregister a leader watcher (stop_watching,
@@ -680,18 +680,25 @@ class BatchedEnsembleService:
             del self._leader_watchers[ens]
         return True
 
+    def _safe_notify(self, fn, *args) -> None:
+        """Run a watcher callback, containing and tracing exceptions
+        (the _safe_resolve contract for watchers)."""
+        try:
+            fn(*args)
+        except Exception:
+            import traceback
+            self._emit("svc_watcher_error",
+                       {"error": traceback.format_exc(limit=8)})
+
     def _notify_leader_changes(self, old: np.ndarray) -> None:
         if not self._leader_watchers:
             return
         changed = np.nonzero(old != self.leader_np)[0]
         for e in changed.tolist():
-            for fn in self._leader_watchers.get(e, ()):
-                try:
-                    fn(e, int(old[e]), int(self.leader_np[e]))
-                except Exception:
-                    import traceback
-                    self._emit("svc_watcher_error",
-                               {"error": traceback.format_exc(limit=8)})
+            # snapshot: a watcher may watch/unwatch from its callback
+            for fn in list(self._leader_watchers.get(e, ())):
+                self._safe_notify(fn, e, int(old[e]),
+                                  int(self.leader_np[e]))
 
     def set_peer_up(self, ens: int, peer: int, up: bool) -> None:
         """Failure-detector input (the host's nodedown/suspend signal)."""

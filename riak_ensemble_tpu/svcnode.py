@@ -22,6 +22,8 @@ requests and correlate out-of-order completions:
     ("kdelete", ens, key)            -> ("ok", vsn) | ("ok", NOTFOUND
                                         when no such key) | "failed"
     ("ksafe_delete", ens, key, vsn)  -> ("ok", new_vsn) | "failed"
+    ("kput_many", ens, keys, vals)   -> [per-key results, in order]
+    ("kget_many", ens, keys)         -> [per-key results, in order]
     ("stats",)                       -> dict
 
 Dynamic-lifecycle ops (service constructed with ``dynamic=True``;
@@ -103,6 +105,10 @@ class ServiceServer:
             return svc.kput(*args)
         if op == "kget":
             return svc.kget(*args)
+        if op == "kput_many":
+            return svc.kput_many(*args)
+        if op == "kget_many":
+            return svc.kget_many(*args)
         if op == "kget_vsn":
             return svc.kget_vsn(*args)
         if op == "kupdate":
@@ -306,6 +312,13 @@ class ServiceClient:
 
     async def ksafe_delete(self, ens, key, vsn, **kw):
         return await self.call("ksafe_delete", ens, key, vsn, **kw)
+
+    async def kput_many(self, ens, keys, values, **kw):
+        return await self.call("kput_many", ens, list(keys),
+                               list(values), **kw)
+
+    async def kget_many(self, ens, keys, **kw):
+        return await self.call("kget_many", ens, list(keys), **kw)
 
     async def stats(self, **kw):
         return await self.call("stats", **kw)

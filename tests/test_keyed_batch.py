@@ -161,3 +161,38 @@ def test_stats_queued_ops_counts_batch_rounds():
         svc.flush()
     assert svc.stats()["queued_ops"] == 0
     svc.stop()
+
+
+def test_kput_many_length_mismatch_rejected():
+    """Network-exposed trust boundary: mismatched keys/values raise
+    (never a silently-truncated batch whose future can't resolve)."""
+    rt, svc = make(n_ens=1)
+    with pytest.raises(ValueError):
+        svc.kput_many(0, ["a", "b"], [b"1"])
+    svc.stop()
+
+
+def test_watcher_unwatches_itself_mid_callback():
+    """A one-shot watcher deregistering inside its callback must not
+    skip sibling watchers (snapshot iteration)."""
+    rt, svc = make(n_ens=1)
+    events = []
+
+    def one_shot(e, old, new):
+        if old == new:
+            return  # skip the registration-time status notify
+        svc.unwatch_leader(0, one_shot)
+        events.append(("one", old, new))
+
+    svc.watch_leader(0, one_shot)
+    svc.watch_leader(0, lambda e, old, new: events.append(("two", old,
+                                                           new)))
+    n = len(events)
+    assert settle(rt, svc.kput(0, "k", b"v"))[0] == "ok"
+    fired = events[n:]
+    assert ("one", -1, int(svc.leader_np[0])) in fired
+    assert ("two", -1, int(svc.leader_np[0])) in fired
+    # one_shot is gone; two remains
+    assert svc._leader_watchers[0] != []
+    assert one_shot not in svc._leader_watchers[0]
+    svc.stop()

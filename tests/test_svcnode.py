@@ -206,3 +206,25 @@ def test_svcnode_nonreading_client_dropped_not_buffered(monkeypatch):
         await server.stop()
 
     asyncio.run(scenario())
+
+
+def test_svcnode_batch_ops_over_the_wire():
+    """kput_many/kget_many ride the TCP protocol: one frame, one
+    response carrying the per-key result list in order."""
+    async def scenario():
+        server = await svcnode.serve(2, 3, 32, port=0,
+                                     config=fast_test_config())
+        c = svcnode.ServiceClient(server.host, server.port)
+        await c.connect()
+        keys = [f"k{i}" for i in range(10)]
+        res = await c.kput_many(1, keys, [b"v%d" % i for i in range(10)])
+        assert len(res) == 10 and all(r[0] == "ok" for r in res)
+        got = await c.kget_many(1, keys + ["nope"])
+        assert [r[1] for r in got[:10]] == [b"v%d" % i for i in range(10)]
+        assert got[10] == ("ok", NOTFOUND)
+        # bad ensemble index still rejected cleanly
+        assert (await c.kput_many(-1, ["k"], [b"v"]))[0] == "error"
+        await c.close()
+        await server.stop()
+
+    asyncio.run(scenario())
