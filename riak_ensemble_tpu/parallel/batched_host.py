@@ -326,7 +326,8 @@ class BatchedEnsembleService:
             if savelib.read(meta) is None:
                 import pickle
                 savelib.write(meta, pickle.dumps(
-                    {"shape": (n_ens, n_peers, n_slots)}, protocol=4))
+                    {"shape": (n_ens, n_peers, n_slots),
+                     "dynamic": dynamic}, protocol=4))
             self._wal = ServiceWAL.open_gen(
                 data_dir, self._current_ckpt(data_dir), wal_sync)
         self._schedule()
@@ -964,12 +965,15 @@ class BatchedEnsembleService:
             if meta_raw is None:
                 raise FileNotFoundError(
                     f"no service checkpoint at {path}")
-            shape = pickle.loads(meta_raw)["shape"]
-            svc = cls(runtime, *shape, **kw)
+            meta = pickle.loads(meta_raw)
+            kw = cls._merge_dynamic(kw, bool(meta.get("dynamic",
+                                                      False)))
+            svc = cls(runtime, *meta["shape"], **kw)
             svc._replay_wal_from(path, 0, ServiceWAL)
             return svc
         host = pickle.loads(raw)
         n_ens, n_peers, n_slots = host["shape"]
+        kw = cls._merge_dynamic(kw, bool(host.get("dynamic", False)))
         svc = cls(runtime, n_ens, n_peers, n_slots, **kw)
         svc.state = ckpt.load(os.path.join(d, "engine"),
                               template=svc.state)
@@ -999,6 +1003,23 @@ class BatchedEnsembleService:
         # lease_until stays zero: no pre-crash lease is ever trusted.
         svc._replay_wal_from(path, n, ServiceWAL)
         return svc
+
+    @staticmethod
+    def _merge_dynamic(kw: Dict[str, Any], persisted: bool
+                       ) -> Dict[str, Any]:
+        """The persisted lifecycle mode WINS at restore: a static
+        image restored as dynamic would mark every row free (the
+        first create would wipe restored data on device); a dynamic
+        image restored as static would drop the name directory.  An
+        explicitly mismatched caller flag fails loudly."""
+        if "dynamic" in kw and bool(kw["dynamic"]) != persisted:
+            raise ValueError(
+                f"restore: service was persisted with "
+                f"dynamic={persisted}; cannot restore with "
+                f"dynamic={kw['dynamic']}")
+        kw = dict(kw)
+        kw["dynamic"] = persisted
+        return kw
 
     def _replay_wal_from(self, path: str, gen: int, wal_cls) -> None:
         """Replay WAL generation ``gen`` under ``path`` if it exists

@@ -48,6 +48,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import itertools
+import os
 import struct
 from typing import Any, Dict, Optional, Tuple
 
@@ -343,10 +344,21 @@ async def serve(n_ens: int, n_peers: int, n_slots: int,
     server (call ``await server.stop()`` to tear down)."""
     runtime = NetRuntime("svc", {"svc": (host, 0)})
     runtime.loop = asyncio.get_running_loop()
-    svc = BatchedEnsembleService(
-        runtime, n_ens, n_peers, n_slots, tick=tick,
-        config=config if config is not None else Config(),
-        engine=engine, dynamic=dynamic, data_dir=data_dir)
+    cfg = config if config is not None else Config()
+    if data_dir is not None and (
+            os.path.exists(os.path.join(data_dir, "META"))
+            or os.path.exists(os.path.join(data_dir, "CURRENT"))):
+        # Operator restart: a data_dir with prior state RESTORES
+        # (checkpoint + WAL replay) — a fresh service over an old WAL
+        # would silently serve empty while poisoning the log.  The
+        # persisted shape wins over the CLI shape.
+        svc = BatchedEnsembleService.restore(
+            runtime, data_dir, tick=tick, config=cfg, engine=engine,
+            dynamic=dynamic, data_dir=data_dir)
+    else:
+        svc = BatchedEnsembleService(
+            runtime, n_ens, n_peers, n_slots, tick=tick, config=cfg,
+            engine=engine, dynamic=dynamic, data_dir=data_dir)
     server = ServiceServer(svc, host, port)
     await server.start()
     return server

@@ -193,3 +193,74 @@ def test_svcnode_lifecycle_ops():
         await server2.stop()
 
     asyncio.run(scenario())
+
+
+def test_svcnode_restart_restores_from_data_dir(tmp_path):
+    """Operator restart flow: a svcnode re-serving an existing
+    data_dir restores the acked state (not an empty service over the
+    old WAL)."""
+    import asyncio
+
+    from riak_ensemble_tpu import svcnode
+
+    data = str(tmp_path / "d")
+
+    async def first():
+        server = await svcnode.serve(4, 3, 8, port=0,
+                                     config=fast_test_config(),
+                                     dynamic=True, data_dir=data)
+        c = svcnode.ServiceClient(server.host, server.port)
+        await c.connect()
+        r = await c.create_ensemble("orders")
+        e = r[1]
+        assert (await c.kput(e, "k", b"v"))[0] == "ok"
+        await c.close()
+        # crash analog: close the WAL without checkpointing
+        server.svc.stop()
+        server.svc._wal.close()
+        if server._server is not None:
+            server._server.close()
+            await server._server.wait_closed()
+        return e
+
+    async def second(e):
+        server = await svcnode.serve(4, 3, 8, port=0,
+                                     config=fast_test_config(),
+                                     dynamic=True, data_dir=data)
+        c = svcnode.ServiceClient(server.host, server.port)
+        await c.connect()
+        assert await c.resolve_ensemble("orders") == ("ok", e)
+        assert await c.kget(e, "k") == ("ok", b"v")
+        await c.close()
+        await server.stop()
+
+    e = asyncio.run(first())
+    asyncio.run(second(e))
+
+
+def test_restore_dynamic_flag_mismatch_fails_loudly(tmp_path):
+    """The persisted lifecycle mode wins at restore; an explicitly
+    contradicting flag is an error, never a silent reinterpretation
+    (a static image restored as dynamic would free every row and the
+    first create would wipe restored data)."""
+    runtime = Runtime(seed=33)
+    svc = BatchedEnsembleService(runtime, 2, 3, 4, tick=0.005,
+                                 config=fast_test_config(),
+                                 data_dir=str(tmp_path / "d"))
+    assert settle(runtime, svc.kput(0, "k", b"v"))[0] == "ok"
+    svc.stop()
+    svc._wal.close()
+
+    rt2 = Runtime(seed=34)
+    with pytest.raises(ValueError):
+        BatchedEnsembleService.restore(
+            rt2, str(tmp_path / "d"), tick=0.005,
+            config=fast_test_config(), data_dir=str(tmp_path / "d"),
+            dynamic=True)
+    # omitting the flag restores with the persisted mode
+    svc2 = BatchedEnsembleService.restore(
+        rt2, str(tmp_path / "d"), tick=0.005,
+        config=fast_test_config(), data_dir=str(tmp_path / "d"))
+    assert not svc2.dynamic
+    assert settle(rt2, svc2.kget(0, "k")) == ("ok", b"v")
+    svc2.stop()
