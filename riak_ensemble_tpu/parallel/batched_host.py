@@ -147,6 +147,9 @@ class _PendingBatch:
     pos: Any = None    # np.int32 [n] position in the caller's order
     keys: Any = None   # list of key objects (puts: for WAL/recycle)
     gen: Any = None    # np.int32 [n] slot generations (puts)
+    #: CAS expected versions (OP_CAS batches; None otherwise)
+    exp_e: Any = None  # np.int32 [n]
+    exp_s: Any = None  # np.int32 [n]
     accum: Any = None  # shared _BatchAccum across splits
     want_vsn: bool = False
     t_enq: float = 0.0
@@ -161,12 +164,16 @@ class _PendingBatch:
         h = _PendingBatch(self.kind, self.slot[:head_n],
                           self.handle[:head_n], self.fut,
                           self.pos[:head_n], cut(self.keys, 0, head_n),
-                          cut(self.gen, 0, head_n), self.accum,
+                          cut(self.gen, 0, head_n),
+                          cut(self.exp_e, 0, head_n),
+                          cut(self.exp_s, 0, head_n), self.accum,
                           self.want_vsn, self.t_enq, head_n)
         t = _PendingBatch(self.kind, self.slot[head_n:],
                           self.handle[head_n:], self.fut,
                           self.pos[head_n:], cut(self.keys, head_n, None),
-                          cut(self.gen, head_n, None), self.accum,
+                          cut(self.gen, head_n, None),
+                          cut(self.exp_e, head_n, None),
+                          cut(self.exp_s, head_n, None), self.accum,
                           self.want_vsn, self.t_enq, self.n - head_n)
         return h, t
 
@@ -512,7 +519,107 @@ class BatchedEnsembleService:
         if m:
             self._push(ens, _PendingBatch(
                 eng.OP_PUT, slot[:m], handle[:m], fut, pos[:m],
-                live_keys, gen[:m], accum, n=m))
+                live_keys, gen[:m], accum=accum, n=m))
+        return fut
+
+    def kupdate_many(self, ens: int, keys: List[Any],
+                     expected_vsns: List[Tuple[int, int]],
+                     values: List[Any]) -> Future:
+        """Vectorized CAS batch (the kupdate/kput_once semantics per
+        key): commit values[i] iff keys[i]'s current version equals
+        expected_vsns[i] ((0, 0) = create-if-missing).  One future,
+        per-key ('ok', new_vsn) | 'failed' in order."""
+        fut = Future()
+        n = len(keys)
+        if n != len(values) or n != len(expected_vsns):
+            raise ValueError(
+                f"kupdate_many: {n} keys vs {len(expected_vsns)} vsns "
+                f"vs {len(values)} values")
+        if self._dead(ens) or n == 0:
+            fut.resolve(["failed"] * n)
+            return fut
+        accum = _BatchAccum(n)
+        slot = np.zeros((n,), np.int32)
+        handle = np.zeros((n,), np.int32)
+        gen = np.zeros((n,), np.int32)
+        pos = np.zeros((n,), np.int32)
+        exp_e = np.zeros((n,), np.int32)
+        exp_s = np.zeros((n,), np.int32)
+        live_keys: List[Any] = []
+        miss_pos: List[int] = []
+        m = 0
+        sg = self.slot_gen[ens]
+        for i, (key, vsn, value) in enumerate(
+                zip(keys, expected_vsns, values)):
+            s = self._slot_for(ens, key, allocate=True)
+            if s is None:
+                miss_pos.append(i)
+                continue
+            h = self._alloc_handle()
+            self.values[h] = value
+            g = sg.get(s, 0) + 1
+            sg[s] = g
+            slot[m], handle[m], gen[m], pos[m] = s, h, g, i
+            exp_e[m], exp_s[m] = int(vsn[0]), int(vsn[1])
+            live_keys.append(key)
+            m += 1
+        if miss_pos:
+            accum.fill(fut, miss_pos, ["failed"] * len(miss_pos),
+                       self._safe_resolve)
+        if m:
+            self._push(ens, _PendingBatch(
+                eng.OP_CAS, slot[:m], handle[:m], fut, pos[:m],
+                live_keys, gen[:m], exp_e[:m], exp_s[:m], accum, n=m))
+        return fut
+
+    def kdelete_many(self, ens: int, keys: List[Any]) -> Future:
+        """Vectorized tombstone writes: one future, per-key
+        ('ok', vsn) | ('ok', NOTFOUND) (no such key) | 'failed' in
+        order.  Committed slots recycle like scalar kdelete."""
+        fut = Future()
+        n = len(keys)
+        if self._dead(ens) or n == 0:
+            # dead-ensemble rejection, same as scalar kdelete and the
+            # other batch ops — never a fake 'ok' for an unserved op
+            fut.resolve(["failed"] * n)
+            return fut
+        accum = _BatchAccum(n)
+        slot = np.zeros((n,), np.int32)
+        gen = np.zeros((n,), np.int32)
+        pos = np.zeros((n,), np.int32)
+        live_keys: List[Any] = []
+        miss_pos: List[int] = []
+        m = 0
+        for i, key in enumerate(keys):
+            s = self._slot_for(ens, key, allocate=False)
+            if s is None:
+                miss_pos.append(i)
+                continue
+            slot[m], pos[m] = s, i
+            gen[m] = self.slot_gen[ens].get(s, 0)
+            live_keys.append(key)
+            m += 1
+        if miss_pos:
+            accum.fill(fut, miss_pos, [("ok", NOTFOUND)] * len(miss_pos),
+                       self._safe_resolve)
+        if m:
+            batch = _PendingBatch(
+                eng.OP_PUT, slot[:m], np.zeros((m,), np.int32), fut,
+                pos[:m], live_keys, gen[:m], accum=accum, n=m)
+            self._push(ens, batch)
+            # deferred recycle per committed tombstone, keyed off the
+            # batch result list (the _recycle_on_ok discipline)
+            keyslots = list(zip(live_keys, slot[:m].tolist(),
+                                gen[:m].tolist(), pos[:m].tolist()))
+
+            def recycle(results):
+                if not isinstance(results, list):
+                    return
+                for key, s, g, p in keyslots:
+                    r = results[p]
+                    if isinstance(r, tuple) and r[0] == "ok":
+                        self._recycle_pending[ens].append((key, s, g))
+            fut.add_waiter(recycle)
         return fut
 
     def kget_many(self, ens: int, keys: List[Any],
@@ -1597,6 +1704,9 @@ class BatchedEnsembleService:
                     kind[j:j + n, e] = op.kind
                     slot[j:j + n, e] = op.slot
                     val[j:j + n, e] = op.handle
+                    if op.exp_e is not None:
+                        exp_e[j:j + n, e] = op.exp_e
+                        exp_s[j:j + n, e] = op.exp_s
                     j += n
                 else:
                     kind[j, e] = op.kind
@@ -1680,7 +1790,7 @@ class BatchedEnsembleService:
             j = -1
             for op in ops:
                 if isinstance(op, _PendingBatch):
-                    if op.kind == eng.OP_PUT:
+                    if op.kind in (eng.OP_PUT, eng.OP_CAS):
                         comm = committed[j + 1:j + 1 + op.n, e]
                         vs2 = vsn[j + 1:j + 1 + op.n, e]
                         for i in np.nonzero(comm)[0]:
@@ -1728,7 +1838,7 @@ class BatchedEnsembleService:
     def _fail_batch(self, e: int, op: _PendingBatch) -> None:
         if op.fut.done:
             return
-        if op.kind == eng.OP_PUT:
+        if op.kind in (eng.OP_PUT, eng.OP_CAS):
             slot_l = op.slot.tolist()
             handle_l = op.handle.tolist()
             gen_l = op.gen.tolist()
@@ -1764,7 +1874,7 @@ class BatchedEnsembleService:
         committed, get_ok, found, value, vsn = planes
         n = op.n
         results: List[Any] = []
-        if op.kind == eng.OP_PUT:
+        if op.kind in (eng.OP_PUT, eng.OP_CAS):
             comm_l = committed[j:j + n, e].tolist()
             vs_l = vsn[j:j + n, e].tolist()
             slot_l = op.slot.tolist()

@@ -24,6 +24,8 @@ requests and correlate out-of-order completions:
     ("ksafe_delete", ens, key, vsn)  -> ("ok", new_vsn) | "failed"
     ("kput_many", ens, keys, vals)   -> [per-key results, in order]
     ("kget_many", ens, keys)         -> [per-key results, in order]
+    ("kupdate_many", ens, keys, vsns, vals) / ("kdelete_many",
+    ens, keys)                       -> [per-key results, in order]
     ("stats",)                       -> dict
 
 Dynamic-lifecycle ops (service constructed with ``dynamic=True``;
@@ -110,6 +112,10 @@ class ServiceServer:
             return svc.kput_many(*args)
         if op == "kget_many":
             return svc.kget_many(*args)
+        if op == "kupdate_many":
+            return svc.kupdate_many(*args)
+        if op == "kdelete_many":
+            return svc.kdelete_many(*args)
         if op == "kget_vsn":
             return svc.kget_vsn(*args)
         if op == "kupdate":
@@ -320,6 +326,14 @@ class ServiceClient:
 
     async def kget_many(self, ens, keys, **kw):
         return await self.call("kget_many", ens, list(keys), **kw)
+
+    async def kupdate_many(self, ens, keys, vsns, values, **kw):
+        return await self.call("kupdate_many", ens, list(keys),
+                               [tuple(v) for v in vsns], list(values),
+                               **kw)
+
+    async def kdelete_many(self, ens, keys, **kw):
+        return await self.call("kdelete_many", ens, list(keys), **kw)
 
     async def stats(self, **kw):
         return await self.call("stats", **kw)

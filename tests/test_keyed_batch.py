@@ -196,3 +196,78 @@ def test_watcher_unwatches_itself_mid_callback():
     assert svc._leader_watchers[0] != []
     assert one_shot not in svc._leader_watchers[0]
     svc.stop()
+
+
+def test_kupdate_many_cas_semantics():
+    """Batch CAS: per-key version compare, (0,0) = create-if-missing,
+    stale versions fail cleanly, chains survive crash."""
+    rt, svc = make(n_ens=1)
+    put = settle(rt, svc.kput_many(0, ["a", "b"], [b"1", b"2"]))
+    vsn_a, vsn_b = tuple(put[0][1]), tuple(put[1][1])
+
+    res = settle(rt, svc.kupdate_many(
+        0, ["a", "b", "c"],
+        [vsn_a, (9, 9), (0, 0)],         # ok / stale / create
+        [b"a2", b"b2", b"c1"]))
+    assert res[0][0] == "ok"
+    assert res[1] == "failed"            # stale vsn: definitive reject
+    assert res[2][0] == "ok"             # create-if-missing
+    assert settle(rt, svc.kget_many(0, ["a", "b", "c"])) == \
+        [("ok", b"a2"), ("ok", b"2"), ("ok", b"c1")]
+    # the stale CAS's payload must not leak
+    assert len(svc.values) == 3
+    svc.stop()
+
+
+def test_kdelete_many_and_recycle():
+    rt, svc = make(n_ens=1, n_slots=3)
+    assert all(r[0] == "ok" for r in settle(
+        rt, svc.kput_many(0, ["a", "b", "c"], [b"1", b"2", b"3"])))
+    res = settle(rt, svc.kdelete_many(0, ["a", "c", "nope"]))
+    assert res[0][0] == "ok" and res[1][0] == "ok"
+    assert res[2] == ("ok", NOTFOUND)
+    assert settle(rt, svc.kget_many(0, ["a", "b", "c"])) == \
+        [("ok", NOTFOUND), ("ok", b"2"), ("ok", NOTFOUND)]
+    # slots recycled: two fresh keys fit in the 3-slot ensemble
+    res = settle(rt, svc.kput_many(0, ["x", "y"], [b"8", b"9"]))
+    assert all(r[0] == "ok" for r in res)
+    assert len(svc.values) == 3  # b, x, y — deleted payloads released
+    svc.stop()
+
+
+def test_batch_cas_and_delete_survive_crash(tmp_path):
+    rt, svc = make(n_ens=1, data_dir=str(tmp_path / "d"))
+    put = settle(rt, svc.kput_many(0, ["a", "b"], [b"1", b"2"]))
+    assert all(r[0] == "ok" for r in put)
+    up = settle(rt, svc.kupdate_many(0, ["a"], [tuple(put[0][1])],
+                                     [b"a2"]))
+    assert up[0][0] == "ok"
+    dl = settle(rt, svc.kdelete_many(0, ["b"]))
+    assert dl[0][0] == "ok"
+    svc.stop()
+    svc._wal.close()
+
+    rt2 = Runtime(seed=63)
+    svc2 = BatchedEnsembleService.restore(
+        rt2, str(tmp_path / "d"), tick=0.005,
+        config=fast_test_config(), data_dir=str(tmp_path / "d"))
+    assert settle(rt2, svc2.kget_many(0, ["a", "b"])) == \
+        [("ok", b"a2"), ("ok", NOTFOUND)]
+    svc2.stop()
+
+
+def test_batch_ops_on_dead_ensemble_fail():
+    """All four batch ops reject a destroyed ensemble with 'failed' —
+    never a fake ('ok', NOTFOUND) for an unserved delete."""
+    rt = Runtime(seed=64)
+    svc = BatchedEnsembleService(rt, 2, 3, 4, tick=0.005,
+                                 config=fast_test_config(),
+                                 dynamic=True)
+    e = svc.create_ensemble("x")
+    assert svc.destroy_ensemble("x")
+    assert svc.kput_many(e, ["k"], [b"v"]).value == ["failed"]
+    assert svc.kget_many(e, ["k"]).value == ["failed"]
+    assert svc.kupdate_many(e, ["k"], [(0, 0)], [b"v"]).value == \
+        ["failed"]
+    assert svc.kdelete_many(e, ["k"]).value == ["failed"]
+    svc.stop()

@@ -222,6 +222,14 @@ def test_svcnode_batch_ops_over_the_wire():
         got = await c.kget_many(1, keys + ["nope"])
         assert [r[1] for r in got[:10]] == [b"v%d" % i for i in range(10)]
         assert got[10] == ("ok", NOTFOUND)
+        # CAS + delete batches over the wire
+        up = await c.kupdate_many(1, [keys[0]], [tuple(res[0][1])],
+                                  [b"up0"])
+        assert up[0][0] == "ok"
+        assert await c.kget(1, keys[0]) == ("ok", b"up0")
+        dl = await c.kdelete_many(1, [keys[1], "nope"])
+        assert dl[0][0] == "ok" and dl[1] == ("ok", NOTFOUND)
+        assert await c.kget(1, keys[1]) == ("ok", NOTFOUND)
         # bad ensemble index still rejected cleanly
         assert (await c.kput_many(-1, ["k"], [b"v"]))[0] == "error"
         await c.close()
