@@ -714,6 +714,13 @@ class BatchedEnsembleService:
             exp=(int(expected_vsn[0]), int(expected_vsn[1]))))
         return fut
 
+    def kput_once(self, ens: int, key: Any, value: Any) -> Future:
+        """Create-if-missing (do_kput_once, peer.erl:278-284): the
+        (0, 0)-expected CAS — commits only when the key holds nothing
+        (true absence or a tombstone).  Resolves ('ok', vsn) |
+        'failed' (exists / no quorum)."""
+        return self.kupdate(ens, key, (0, 0), value)
+
     def ksafe_delete(self, ens: int, key: Any,
                      expected_vsn: Tuple[int, int]) -> Future:
         """Version-guarded delete (ksafe_delete): CAS to a tombstone."""

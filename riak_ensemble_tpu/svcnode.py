@@ -19,6 +19,7 @@ requests and correlate out-of-order completions:
     ("kget", ens, key)               -> ("ok", value|NOTFOUND) | "failed"
     ("kget_vsn", ens, key)           -> ("ok", value, vsn) | "failed"
     ("kupdate", ens, key, vsn, val)  -> ("ok", new_vsn) | "failed"
+    ("kput_once", ens, key, val)     -> ("ok", vsn) | "failed"
     ("kdelete", ens, key)            -> ("ok", vsn) | ("ok", NOTFOUND
                                         when no such key) | "failed"
     ("ksafe_delete", ens, key, vsn)  -> ("ok", new_vsn) | "failed"
@@ -120,6 +121,8 @@ class ServiceServer:
             return svc.kget_vsn(*args)
         if op == "kupdate":
             return svc.kupdate(*args)
+        if op == "kput_once":
+            return svc.kput_once(*args)
         if op == "kdelete":
             return svc.kdelete(*args)
         if op == "ksafe_delete":
@@ -313,6 +316,9 @@ class ServiceClient:
 
     async def kupdate(self, ens, key, vsn, value, **kw):
         return await self.call("kupdate", ens, key, vsn, value, **kw)
+
+    async def kput_once(self, ens, key, value, **kw):
+        return await self.call("kput_once", ens, key, value, **kw)
 
     async def kdelete(self, ens, key, **kw):
         return await self.call("kdelete", ens, key, **kw)

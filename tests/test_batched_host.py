@@ -805,3 +805,19 @@ def test_service_leader_watchers():
     assert settle(runtime, svc.kget(0, "k"))[0] == "ok"  # re-elects
     assert len(events) == n2
     svc.stop()
+
+
+def test_service_kput_once():
+    """do_kput_once (peer.erl:278-284): create-if-missing through the
+    (0,0) CAS — commits on absence or tombstone, rejects existing."""
+    runtime, svc = make_service(n_ens=1, n_peers=3, n_slots=4)
+    r = settle(runtime, svc.kput_once(0, "k", b"first"))
+    assert r[0] == "ok"
+    assert settle(runtime, svc.kput_once(0, "k", b"second")) == "failed"
+    assert settle(runtime, svc.kget(0, "k")) == ("ok", b"first")
+    # over a tombstone it succeeds (the notfound-obj case)
+    assert settle(runtime, svc.kdelete(0, "k"))[0] == "ok"
+    r = settle(runtime, svc.kput_once(0, "k", b"third"))
+    assert r[0] == "ok"
+    assert settle(runtime, svc.kget(0, "k")) == ("ok", b"third")
+    svc.stop()
