@@ -330,7 +330,10 @@ class ServiceClient:
         return await self.call("kput_many", ens, list(keys),
                                list(values), **kw)
 
-    async def kget_many(self, ens, keys, **kw):
+    async def kget_many(self, ens, keys, want_vsn=False, **kw):
+        if want_vsn:
+            return await self.call("kget_many", ens, list(keys), True,
+                                   **kw)
         return await self.call("kget_many", ens, list(keys), **kw)
 
     async def kupdate_many(self, ens, keys, vsns, values, **kw):

@@ -230,6 +230,10 @@ def test_svcnode_batch_ops_over_the_wire():
         dl = await c.kdelete_many(1, [keys[1], "nope"])
         assert dl[0][0] == "ok" and dl[1] == ("ok", NOTFOUND)
         assert await c.kget(1, keys[1]) == ("ok", NOTFOUND)
+        # versioned batch reads over the wire
+        gv = await c.kget_many(1, [keys[0], "nope"], want_vsn=True)
+        assert gv[0][:2] == ("ok", b"up0") and len(gv[0]) == 3
+        assert gv[1] == ("ok", NOTFOUND, (0, 0))
         # bad ensemble index still rejected cleanly
         assert (await c.kput_many(-1, ["k"], [b"v"]))[0] == "error"
         await c.close()
