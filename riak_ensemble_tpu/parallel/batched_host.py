@@ -88,6 +88,7 @@ class _LocalEngine:
     exchange_step = staticmethod(eng.exchange_step)
     reconfig_step = staticmethod(eng.reconfig_step)
     reset_rows = staticmethod(eng.reset_rows)
+    verify_trees = staticmethod(eng.verify_trees)
 
 
 class WallRuntime:
@@ -1553,6 +1554,42 @@ class BatchedEnsembleService:
         tr = getattr(self.runtime, "trace", None)
         if tr is not None:
             tr(kind, payload)
+
+    def scrub(self) -> Dict[str, int]:
+        """Full anti-entropy sweep — the maintenance form of the
+        corruption-triggered exchange (riak_ensemble_exchange +
+        peer_tree:do_repair): verify EVERY replica's tree (the BFS
+        verify, synctree.erl:549-571), run the exchange over
+        ensembles holding damage (newest hash-valid copy wins,
+        adopters rebuild), and report what was found/healed.  Reads
+        only touch accessed slots, so damage on cold slots is
+        invisible to the data path until a scrub or access — the
+        operator cadence knob the reference gets from AAE timers."""
+        jnp = self._jnp
+        node_bad, leaf_bad = self.engine.verify_trees(self.state)
+        bad = np.asarray(node_bad) | np.asarray(leaf_bad)    # [E, M]
+        found = int(bad.sum())
+        if not found:
+            return {"replicas_damaged": 0, "replicas_healed": 0,
+                    "ensembles_swept": 0}
+        run = bad.any(1)
+        self.corruptions += found
+        state_snapshot = self.state
+        try:
+            self.state, diverged, synced = self.engine.exchange_step(
+                self.state, jnp.asarray(run), self._up_device())
+            node_bad2, leaf_bad2 = self.engine.verify_trees(self.state)
+            still = (np.asarray(node_bad2)
+                     | np.asarray(leaf_bad2)) & bad
+        except BaseException:
+            self.state = state_snapshot
+            raise
+        healed = found - int(still.sum())
+        self.repairs += int(
+            np.asarray(diverged)[np.asarray(synced)].sum())
+        self._emit("svc_scrub", {"damaged": found, "healed": healed})
+        return {"replicas_damaged": found, "replicas_healed": healed,
+                "ensembles_swept": int(run.sum())}
 
     def latency_breakdown(self) -> Dict[str, Dict[str, float]]:
         """Per-component launch-latency percentiles (ms) over the
