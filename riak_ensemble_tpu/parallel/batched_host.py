@@ -217,7 +217,8 @@ class BatchedEnsembleService:
                  data_dir: Optional[str] = None,
                  wal_sync: str = "fsync",
                  wal_compact_records: int = 1 << 18,
-                 dynamic: bool = False) -> None:
+                 dynamic: bool = False,
+                 scrub_every_flushes: Optional[int] = None) -> None:
         import jax.numpy as jnp
 
         self.runtime = runtime
@@ -305,6 +306,9 @@ class BatchedEnsembleService:
                 jnp.zeros((n_ens, n_peers), bool))
         #: leader-status watchers per ensemble (watch_leader)
         self._leader_watchers: Dict[int, List[Any]] = {}
+        #: periodic anti-entropy cadence: run :meth:`scrub` every N
+        #: flushes (None = on demand only) — the AAE-timer analog
+        self.scrub_every_flushes = scrub_every_flushes
         self._timer: Optional[Timer] = None
         self._kick_pending = False  # burst flush queued (see _maybe_kick)
         self._jnp = jnp
@@ -1817,6 +1821,9 @@ class BatchedEnsembleService:
             # WAL grew past the compaction bound: fold it into a fresh
             # checkpoint (save() rotates the generation).
             self.save()
+        if (self.scrub_every_flushes
+                and self.flushes % self.scrub_every_flushes == 0):
+            self.scrub()
         return served
 
     def _log_wal(self, taken, planes) -> None:

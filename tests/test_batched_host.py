@@ -852,3 +852,28 @@ def test_service_scrub_heals_cold_slot_damage():
         assert settle(runtime, svc.kget(e, "cold")) == ("ok", b"c%d" % e)
         assert settle(runtime, svc.kget(e, "hot")) == ("ok", b"h%d" % e)
     svc.stop()
+
+
+def test_periodic_scrub_cadence():
+    """scrub_every_flushes: cold-slot damage heals without any
+    explicit scrub call — the tick-driven AAE analog."""
+    from riak_ensemble_tpu.config import fast_test_config
+    from riak_ensemble_tpu.runtime import Runtime
+    runtime = Runtime(seed=52)
+    svc = BatchedEnsembleService(runtime, 2, 3, 8, tick=0.005,
+                                 config=fast_test_config(),
+                                 scrub_every_flushes=3)
+    assert settle(runtime, svc.kput(0, "cold", b"c"))[0] == "ok"
+    s = svc.key_slot[0]["cold"]
+    svc.state = svc.state._replace(
+        obj_val=svc.state.obj_val.at[0, 1, s].set(777777))
+    # traffic on the OTHER ensemble drives flushes past the cadence
+    for i in range(8):
+        assert settle(runtime, svc.kput(1, f"k{i}", b"v"))[0] == "ok"
+    from riak_ensemble_tpu.ops import engine as eng
+    node_bad, leaf_bad = eng.verify_trees(svc.state)
+    assert not bool(np.asarray(node_bad).any())
+    assert not bool(np.asarray(leaf_bad).any())
+    assert svc.repairs >= 1 or svc.corruptions >= 1
+    assert settle(runtime, svc.kget(0, "cold")) == ("ok", b"c")
+    svc.stop()
