@@ -75,6 +75,49 @@ def _pack_results(won, res: eng.KvResult, want_vsn: bool):
     return jnp.concatenate([jnp.packbits(flags), ints_u8])
 
 
+def unpack_results(flat: np.ndarray, e: int, m: int, k: int,
+                   want_vsn: bool):
+    """Invert :func:`_pack_results`: one packed uint8 vector →
+    ``(won, quorum_ok, corrupt, committed, get_ok, found, value,
+    vsn)`` host arrays (the k == 0 planes are None).  Module-level so
+    the replica side of the replication group
+    (:mod:`riak_ensemble_tpu.parallel.repgroup`) unpacks the SAME
+    layout its leader packs."""
+    nbits = 2 * e + e * m + 3 * k * e
+    bits = np.unpackbits(flat[:(nbits + 7) // 8],
+                         count=nbits).astype(bool)
+    ints = flat[(nbits + 7) // 8:].copy().view(np.int32)
+    boff = ioff = 0
+
+    def take_bits(n, shape=None):
+        nonlocal boff
+        out = bits[boff:boff + n]
+        boff += n
+        return out.reshape(shape) if shape is not None else out
+
+    def take_ints(n, shape=None):
+        nonlocal ioff
+        out = ints[ioff:ioff + n]
+        ioff += n
+        return out.reshape(shape) if shape is not None else out
+
+    won = take_bits(e)
+    quorum_ok = take_bits(e)
+    corrupt = take_bits(e * m, (e, m))
+    if k:
+        committed = take_bits(k * e, (k, e))
+        get_ok = take_bits(k * e, (k, e))
+        found = take_bits(k * e, (k, e))
+        value = take_ints(k * e, (k, e))
+        vsn = None
+        if want_vsn:
+            vsn = np.stack([take_ints(k * e, (k, e)),
+                            take_ints(k * e, (k, e))], axis=-1)
+    else:
+        committed = get_ok = found = value = vsn = None
+    return won, quorum_ok, corrupt, committed, get_ok, found, value, vsn
+
+
 class _LocalEngine:
     """Default engine adapter: the module kernels, single-process jit
     (data-parallel over whatever devices XLA picks).  A
@@ -329,6 +372,9 @@ class BatchedEnsembleService:
         self.wal_compact_records = wal_compact_records
         self._wal = None
         self._in_save = False
+        #: one-time flag: a WAL-enabled service served device-resident
+        #: execute() calls (which skip the WAL — see execute())
+        self._dev_exec_unlogged = False
         if data_dir is not None:
             from riak_ensemble_tpu import save as savelib
             from riak_ensemble_tpu.parallel.wal import ServiceWAL
@@ -1395,16 +1441,31 @@ class BatchedEnsembleService:
     def _launch(self, kind: np.ndarray, slot: np.ndarray,
                 val: np.ndarray, k: int, want_vsn: bool,
                 exp_e: Optional[np.ndarray] = None,
-                exp_s: Optional[np.ndarray] = None):
+                exp_s: Optional[np.ndarray] = None,
+                entries: Optional[List[List[Any]]] = None,
+                elect: Optional[np.ndarray] = None,
+                cand: Optional[np.ndarray] = None,
+                lease_ok: Optional[np.ndarray] = None):
         """One ``full_step`` launch + host bookkeeping shared by
         :meth:`flush` (future-based) and :meth:`execute` (bulk):
         elections folded in, lease check/renewal, corruption-driven
         exchange.  Returns np result arrays (vsn None unless asked —
         it is the largest transfer and bulk callers rarely need it).
+
+        ``entries`` is the flush's taken queue entries (None for bulk
+        execute); the base launch doesn't need them, but the
+        replicated subclass (:mod:`..parallel.repgroup`) ships their
+        key/payload metadata to its peer hosts.  ``elect``/``cand``/
+        ``lease_ok`` may be passed precomputed so a wrapper that must
+        OBSERVE the exact launch inputs (to replicate them) sees the
+        same vectors this launch consumes — recomputing lease_ok from
+        a later ``runtime.now`` could differ.
         """
-        elect, cand = self._election_inputs()
+        if elect is None:
+            elect, cand = self._election_inputs()
         now = self.runtime.now
-        lease_ok = self.lease_until > now
+        if lease_ok is None:
+            lease_ok = self.lease_until > now
 
         # Under async dispatch a device failure surfaces at the d2h
         # fetch BELOW, after self.state has been replaced with the
@@ -1481,39 +1542,9 @@ class BatchedEnsembleService:
         # dispatch means the block lands here); unpack filled below.
         self._lat_last = {"h2d": t1 - t0, "dispatch": t2 - t1,
                           "device_d2h": t3 - t2}
-        nbits = 2 * e + e * m + 3 * k * e
-        bits = np.unpackbits(flat[:(nbits + 7) // 8],
-                             count=nbits).astype(bool)
-        ints = flat[(nbits + 7) // 8:].copy().view(np.int32)
-        boff = ioff = 0
-
-        def take_bits(n, shape=None):
-            nonlocal boff
-            out = bits[boff:boff + n]
-            boff += n
-            return out.reshape(shape) if shape is not None else out
-
-        def take_ints(n, shape=None):
-            nonlocal ioff
-            out = ints[ioff:ioff + n]
-            ioff += n
-            return out.reshape(shape) if shape is not None else out
-
-        won_np = take_bits(e)
-        quorum_ok = take_bits(e)
-        corrupt_np = take_bits(e * m, (e, m))
+        (won_np, quorum_ok, corrupt_np, committed, get_ok, found,
+         value, vsn) = unpack_results(flat, e, m, k, want_vsn)
         corrupt = corrupt_np if k else None
-        if k:
-            committed = take_bits(k * e, (k, e))
-            get_ok = take_bits(k * e, (k, e))
-            found = take_bits(k * e, (k, e))
-            value = take_ints(k * e, (k, e))
-            vsn = None
-            if want_vsn:
-                vsn = np.stack([take_ints(k * e, (k, e)),
-                                take_ints(k * e, (k, e))], axis=-1)
-        else:
-            committed = get_ok = found = value = vsn = None
 
         # Host mirror: a won election installed our candidate.
         self.leader_np = np.where(won_np, cand, self.leader_np)
@@ -1630,6 +1661,7 @@ class BatchedEnsembleService:
                 (self._desired_mask | self._pending_mask
                  | self._queued_mask).sum()),
             "queued_ops": sum(self._queue_rounds),
+            "execute_unlogged": self._dev_exec_unlogged,
         }
 
     def execute(self, kind: np.ndarray, slot: np.ndarray,
@@ -1667,6 +1699,16 @@ class BatchedEnsembleService:
         in ARCHITECTURE).
         """
         if isinstance(kind, jax.Array):
+            if self._wal is not None and not self._dev_exec_unlogged:
+                # The durability contract weakens on this path (no WAL
+                # record; RPO = checkpoint cadence) purely because of
+                # the argument TYPE — make that observable once per
+                # service instead of silent (ADVICE r3): a trace event
+                # plus a stats() counter.
+                self._dev_exec_unlogged = True
+                self._emit("svc_execute_unlogged", {
+                    "reason": "device-resident op planes skip the WAL;"
+                              " RPO is the checkpoint cadence"})
             k = int(kind.shape[0])
             committed, get_ok, found, value, _ = self._launch(
                 kind, slot, val, k, want_vsn=False,
@@ -1765,7 +1807,8 @@ class BatchedEnsembleService:
 
         try:
             planes = self._launch(kind, slot, val, k, want_vsn=True,
-                                  exp_e=exp_e, exp_s=exp_s)
+                                  exp_e=exp_e, exp_s=exp_s,
+                                  entries=taken)
         except BaseException:
             # A failed device launch (XLA error, OOM, dead backend)
             # must not orphan the taken ops: clients would block on

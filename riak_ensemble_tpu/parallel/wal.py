@@ -209,6 +209,13 @@ class ServiceWAL:
             self._store.delete(key)
         if self.sync_mode == "fsync":
             self._store.sync()
+        else:
+            # Mirror log(): buffer mode still promises process-crash
+            # durability, and a destroy's kv deletions sitting in the
+            # userspace stdio buffer would die with the process — the
+            # destroyed tenant's records would replay into a recycled
+            # row (ADVICE r3).
+            self._flush_store()
 
     def records(self) -> List[Tuple[Any, Any]]:
         return [(k, self._store.fetch(k)) for k in self._store.keys()]

@@ -361,10 +361,15 @@ async def serve(n_ens: int, n_peers: int, n_slots: int,
                 host: str = "127.0.0.1", port: int = 0,
                 tick: float = 0.005,
                 config: Optional[Config] = None,
-                engine: Any = None, dynamic: bool = False,
+                engine: Any = None, dynamic: Optional[bool] = None,
                 data_dir: Optional[str] = None) -> ServiceServer:
     """Bring up runtime + service + server; returns the started
-    server (call ``await server.stop()`` to tear down)."""
+    server (call ``await server.stop()`` to tear down).
+
+    ``dynamic`` is tri-state: None (default) = no assertion — a
+    restore adopts the persisted lifecycle mode; True/False = the
+    caller's explicit assertion — a restore of a data_dir persisted
+    with the OTHER mode fails loudly (``_merge_dynamic``)."""
     runtime = NetRuntime("svc", {"svc": (host, 0)})
     runtime.loop = asyncio.get_running_loop()
     cfg = config if config is not None else Config()
@@ -374,14 +379,22 @@ async def serve(n_ens: int, n_peers: int, n_slots: int,
         # Operator restart: a data_dir with prior state RESTORES
         # (checkpoint + WAL replay) — a fresh service over an old WAL
         # would silently serve empty while poisoning the log.  The
-        # persisted shape wins over the CLI shape.
+        # persisted shape wins over the CLI shape, and the persisted
+        # lifecycle MODE wins unless the caller explicitly asserted
+        # one: restore() treats any present 'dynamic' kwarg as an
+        # explicit choice and fails loudly on mismatch, so forwarding
+        # an unasserted default would crash every restart of a
+        # --dynamic-persisted data_dir (ADVICE r3).  An explicit
+        # True OR False still forwards, keeping the loud error for
+        # genuinely contradictory assertions in both directions.
+        dyn_kw = {} if dynamic is None else {"dynamic": bool(dynamic)}
         svc = BatchedEnsembleService.restore(
             runtime, data_dir, tick=tick, config=cfg, engine=engine,
-            dynamic=dynamic, data_dir=data_dir)
+            data_dir=data_dir, **dyn_kw)
     else:
         svc = BatchedEnsembleService(
             runtime, n_ens, n_peers, n_slots, tick=tick, config=cfg,
-            engine=engine, dynamic=dynamic, data_dir=data_dir)
+            engine=engine, dynamic=bool(dynamic), data_dir=data_dir)
     server = ServiceServer(svc, host, port)
     await server.start()
     return server
@@ -397,9 +410,11 @@ def main(argv=None) -> int:
     ap.add_argument("--tick", type=float, default=0.005)
     ap.add_argument("--fast", action="store_true",
                     help="fast_test_config timeouts")
-    ap.add_argument("--dynamic", action="store_true",
+    ap.add_argument("--dynamic", action="store_true", default=None,
                     help="start with zero ensembles; clients create/"
-                         "destroy them at runtime")
+                         "destroy them at runtime (on restart of an "
+                         "existing --data-dir, omitting this adopts "
+                         "the persisted mode)")
     ap.add_argument("--data-dir", default=None,
                     help="durability root (WAL + checkpoints); acked "
                          "writes survive crashes")

@@ -240,3 +240,71 @@ def test_svcnode_batch_ops_over_the_wire():
         await server.stop()
 
     asyncio.run(scenario())
+
+
+def test_svcnode_restart_adopts_persisted_dynamic_mode(tmp_path):
+    """ADVICE r3 (medium): restarting a --dynamic-persisted data_dir
+    WITHOUT re-passing --dynamic must adopt the persisted mode (the
+    restore docstring's 'persisted lifecycle mode WINS'), not crash at
+    startup; an explicitly contradictory flag still fails loudly."""
+    data = str(tmp_path / "d")
+
+    async def first_boot():
+        server = await svcnode.serve(4, 3, 8, port=0,
+                                     config=fast_test_config(),
+                                     dynamic=True, data_dir=data)
+        c = svcnode.ServiceClient(server.host, server.port)
+        await c.connect()
+        assert (await c.create_ensemble("tenant"))[0] == "ok"
+        r = await c.resolve_ensemble("tenant")
+        assert r[0] == "ok"
+        ens = r[1]
+        assert (await c.kput(ens, "k", b"v"))[0] == "ok"
+        await c.close()
+        await server.stop()
+
+    async def restart_without_flag():
+        # the operator restart path: no dynamic flag at all
+        server = await svcnode.serve(4, 3, 8, port=0,
+                                     config=fast_test_config(),
+                                     data_dir=data)
+        assert server.svc.dynamic is True  # persisted mode adopted
+        c = svcnode.ServiceClient(server.host, server.port)
+        await c.connect()
+        r = await c.resolve_ensemble("tenant")
+        assert r[0] == "ok"
+        assert await c.kget(r[1], "k") == ("ok", b"v")
+        await c.close()
+        await server.stop()
+
+    asyncio.run(first_boot())
+    asyncio.run(restart_without_flag())
+
+    # a static-persisted dir restarted with an EXPLICIT --dynamic
+    # still errors loudly (the mismatch is a genuine operator bug)
+    static_dir = str(tmp_path / "s")
+
+    async def static_boot():
+        server = await svcnode.serve(2, 3, 4, port=0,
+                                     config=fast_test_config(),
+                                     data_dir=static_dir)
+        await server.stop()
+
+    async def conflicting_restart():
+        with pytest.raises(ValueError):
+            await svcnode.serve(2, 3, 4, port=0,
+                                config=fast_test_config(),
+                                dynamic=True, data_dir=static_dir)
+
+    # ...and the False direction: an embedder explicitly asserting
+    # static over a dynamic-persisted dir must ALSO error, not
+    # silently come up dynamic (the tri-state contract)
+    async def conflicting_static_assertion():
+        with pytest.raises(ValueError):
+            await svcnode.serve(4, 3, 8, port=0,
+                                config=fast_test_config(),
+                                dynamic=False, data_dir=data)
+
+    asyncio.run(static_boot())
+    asyncio.run(conflicting_restart())
+    asyncio.run(conflicting_static_assertion())

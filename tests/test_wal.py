@@ -488,3 +488,55 @@ def test_crash_point_fuzz_no_acked_write_lost(tmp_path, seed):
         assert got[1] == want, \
             f"acked write lost/stale at {(e, key)}: {got[1]!r} != {want!r}"
     svc2.stop()
+
+
+def test_buffer_mode_delete_reaches_kernel_before_ack(tmp_path,
+                                                      monkeypatch):
+    """ADVICE r3: delete() must honor buffer mode's process-crash
+    floor exactly like log() — a destroy's kv deletions sitting in the
+    userspace stdio buffer would die with the process and replay the
+    destroyed tenant's records into a recycled row."""
+    from riak_ensemble_tpu.synctree import native_store
+
+    monkeypatch.setattr(native_store, "available", lambda: False)
+    w = ServiceWAL(str(tmp_path / "w"), sync_mode="buffer")
+    w.log([(("kv", 0, 0), ("k", 1, 1, 1, b"v", False))])
+    w.delete([("kv", 0, 0)])
+    # a fresh reader of the same file (no close on the writer!)
+    rd = PyLogStore(os.path.join(str(tmp_path / "w"), "wal"))
+    assert rd.fetch(("kv", 0, 0)) is None, \
+        "buffered deletion never reached the kernel"
+    rd.close()
+    w.close()
+
+
+def test_device_resident_execute_unlogged_is_observable(tmp_path):
+    """ADVICE r3: a WAL-enabled service serving device-resident
+    execute() calls silently weakens the durability contract (no WAL
+    record; RPO = checkpoint cadence).  That must be observable: a
+    one-time trace event plus a stats() flag."""
+    import jax.numpy as jnp
+
+    from riak_ensemble_tpu.ops import engine as eng
+
+    events = []
+    runtime, svc = make_durable(tmp_path)
+    runtime.trace = lambda kind, payload: events.append((kind, payload))
+    k = 2
+    kind = jnp.full((k, svc.n_ens), eng.OP_PUT, jnp.int32)
+    slot = jnp.zeros((k, svc.n_ens), jnp.int32)
+    val = jnp.ones((k, svc.n_ens), jnp.int32)
+    assert svc.stats()["execute_unlogged"] is False
+    svc.execute(kind, slot, val)
+    svc.execute(kind, slot, val)
+    unlogged = [e for e in events if e[0] == "svc_execute_unlogged"]
+    assert len(unlogged) == 1, "exactly one one-time trace event"
+    assert svc.stats()["execute_unlogged"] is True
+    # host-array calls still WAL-log: the flag marks the weaker path's
+    # use, it does not disable durability for the strong one
+    before = svc._wal.count
+    svc.execute(np.full((1, svc.n_ens), eng.OP_PUT, np.int32),
+                np.zeros((1, svc.n_ens), np.int32),
+                np.full((1, svc.n_ens), 7, np.int32))
+    assert svc._wal.count > before
+    svc.stop()
