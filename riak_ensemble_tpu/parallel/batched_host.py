@@ -811,6 +811,83 @@ class BatchedEnsembleService:
         self._recycle_on_ok(fut, ens, key, slot)
         return fut
 
+    def kmodify(self, ens: int, key: Any, mod_fun: Any, default: Any,
+                retries: int = 8) -> Future:
+        """Server-side modify — the batched analog of the put FSM's
+        kmodify (do_kmodify, peer.erl:303-317; modify FSM
+        :1404-1416): read the key, apply ``mod_fun`` to the current
+        value (``default`` when absent), and commit the result under
+        the read version's CAS guard, retrying the whole
+        read→fn→CAS cycle on conflict (another writer's commit landed
+        between our read and our write — the seq discipline the
+        reference gets from running the fun inside the leader's FSM).
+
+        ``mod_fun`` is a callable or a wire-safe funref
+        (:mod:`riak_ensemble_tpu.funref`), called as
+        ``mod_fun(vsn, current_value) -> new_value | "failed"`` —
+        the actor plane's signature, except ``vsn`` is the version
+        the value was READ at, not the prospective commit version
+        (the batched engine assigns versions on device at commit
+        time, so they are unknowable host-side; root-style vsn-pinned
+        merges use the CAS guard itself for that).  Returning
+        "failed" (or raising) aborts without writing.  Resolves
+        ('ok', new_vsn) | 'failed'.
+
+        The chain rides the normal flush cadence: each attempt's read
+        and CAS are ordinary queued ops, so concurrent kmodifys of
+        one key serialize through device-round order and the losers
+        retry — N concurrent increments converge to exactly +N.
+        """
+        from riak_ensemble_tpu import funref
+
+        fut = Future()
+        try:
+            fn = funref.resolve(mod_fun)
+        except ValueError:
+            fut.resolve("failed")
+            return fut
+        if self._dead(ens):
+            fut.resolve("failed")
+            return fut
+
+        def attempt(tries_left: int) -> None:
+            g = self.kget_vsn(ens, key)
+
+            def on_read(res: Any) -> None:
+                if fut.done:
+                    return
+                if not (isinstance(res, tuple) and res[0] == "ok"):
+                    self._safe_resolve(fut, "failed")
+                    return
+                cur, vsn = res[1], tuple(res[2])
+                try:
+                    new = fn(vsn, default if cur is NOTFOUND else cur)
+                except Exception:
+                    import traceback
+                    self._emit("svc_kmodify_error",
+                               {"error": traceback.format_exc(limit=8)})
+                    self._safe_resolve(fut, "failed")
+                    return
+                if isinstance(new, str) and new == "failed":
+                    self._safe_resolve(fut, "failed")
+                    return
+                c = self.kupdate(ens, key, vsn, new)
+
+                def on_cas(r: Any) -> None:
+                    if fut.done:
+                        return
+                    if isinstance(r, tuple) and r[0] == "ok":
+                        self._safe_resolve(fut, r)
+                    elif tries_left > 1:
+                        attempt(tries_left - 1)
+                    else:
+                        self._safe_resolve(fut, "failed")
+                c.add_waiter(on_cas)
+            g.add_waiter(on_read)
+
+        attempt(max(1, retries))
+        return fut
+
     def _recycle_on_ok(self, fut: Future, ens: int, key: Any,
                        slot: int) -> None:
         """Once a delete commits, queue the slot for deferred
@@ -1738,7 +1815,7 @@ class BatchedEnsembleService:
                       int(vsn[j, e, 1]), None, True))
                     for j, e in zip(js.tolist(), es.tolist())]
             if recs:
-                self._wal.log(recs)
+                self._wal.log(recs + self._wal_extra_records())
         self.ops_served += int((np.asarray(kind) != eng.OP_NOOP).sum())
         return committed, get_ok, found, value
 
@@ -1869,6 +1946,14 @@ class BatchedEnsembleService:
             self.scrub()
         return served
 
+    def _wal_extra_records(self) -> List[Tuple[Any, Any]]:
+        """Records a subclass wants persisted in the SAME durability
+        barrier as a flush's committed writes (one log() call = one
+        sync) — the replication group rides its (epoch, seq) meta
+        here so a leader restart can never mistake its own data-
+        bearing position for an older one."""
+        return []
+
     def _log_wal(self, taken, planes) -> None:
         """Append this flush's committed client writes to the WAL
         (latest record per (ens, slot)); called BEFORE any future
@@ -1906,7 +1991,7 @@ class BatchedEnsembleService:
                                  (op.key, op.handle, ve, vs, payload,
                                   False)))
         if recs:
-            self._wal.log(recs)
+            self._wal.log(recs + self._wal_extra_records())
 
     def _safe_resolve(self, fut: Future, result: Any) -> None:
         """Resolve a client future, containing waiter exceptions:
@@ -1962,7 +2047,7 @@ class BatchedEnsembleService:
         self._safe_resolve(op.fut, "failed")
 
     def _resolve_batch(self, e: int, j: int, op: _PendingBatch,
-                       planes, ack: bool) -> None:
+                       planes, ack: bool, ack_reads: bool = True) -> None:
         """Resolve one batch entry from result-plane column slices —
         the vectorized counterpart of the per-op resolve loop."""
         committed, get_ok, found, value, vsn = planes
@@ -1998,7 +2083,7 @@ class BatchedEnsembleService:
             vs_l = vsn[j:j + n, e].tolist() if op.want_vsn else None
             values = self.values
             for i in range(n):
-                if ok_l[i]:
+                if ok_l[i] and ack_reads:
                     v = val_l[i]
                     out = (values.get(v, NOTFOUND)
                            if found_l[i] and v != 0 else NOTFOUND)
@@ -2009,11 +2094,16 @@ class BatchedEnsembleService:
         op.accum.fill(op.fut, op.pos.tolist(), results,
                       self._safe_resolve)
 
-    def _resolve_flush(self, taken, planes, ack: bool = True) -> int:
+    def _resolve_flush(self, taken, planes, ack: bool = True,
+                       ack_reads: bool = True) -> int:
         """Resolve every taken op from the result planes.  With
         ``ack=False`` (the WAL write failed) committed writes keep
         their device-side bookkeeping — the commit is real — but
-        resolve 'failed': an ack may never outrun the disk."""
+        resolve 'failed': an ack may never outrun the disk.  Reads
+        don't need the disk, so they survive ``ack=False``;
+        ``ack_reads=False`` fails them too — the replication group
+        uses it when the HOST quorum was lost, where serving a read
+        would mean a minority/deposed leader answering clients."""
         committed, get_ok, found, value, vsn = planes
 
         # Per-op resolve loop: convert the result planes to plain
@@ -2039,7 +2129,8 @@ class BatchedEnsembleService:
             j = -1
             for op in ops:
                 if isinstance(op, _PendingBatch):
-                    self._resolve_batch(e, j + 1, op, planes, ack)
+                    self._resolve_batch(e, j + 1, op, planes, ack,
+                                        ack_reads)
                     served += op.n
                     j += op.n
                     continue
@@ -2061,7 +2152,7 @@ class BatchedEnsembleService:
                     else:
                         self._fail_op(e, op)
                 else:
-                    if get_ok_l[j][e]:
+                    if get_ok_l[j][e] and ack_reads:
                         v = value_l[j][e]
                         out = (self.values.get(v, NOTFOUND)
                                if found_l[j][e] and v != 0

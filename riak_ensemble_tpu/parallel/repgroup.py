@@ -1,0 +1,1164 @@
+"""Replica quorum across OS-process failure domains — the scale path's
+availability story.
+
+The reference's availability model is M peers on M *machines*: every
+commit's quorum crosses node boundaries
+(``riak_ensemble_msg.erl:132-142`` sends to remote pids; one process
+hierarchy per node, ``doc/Readme.md:49-63``), so a machine dying
+neither loses acked data nor stops service.  The batched service held
+all M replica "lanes" of an ensemble in ONE process's device arrays —
+durable (WAL) but not available across a host death.  This module
+closes that gap with a **replication group**:
+
+- **N host processes**, each holding a single-peer engine shard
+  (``n_peers=1``) of ALL the group's ensembles plus its own
+  :class:`~riak_ensemble_tpu.parallel.wal.ServiceWAL`.  One host is
+  the **leader** (the client-facing
+  :class:`ReplicatedService`); the rest run :class:`ReplicaServer`.
+- Every device launch the leader performs — the whole ``[K, E]`` op
+  plane, the election vector, the lease vector — is **shipped to every
+  replica host over the restricted wire codec** and applied through
+  the same jitted kernels.  Identical inputs over identical state make
+  the lanes bit-equal by induction (all-int32 kernels), which is what
+  lets ONE batched protocol replace per-op consensus messages: the
+  cross-host agreement is per *launch*, amortized over every op in it
+  (the msg.erl fan-out/collect as one frame per host per flush).
+- **The commit barrier is a host-level quorum of WAL-persisted
+  acks**: each replica fsyncs the batch's committed records before
+  acking, and the leader resolves client futures as 'ok' only when
+  ``1 + acks >= majority(group)`` — otherwise every op in the flush
+  resolves 'failed' while the device-side bookkeeping stands (the
+  unacked-commit ambiguity the reference also allows under timeout).
+- **Epoch/seq fencing** (the vertical-Paxos shape of the reference's
+  epoch discipline, peer.erl:877-885): applies carry a group epoch and
+  a batch sequence; a replica accepts seq N+1 at its promised epoch
+  only.  Leader takeover = promise round to a majority (grants persist
+  before they're answered), adopt the newest ``(epoch, seq)`` state
+  among the grants, bump the epoch — after which the old leader's
+  straggler applies are nacked and it steps down (the sc.erl
+  partition premise, test/sc.erl:1012-1036).
+- **Catch-up**: a restarted or diverged replica is re-synced with a
+  full state snapshot (engine arrays + keyed host mirrors) pushed by
+  the leader, then rides the apply stream again.  Divergence is
+  *detected*, not assumed: every ack carries a CRC of the result
+  planes and a mismatch marks the replica for re-sync (a cross-host
+  integrity check the reference's disterl transport never had).
+
+Determinism notes (why lanes stay bit-equal): election and lease
+vectors are computed once by the leader and shipped verbatim (a
+replica recomputing ``lease_ok`` from its own clock could disagree);
+payload handles are allocated by the leader and ride in the frame;
+all kernels are int32 (no float nondeterminism).  Physical corruption
+on one host is by nature non-deterministic — it surfaces as a CRC
+mismatch and heals through re-sync.
+
+v1 scope: the group's host set is fixed at construction (the
+dynamic-membership story lives in the single-process service and the
+actor plane); every ensemble's member set is the full host set.
+
+Wire protocol (length-prefixed frames, :mod:`riak_ensemble_tpu.wire`):
+
+    leader -> replica
+      ("hello", ge)                     handshake on (re)connect
+      ("promise", ge)                   takeover prepare
+      ("pull",)                         fetch full state (new leader)
+      ("install", ge, seq, state)       push full state (re-sync)
+      ("apply", ge, seq, k, want_vsn, elect, lease, kind, slot, val,
+       exp_e, exp_s, meta)              one launch; meta = put-lane
+                                        (round, ens, key, handle,
+                                        payload) records
+      ("promote", peers, tick)          control: become the leader
+      ("status",)                       control: role/epoch/seq
+    replica -> leader
+      ("helloed", promised, applied_ge, applied_seq)
+      ("promised", granted, promised, applied_ge, applied_seq)
+      ("state", ge, seq, state) | ("installed", ge, seq)
+      ("applied", ge, seq, crc) | ("nack", why, promised, age, aseq)
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import struct
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from riak_ensemble_tpu import wire
+from riak_ensemble_tpu.config import Config
+from riak_ensemble_tpu.ops import engine as eng
+from riak_ensemble_tpu.parallel.batched_host import (
+    BatchedEnsembleService, WallRuntime, _PendingBatch)
+
+_HDR = struct.Struct(">I")
+#: install frames carry full engine-state snapshots
+_MAX_FRAME = 256 << 20
+
+
+class DeposedError(RuntimeError):
+    """This leader's group epoch was superseded — stop serving."""
+
+
+# -- framing -----------------------------------------------------------------
+
+def send_frame(sock: socket.socket, value: Any) -> None:
+    payload = wire.encode(value)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket) -> Any:
+    head = _recv_exact(sock, _HDR.size)
+    (length,) = _HDR.unpack(head)
+    if length > _MAX_FRAME:
+        raise wire.WireError(f"frame too large: {length}")
+    return wire.decode(_recv_exact(sock, length))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+# -- plane / result codecs ---------------------------------------------------
+
+def _pack_bool(v: np.ndarray) -> bytes:
+    return np.packbits(np.asarray(v, bool)).tobytes()
+
+
+def _unpack_bool(b: bytes, n: int) -> np.ndarray:
+    return np.unpackbits(np.frombuffer(b, np.uint8), count=n).astype(bool)
+
+
+def _pack_i32(v: Optional[np.ndarray]) -> Optional[bytes]:
+    return None if v is None else np.asarray(v, np.int32).tobytes()
+
+
+def _unpack_i32(b: Optional[bytes], shape) -> Optional[np.ndarray]:
+    if b is None:
+        return None
+    return np.frombuffer(b, np.int32).reshape(shape).copy()
+
+
+def result_crc(committed: Optional[np.ndarray],
+               vsn: Optional[np.ndarray]) -> int:
+    """CRC of the launch's commit/version outcome — the cross-host
+    divergence detector carried in every ack."""
+    crc = 0
+    if committed is not None:
+        crc = zlib.crc32(np.packbits(committed).tobytes(), crc)
+    if vsn is not None:
+        crc = zlib.crc32(np.ascontiguousarray(vsn).tobytes(), crc)
+    return crc
+
+
+# -- state snapshot ----------------------------------------------------------
+
+def dump_state(svc: BatchedEnsembleService) -> Tuple:
+    """Full snapshot of one host's lane: every engine array plus the
+    keyed host mirrors a promoted leader needs.  Wire-safe (no
+    pickle: the group transport keeps the no-code-on-decode trust
+    model of the cluster transport)."""
+    fields = []
+    for name, arr in zip(eng.EngineState._fields, svc.state):
+        a = np.asarray(arr)
+        fields.append((name, a.dtype.str, list(a.shape), a.tobytes()))
+    host = (
+        [list(ks.items()) for ks in svc.key_slot],
+        [list(sh.items()) for sh in svc.slot_handle],
+        list(svc.values.items()),
+        int(svc._next_handle),
+        _pack_i32(svc.leader_np),
+    )
+    return (tuple(fields), host)
+
+
+def install_state(svc: BatchedEnsembleService, dump: Tuple) -> None:
+    """Inverse of :func:`dump_state`: make this host's lane bit-equal
+    to the dumped one.  Derived host structures (free slots, slot
+    generations) are recomputed — they are per-process queue
+    bookkeeping, not replicated state."""
+    import jax.numpy as jnp
+
+    fields, host = dump
+    by_name = {name: (dt, shape, raw) for name, dt, shape, raw in fields}
+    new = {}
+    for name in eng.EngineState._fields:
+        dt, shape, raw = by_name[name]
+        new[name] = jnp.asarray(
+            np.frombuffer(raw, np.dtype(dt)).reshape(shape))
+    svc.state = eng.EngineState(**new)
+    key_slot, slot_handle, values, next_handle, leader_b = host
+    svc.key_slot = [dict(pairs) for pairs in key_slot]
+    svc.slot_handle = [{int(s): int(h) for s, h in pairs}
+                       for pairs in slot_handle]
+    svc.values = {int(h): v for h, v in values}
+    svc._next_handle = int(next_handle)
+    svc._free_handles = []
+    svc.leader_np = _unpack_i32(leader_b, (svc.n_ens,))
+    rebuild_derived(svc)
+
+
+def rebuild_derived(svc: BatchedEnsembleService) -> None:
+    """Recompute free-slot lists / slot generations from the keyed
+    mirrors (used after install and before a replica checkpoints —
+    replicas don't maintain them incrementally)."""
+    for e in range(svc.n_ens):
+        used = set(svc.key_slot[e].values())
+        svc.free_slots[e] = [s for s in range(svc.n_slots)
+                             if s not in used]
+        svc.slot_gen[e] = {}
+        svc._recycle_pending[e] = []
+
+
+# -- group metadata persistence ----------------------------------------------
+
+_GRP_KEY = ("grp",)
+
+
+def load_group_meta(svc: BatchedEnsembleService) -> Tuple[int, int, int]:
+    """(promised_ge, applied_ge, applied_seq) from the WAL, or zeros."""
+    if svc._wal is None:
+        return (0, 0, 0)
+    for key, value in svc._wal.records():
+        if key == _GRP_KEY:
+            return (int(value[0]), int(value[1]), int(value[2]))
+    return (0, 0, 0)
+
+
+def save_group_meta(svc: BatchedEnsembleService, promised: int,
+                    applied_ge: int, applied_seq: int) -> None:
+    if svc._wal is not None:
+        svc._wal.log([(_GRP_KEY, (promised, applied_ge, applied_seq))])
+
+
+# -- apply-frame construction ------------------------------------------------
+
+def _entries_meta(entries, kind: np.ndarray, slot: np.ndarray,
+                  values: Dict[int, Any]) -> List[Tuple]:
+    """Put/CAS lane metadata for the replicas' WALs and keyed mirrors:
+    (round j, ensemble e, key, handle, payload).  Mirrors the
+    iteration order of ``_log_wal`` so rounds line up with the op
+    planes."""
+    meta: List[Tuple] = []
+    if entries is None:
+        return meta
+    for e, ops in enumerate(entries):
+        j = -1
+        for op in ops:
+            if isinstance(op, _PendingBatch):
+                if op.kind in (eng.OP_PUT, eng.OP_CAS):
+                    for i in range(op.n):
+                        h = int(op.handle[i])
+                        key = op.keys[i] if op.keys is not None else None
+                        meta.append((j + 1 + i, e, key, h,
+                                     values.get(h) if h else None))
+                j += op.n
+                continue
+            j += 1
+            if op.kind in (eng.OP_PUT, eng.OP_CAS):
+                meta.append((j, e, op.key, op.handle,
+                             values.get(op.handle) if op.handle
+                             else None))
+    return meta
+
+
+def build_apply_frame(ge: int, seq: int, k: int, want_vsn: bool,
+                      elect: np.ndarray, lease_ok: np.ndarray,
+                      kind: np.ndarray, slot: np.ndarray,
+                      val: np.ndarray, exp_e: Optional[np.ndarray],
+                      exp_s: Optional[np.ndarray],
+                      meta: List[Tuple]) -> Tuple:
+    return ("apply", ge, seq, k, want_vsn, _pack_bool(elect),
+            _pack_bool(lease_ok), _pack_i32(kind), _pack_i32(slot),
+            _pack_i32(val), _pack_i32(exp_e), _pack_i32(exp_s), meta)
+
+
+# -- replica-side apply ------------------------------------------------------
+
+class ReplicaCore:
+    """One host's lane + group metadata: the apply/install/promise
+    logic shared by the standalone :class:`ReplicaServer` process and
+    a :class:`ReplicatedService` acting as its own replica zero."""
+
+    def __init__(self, svc: BatchedEnsembleService) -> None:
+        self.svc = svc
+        self.promised, self.applied_ge, self.applied_seq = \
+            load_group_meta(svc)
+        self.last_crc = 0
+
+    def handle_promise(self, ge: int) -> Tuple:
+        """Grant iff strictly newer; the grant persists BEFORE it is
+        answered (a granted promise that didn't survive a crash would
+        let a deposed leader commit after our restart)."""
+        if ge > self.promised:
+            self.promised = ge
+            save_group_meta(self.svc, self.promised, self.applied_ge,
+                            self.applied_seq)
+            return ("promised", True, self.promised, self.applied_ge,
+                    self.applied_seq)
+        return ("promised", False, self.promised, self.applied_ge,
+                self.applied_seq)
+
+    def handle_apply(self, frame: Tuple) -> Tuple:
+        (_, ge, seq, k, want_vsn, elect_b, lease_b, kind_b, slot_b,
+         val_b, exp_e_b, exp_s_b, meta) = frame
+        svc = self.svc
+        e_n = svc.n_ens
+        if ge != self.promised or ge < self.applied_ge:
+            return ("nack", "epoch", self.promised, self.applied_ge,
+                    self.applied_seq)
+        if seq == self.applied_seq and ge == self.applied_ge:
+            # retransmit of the batch we just applied (ack was lost)
+            return ("applied", ge, seq, self.last_crc)
+        if seq != self.applied_seq + 1:
+            return ("nack", "seq", self.promised, self.applied_ge,
+                    self.applied_seq)
+
+        elect = _unpack_bool(elect_b, e_n)
+        lease_ok = _unpack_bool(lease_b, e_n)
+        kind = _unpack_i32(kind_b, (k, e_n))
+        slot = _unpack_i32(slot_b, (k, e_n))
+        val = _unpack_i32(val_b, (k, e_n))
+        exp_e = _unpack_i32(exp_e_b, (k, e_n))
+        exp_s = _unpack_i32(exp_s_b, (k, e_n))
+        cand = np.zeros((e_n,), np.int32)
+        # unbound base call: a ReplicatedService in the replica role
+        # must apply through the PLAIN launch (its own override would
+        # try to re-replicate / demand leadership)
+        committed, _get_ok, _found, _value, vsn = \
+            BatchedEnsembleService._launch(
+                svc, kind, slot, val, k, want_vsn=want_vsn,
+                exp_e=exp_e, exp_s=exp_s, elect=elect, cand=cand,
+                lease_ok=lease_ok)
+        crc = result_crc(committed, vsn)
+
+        # Durability barrier: this host's WAL carries every committed
+        # record of the batch BEFORE the ack that lets the leader
+        # count us toward the commit quorum.  One log() call = one
+        # sync for batch + group meta.
+        recs: List[Tuple[Any, Any]] = []
+        committed_l = committed.tolist() if committed is not None else []
+        for j, e, key, handle, payload in meta:
+            if not committed_l[j][e]:
+                continue
+            ve, vs = (int(vsn[j, e, 0]), int(vsn[j, e, 1])) \
+                if vsn is not None else (0, 0)
+            recs.append((("kv", e, int(slot[j, e])),
+                         (key, handle, ve, vs, payload, False)))
+            self._mirror_write(e, key, int(slot[j, e]), handle, payload)
+        self.applied_ge, self.applied_seq = ge, seq
+        self.last_crc = crc
+        recs.append((_GRP_KEY, (self.promised, ge, seq)))
+        if svc._wal is not None:
+            svc._wal.log(recs)
+            if svc._wal.count >= svc.wal_compact_records:
+                rebuild_derived(svc)
+                svc.save()
+                # save() rotated to an EMPTY WAL generation: the group
+                # meta must survive into it, or a crash before the
+                # next apply restarts this host amnesiac about its
+                # promise — an old-epoch leader could then count it
+                # into a quorum while the new-epoch leader commits
+                # elsewhere (review r4: split-brain via compaction).
+                save_group_meta(svc, self.promised, ge, seq)
+        return ("applied", ge, seq, crc)
+
+    def _mirror_write(self, e: int, key: Any, slot: int, handle: int,
+                      payload: Any) -> None:
+        """Keep the keyed host mirrors live on the replica so a
+        promoted leader can serve keyed ops without a WAL rescan."""
+        svc = self.svc
+        old = svc.slot_handle[e].pop(slot, 0)
+        if old and old != handle:
+            svc.values.pop(old, None)
+        if handle:
+            svc.values[handle] = payload
+            svc.slot_handle[e][slot] = handle
+            if key is not None:
+                svc.key_slot[e][key] = slot
+            if handle >= svc._next_handle:
+                svc._next_handle = handle + 1
+        else:
+            if key is not None:
+                svc.key_slot[e].pop(key, None)
+
+    def handle_install(self, frame: Tuple) -> Tuple:
+        _, ge, seq, dump = frame
+        if ge < self.promised:
+            return ("nack", "epoch", self.promised, self.applied_ge,
+                    self.applied_seq)
+        install_state(self.svc, dump)
+        self.promised = max(self.promised, ge)
+        self.applied_ge, self.applied_seq = ge, seq
+        self.last_crc = 0
+        save_group_meta(self.svc, self.promised, ge, seq)
+        if self.svc.data_dir is not None:
+            # checkpoint the installed state so our own restart
+            # restores it (save() rotates the WAL generation)
+            self.svc.save()
+            save_group_meta(self.svc, self.promised, ge, seq)
+        return ("installed", ge, seq)
+
+    def handle_pull(self) -> Tuple:
+        rebuild_derived(self.svc)
+        return ("state", self.applied_ge, self.applied_seq,
+                dump_state(self.svc))
+
+
+# -- leader-side peer link ---------------------------------------------------
+
+class _Ticket:
+    __slots__ = ("event", "result")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.result: Any = None
+
+
+class PeerLink:
+    """Leader's connection to one replica host: a worker thread owning
+    a blocking socket, lockstep request/response (one outstanding
+    frame), automatic reconnect with handshake.  A link that has ever
+    missed/failed anything is ``needs_sync`` until an install
+    succeeds — conservative, because an out-of-date replica acking
+    nothing is merely slow, while an out-of-date replica counted into
+    a quorum is data loss."""
+
+    RECONNECT_DELAY = 0.2
+
+    def __init__(self, host: str, port: int, get_epoch) -> None:
+        self.host, self.port = host, port
+        self._get_epoch = get_epoch
+        self.connected = False
+        self.needs_sync = True
+        #: at most one in-flight state snapshot; consumed (not waited
+        #: on) by a later flush — installs never block the commit path
+        self.install_ticket: Optional[_Ticket] = None
+        self.remote_state: Tuple[int, int, int] = (0, 0, 0)
+        self._q: "queue.Queue[Optional[Tuple[Tuple, _Ticket]]]" = \
+            queue.Queue()
+        self._sock: Optional[socket.socket] = None
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def post(self, frame: Tuple) -> _Ticket:
+        t = _Ticket()
+        self._q.put((frame, t))
+        return t
+
+    @staticmethod
+    def wait(ticket: _Ticket, deadline: float) -> Any:
+        if ticket.event.wait(max(0.0, deadline - time.monotonic())):
+            return ticket.result
+        return None
+
+    def close(self) -> None:
+        self._stop = True
+        self._q.put(None)
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- worker -------------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop:
+            item = self._q.get()
+            if item is None:
+                continue
+            frame, ticket = item
+            try:
+                self._ensure_connected()
+                send_frame(self._sock, frame)
+                ticket.result = recv_frame(self._sock)
+            except (OSError, ConnectionError, wire.WireError):
+                self._drop()
+            ticket.event.set()
+
+    #: per-operation socket timeout: generous enough for an install
+    #: (state transfer + replica-side checkpoint), bounded so a
+    #: SIGSTOP'd/partitioned peer can't wedge the worker forever
+    IO_TIMEOUT = 120.0
+
+    def _ensure_connected(self) -> None:
+        if self.connected and self._sock is not None:
+            return
+        self._sock = socket.create_connection(
+            (self.host, self.port), timeout=10.0)
+        self._sock.settimeout(self.IO_TIMEOUT)
+        send_frame(self._sock, ("hello", self._get_epoch()))
+        resp = recv_frame(self._sock)
+        if resp[0] != "helloed":
+            raise ConnectionError(f"bad handshake: {resp!r}")
+        self.remote_state = (int(resp[1]), int(resp[2]), int(resp[3]))
+        self.connected = True
+        # any (re)connect is conservative: re-sync before counting
+        self.needs_sync = True
+
+    def _drop(self) -> None:
+        self.connected = False
+        self.needs_sync = True
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        if not self._stop:
+            time.sleep(self.RECONNECT_DELAY)
+
+
+# -- the replicated service (leader role) ------------------------------------
+
+class ReplicatedService(BatchedEnsembleService):
+    """A batched service whose commit barrier spans OS-process failure
+    domains.
+
+    Construct with ``peers=[(host, port), ...]`` (the OTHER replica
+    hosts' replication ports) and call :meth:`takeover` to establish
+    leadership before serving.  Without peers it behaves as a plain
+    single-lane service (the replica role drives it through
+    :class:`ReplicaCore` instead).
+
+    The client surface (kput/kget/... and the vectorized/batch forms)
+    is inherited unchanged — replication happens inside ``_launch``,
+    and the host-quorum outcome gates future resolution through
+    ``_resolve_flush`` exactly like the local WAL barrier does.
+    """
+
+    def __init__(self, runtime, n_ens: int, n_peers: int = 1,
+                 n_slots: int = 128, group_size: int = 1,
+                 peers: Sequence[Tuple[str, int]] = (),
+                 ack_timeout: float = 2.0,
+                 install_timeout: float = 60.0,
+                 **kw) -> None:
+        # the (runtime, n_ens, n_peers, n_slots) positional prefix
+        # matches the base class so restore() reconstructs us from a
+        # persisted shape; the lane is always single-peer (the OTHER
+        # peers are the group's hosts)
+        assert n_peers == 1, "a replication-group lane has n_peers=1"
+        kw.setdefault("tick", None)
+        super().__init__(runtime, n_ens, 1, n_slots, **kw)
+        assert group_size >= 1
+        self.group_size = group_size
+        self.ack_timeout = ack_timeout
+        self.install_timeout = install_timeout
+        self.core = ReplicaCore(self)
+        self._ge = self.core.applied_ge
+        self._grp_seq = self.core.applied_seq
+        self._deposed = False
+        self._is_leader = False
+        self._last_quorum_ok = True
+        self._links: List[PeerLink] = [
+            PeerLink(h, p, lambda: self._ge) for h, p in peers]
+        #: replication observability
+        self.group_stats = {"applies": 0, "quorum_failures": 0,
+                            "resyncs": 0, "depositions": 0}
+
+    # -- leadership ---------------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader and not self._deposed
+
+    def attach_peers(self, peers: Sequence[Tuple[str, int]]) -> None:
+        assert not self._links, "peers already attached"
+        self._links = [PeerLink(h, p, lambda: self._ge) for h, p in peers]
+
+    def takeover(self, timeout: float = 30.0) -> bool:
+        """Establish leadership: promise round to a majority, adopt
+        the newest ``(epoch, seq)`` state among the grants, bump the
+        group epoch.  Returns True on success; False when no majority
+        granted (insufficient reachable replicas — the group cannot
+        safely elect, exactly the minority-partition case)."""
+        deadline = time.monotonic() + timeout
+        ge = max(self._ge, self.core.promised) + 1
+        majority = self.group_size // 2 + 1
+        while time.monotonic() < deadline:
+            tickets = [(l, l.post(("promise", ge))) for l in self._links]
+            grants: List[Tuple[PeerLink, int, int]] = []
+            highest = ge
+            for link, t in tickets:
+                r = PeerLink.wait(t, min(deadline,
+                                         time.monotonic()
+                                         + self.ack_timeout))
+                if r is None or r[0] != "promised":
+                    continue
+                _, granted, promised, age, aseq = r
+                highest = max(highest, int(promised))
+                if granted:
+                    grants.append((link, int(age), int(aseq)))
+            # self-grant: our own lane participates (it holds state)
+            if 1 + len(grants) < majority:
+                # keep trying until the deadline, always at a FRESH
+                # epoch: this round's grants consumed the current one
+                # (promises are strictly increasing), so re-proposing
+                # it can never succeed (review r4)
+                ge = max(highest, ge) + 1
+                time.sleep(0.2)
+                continue
+            # adopt the newest state among the quorum (self included)
+            best = max(grants, key=lambda g: (g[1], g[2]), default=None)
+            if best is not None and \
+                    (best[1], best[2]) > (self.core.applied_ge,
+                                          self.core.applied_seq):
+                link = best[0]
+                t = link.post(("pull",))
+                r = PeerLink.wait(t, time.monotonic()
+                                  + self.install_timeout)
+                if r is None or r[0] != "state":
+                    # source died mid-pull; retry with a HIGHER epoch:
+                    # the round's grants consumed this one (promises
+                    # are strictly increasing), so re-proposing it
+                    # could never gather a majority again (review r4)
+                    ge += 1
+                    continue
+                _, age, aseq, dump = r
+                install_state(self, dump)
+                self.core.applied_ge = int(age)
+                self.core.applied_seq = int(aseq)
+                # the source is NOT stale relative to us
+                link.needs_sync = False
+                link.remote_state = (ge, int(age), int(aseq))
+            self._ge = ge
+            self._grp_seq = self.core.applied_seq
+            self.core.promised = ge
+            save_group_meta(self, ge, self.core.applied_ge,
+                            self._grp_seq)
+            # links whose promise reported our adopted (ge, seq) hold
+            # bit-equal state (same applied prefix) — no re-sync
+            for link, age, aseq in grants:
+                if (age, aseq) == (self.core.applied_ge,
+                                   self._grp_seq):
+                    link.needs_sync = False
+            self._deposed = False
+            self._is_leader = True
+            self._emit("grp_takeover", {"epoch": ge,
+                                        "seq": self._grp_seq})
+            return True
+        return False
+
+    # -- the replicated launch ----------------------------------------------
+
+    def _launch(self, kind, slot, val, k, want_vsn,
+                exp_e=None, exp_s=None, entries=None, elect=None,
+                cand=None, lease_ok=None):
+        if not self._links and self.group_size == 1:
+            return super()._launch(kind, slot, val, k, want_vsn,
+                                   exp_e, exp_s, entries, elect, cand,
+                                   lease_ok)
+        if not self.is_leader:
+            raise DeposedError(
+                "not the group leader (takeover() not run, or this "
+                "epoch was superseded)")
+        if elect is None:
+            elect, cand = self._election_inputs()
+        if lease_ok is None:
+            lease_ok = self.lease_until > self.runtime.now
+
+        # device-resident planes must be host arrays to ship
+        import jax
+        if isinstance(kind, jax.Array):
+            kind = np.asarray(kind)
+            slot = np.asarray(slot)
+            val = np.asarray(val)
+        seq = self._grp_seq + 1
+        meta = _entries_meta(entries, kind, slot, self.values)
+        frame = build_apply_frame(
+            self._ge, seq, k, want_vsn, elect, lease_ok, kind, slot,
+            val, exp_e, exp_s, meta)
+
+        # Ship first: the network fan-out and the remote launches
+        # overlap our local launch.  A link needing re-sync gets the
+        # state snapshot queued ahead of the apply (lockstep per link
+        # keeps the order) — but the flush NEVER blocks on an install:
+        # its outcome is consumed on a later flush, and at most one
+        # install is in flight per link (a slow replica must not
+        # stall every client future for install_timeout, nor accrue a
+        # queue of redundant snapshots — review r4).
+        sends: List[Tuple[PeerLink, _Ticket]] = []
+        snapshot_frame = None
+        for link in self._links:
+            inst_t = link.install_ticket
+            if inst_t is not None and inst_t.event.is_set():
+                r = inst_t.result
+                link.install_ticket = None
+                if r is not None and r[0] == "installed":
+                    link.needs_sync = False
+                elif r is not None and r[0] == "nack" \
+                        and int(r[2]) > self._ge:
+                    self._note_depose(int(r[2]))
+            if link.needs_sync and link.connected \
+                    and link.install_ticket is None:
+                if snapshot_frame is None:
+                    snapshot_frame = ("install", self._ge,
+                                      self._grp_seq, dump_state(self))
+                link.install_ticket = link.post(snapshot_frame)
+                self.group_stats["resyncs"] += 1
+            sends.append((link, link.post(frame)))
+
+        try:
+            out = super()._launch(kind, slot, val, k, want_vsn,
+                                  exp_e, exp_s, None, elect, cand,
+                                  lease_ok)
+        except BaseException:
+            # local launch failed AFTER the batch was shipped: any
+            # replica that applied seq N is now ahead of us — roll
+            # them back to our (rolled-back) state via re-sync before
+            # they can count toward a quorum again.
+            for link in self._links:
+                link.needs_sync = True
+            raise
+        self._grp_seq = seq
+        committed, _g, _f, _v, vsn = out
+        crc = result_crc(committed, vsn)
+        self.core.applied_ge = self._ge
+        self.core.applied_seq = seq
+        self.core.last_crc = crc
+
+        acked = 0
+        deadline = time.monotonic() + self.ack_timeout
+        for link, apply_t in sends:
+            r = PeerLink.wait(apply_t, deadline)
+            if r is None:
+                link.needs_sync = True
+                continue
+            if r[0] == "applied" and int(r[3]) == crc \
+                    and not link.needs_sync:
+                acked += 1
+            elif r[0] == "applied":
+                # applied but diverged (CRC mismatch): physical
+                # corruption or a missed batch — heal via re-sync
+                link.needs_sync = True
+            elif r[0] == "nack" and r[1] == "epoch":
+                # Depose ONLY when the replica promised a genuinely
+                # newer epoch.  A LOWER promised (a blank replacement
+                # host, or one whose meta was lost) is merely stale —
+                # deposing on it would let a dead disk take down a
+                # healthy majority leader (review r4).  It re-syncs
+                # instead (install raises its promise).
+                if int(r[2]) > self._ge:
+                    self._note_depose(int(r[2]))
+                link.needs_sync = True
+            else:
+                link.needs_sync = True
+        quorum_ok = (1 + acked) >= (self.group_size // 2 + 1)
+        self._last_quorum_ok = quorum_ok and not self._deposed
+        self.group_stats["applies"] += 1
+        if not self._last_quorum_ok:
+            self.group_stats["quorum_failures"] += 1
+        # Group meta persists via _wal_extra_records inside the flush's
+        # own durability barrier (one sync, and atomically with the kv
+        # records — a leader restart must never see data-bearing kv
+        # records from a seq its meta doesn't cover, or takeover could
+        # adopt an older replica state over its own acked writes).
+        # Data-less launches (heartbeats, pure reads) skip it: adopting
+        # a state that differs only by empty batches loses nothing.
+        return out
+
+    def heartbeat(self) -> bool:
+        """Drive replication liveness without client load: an empty
+        apply (k=0, no elections) that reconnects and re-syncs lagging
+        replicas and re-confirms the host quorum.  Busy leaders get
+        this for free from real flushes; idle ones need the beat or a
+        restarted replica would stay stale until the next client op.
+        Returns the host-quorum outcome."""
+        z = np.zeros((0, self.n_ens), np.int32)
+        elect, cand = self._election_inputs()
+        lease_ok = self.lease_until > self.runtime.now
+        self._launch(z, z, z, 0, want_vsn=True, exp_e=z, exp_s=z,
+                     elect=elect, cand=cand, lease_ok=lease_ok)
+        return self._last_quorum_ok
+
+    def _wal_extra_records(self) -> List[Tuple[Any, Any]]:
+        return [(_GRP_KEY, (self.core.promised, self._ge,
+                            self._grp_seq))]
+
+    def _note_depose(self, promised: int) -> None:
+        if not self._deposed:
+            self.group_stats["depositions"] += 1
+            self._emit("grp_deposed", {"superseded_by": promised})
+        self._deposed = True
+        self.core.promised = max(self.core.promised, promised)
+
+    def _resolve_flush(self, taken, planes, ack: bool = True,
+                       ack_reads: bool = True) -> int:
+        """An ack may never outrun the host quorum: without a
+        majority of WAL-persisted acks this flush's ops — READS
+        INCLUDED (a minority/deposed leader serving reads would break
+        linearizability under partition) — all resolve 'failed'
+        (committed writes' device-side effects stand — the allowed
+        unacked-commit ambiguity), mirroring the local WAL-failure
+        discipline."""
+        q = self._last_quorum_ok
+        return super()._resolve_flush(taken, planes, ack=ack and q,
+                                      ack_reads=ack_reads and q)
+
+    def update_members(self, sel, new_view):
+        if self._links or self.group_size > 1:
+            raise NotImplementedError(
+                "repgroup v1: the host set IS the replication "
+                "membership and is fixed at construction")
+        return super().update_members(sel, new_view)
+
+    def stats(self) -> Dict[str, Any]:
+        s = super().stats()
+        s["group"] = {
+            "leader": self.is_leader,
+            "epoch": self._ge,
+            "seq": self._grp_seq,
+            "size": self.group_size,
+            "peers_connected": sum(l.connected for l in self._links),
+            "peers_synced": sum(not l.needs_sync for l in self._links),
+            **self.group_stats,
+        }
+        return s
+
+    def stop(self) -> None:
+        super().stop()
+        for link in self._links:
+            link.close()
+
+
+def warmup_kernels(svc: BatchedEnsembleService) -> None:
+    """Pre-compile the apply path's XLA programs on a THROWAWAY state
+    (never the live lane: a warmup launch that mutated ``svc.state``
+    outside the apply stream would diverge this replica from its
+    group).  Flush depths are pow2-bucketed, so warming k in
+    {0, 1, 2, ..., max_k} covers every program a leader can ship;
+    without this, the first real apply pays a tens-of-seconds compile
+    inside the leader's ack window and gets this replica marked stale.
+    """
+    import jax.numpy as jnp
+
+    from riak_ensemble_tpu.parallel.batched_host import _pack_results
+
+    e, s = svc.n_ens, svc.n_slots
+    st = svc.engine.init_state(e, 1, s)
+    elect = jnp.zeros((e,), bool)
+    cand = jnp.zeros((e,), jnp.int32)
+    up = jnp.ones((e, 1), bool)
+    k = 0
+    while True:
+        kind = jnp.zeros((k, e), jnp.int32)
+        lease = jnp.zeros((k, e), bool)
+        _, won, res = svc.engine.full_step(
+            st, elect, cand, kind, kind, kind, lease, up,
+            exp_epoch=kind, exp_seq=kind)
+        np.asarray(_pack_results(won, res, True))
+        if k >= svc.max_k:
+            break
+        k = 1 if k == 0 else k * 2
+
+
+# -- the replica host process ------------------------------------------------
+
+class ReplicaServer:
+    """One replica host: a threaded TCP server speaking the group
+    protocol (promise/apply/install/pull) plus control commands
+    (promote/status), and a client port that answers the svcnode frame
+    protocol — ops are rejected with ("error", "not-leader") until
+    this host is promoted, after which it serves exactly like a
+    leader-born node (the in-place promotion path: its own lane
+    already holds the replicated state, so promotion is a promise
+    round plus adopting the newest grant, never a cold transfer)."""
+
+    def __init__(self, n_ens: int, group_size: int, n_slots: int,
+                 repl_port: int = 0, client_port: int = 0,
+                 host: str = "127.0.0.1",
+                 data_dir: Optional[str] = None,
+                 config: Optional[Config] = None,
+                 tick: float = 0.005,
+                 ack_timeout: float = 2.0) -> None:
+        runtime = WallRuntime()
+        if data_dir is not None and (
+                os.path.exists(os.path.join(data_dir, "META"))
+                or os.path.exists(os.path.join(data_dir, "CURRENT"))):
+            self.svc = ReplicatedService.restore(
+                runtime, data_dir, group_size=group_size,
+                data_dir=data_dir, config=config,
+                ack_timeout=ack_timeout)
+        else:
+            self.svc = ReplicatedService(
+                runtime, n_ens, 1, n_slots, group_size=group_size,
+                data_dir=data_dir, config=config,
+                ack_timeout=ack_timeout)
+        self.core = self.svc.core
+        warmup_kernels(self.svc)
+        self.tick = tick
+        self._lock = threading.RLock()
+        self._stop = False
+        self._flush_thread: Optional[threading.Thread] = None
+        self._repl_srv = _ThreadedAcceptor(
+            host, repl_port, self._serve_repl_conn)
+        self._client_srv = _ThreadedAcceptor(
+            host, client_port, self._serve_client_conn)
+        self.repl_port = self._repl_srv.port
+        self.client_port = self._client_srv.port
+
+    # restore() classmethod inherits BatchedEnsembleService.restore,
+    # which forwards **kw to the constructor — group_size rides along.
+
+    @property
+    def role(self) -> str:
+        return "leader" if self.svc.is_leader else "replica"
+
+    # -- replication port ---------------------------------------------------
+
+    def _serve_repl_conn(self, sock: socket.socket) -> None:
+        while not self._stop:
+            try:
+                frame = recv_frame(sock)
+            except (ConnectionError, OSError, wire.WireError):
+                return
+            try:
+                with self._lock:
+                    resp = self._handle_repl(frame)
+            except Exception:
+                import traceback
+                self.svc._emit("grp_replica_error",
+                               {"error": traceback.format_exc(limit=8)})
+                resp = ("error", "internal")
+            try:
+                send_frame(sock, resp)
+            except (ConnectionError, OSError):
+                return
+
+    def _handle_repl(self, frame: Tuple) -> Tuple:
+        op = frame[0]
+        if op == "hello":
+            ge = int(frame[1])
+            # a newer leader's handshake supersedes this host's own
+            # leadership (the fencing a deposed leader observes)
+            if ge > self.core.promised:
+                self._step_down()
+            return ("helloed", self.core.promised, self.core.applied_ge,
+                    self.core.applied_seq)
+        if op == "promise":
+            ge = int(frame[1])
+            if ge > self.core.promised:
+                self._step_down()
+            return self.core.handle_promise(ge)
+        if op == "apply":
+            if self.svc.is_leader:
+                # a live apply stream at a newer epoch deposes us;
+                # at an older epoch it is nacked by the core
+                if int(frame[1]) > self.core.promised:
+                    self._step_down()
+            return self.core.handle_apply(frame)
+        if op == "install":
+            if int(frame[1]) >= self.core.promised:
+                self._step_down()
+            return self.core.handle_install(frame)
+        if op == "pull":
+            return self.core.handle_pull()
+        if op == "promote":
+            peers = [(str(h), int(p)) for h, p in frame[1]]
+            return self._promote(peers)
+        if op == "status":
+            return ("status", self.role, self.core.promised,
+                    self.core.applied_ge, self.core.applied_seq)
+        return ("error", "unknown-op")
+
+    def _step_down(self) -> None:
+        if self.svc._is_leader:
+            self.svc._is_leader = False
+            self.svc._deposed = True
+            self.svc._emit("grp_step_down", {})
+
+    def _promote(self, peers: List[Tuple[str, int]]) -> Tuple:
+        if not self.svc._links:
+            self.svc.attach_peers(peers)
+        rebuild_derived(self.svc)
+        ok = self.svc.takeover()
+        if not ok:
+            return ("error", "no-majority")
+        if self._flush_thread is None:
+            self._flush_thread = threading.Thread(
+                target=self._flush_loop, daemon=True)
+            self._flush_thread.start()
+        return ("ok", self.svc._ge)
+
+    HEARTBEAT_EVERY = 1.0
+
+    def _flush_loop(self) -> None:
+        last_beat = time.monotonic()
+        while not self._stop:
+            time.sleep(self.tick)
+            if not self.svc.is_leader:
+                continue
+            try:
+                with self._lock:
+                    if any(self.svc.queues) or \
+                            self.svc._election_inputs()[0].any():
+                        self.svc.flush()
+                        last_beat = time.monotonic()
+                    elif time.monotonic() - last_beat \
+                            > self.HEARTBEAT_EVERY:
+                        # idle: keep replica liveness/re-sync moving
+                        self.svc.heartbeat()
+                        last_beat = time.monotonic()
+            except DeposedError:
+                continue
+            except Exception:
+                import traceback
+                self.svc._emit("grp_flush_error",
+                               {"error": traceback.format_exc(limit=8)})
+
+    # -- client port (svcnode frame protocol) -------------------------------
+
+    def _serve_client_conn(self, sock: socket.socket) -> None:
+        wlock = threading.Lock()
+
+        def send(req_id, result) -> None:
+            try:
+                payload = wire.encode((req_id, result))
+            except wire.WireError:
+                payload = wire.encode((req_id, "failed"))
+            with wlock:
+                try:
+                    sock.sendall(_HDR.pack(len(payload)) + payload)
+                except (ConnectionError, OSError):
+                    pass
+
+        while not self._stop:
+            try:
+                msg = recv_frame(sock)
+                req_id, op = msg[0], msg[1]
+                args = tuple(msg[2:])
+            except (ConnectionError, OSError, wire.WireError,
+                    IndexError, TypeError):
+                return
+            if op == "stats":
+                with self._lock:
+                    send(req_id, self.svc.stats())
+                continue
+            if not self.svc.is_leader:
+                send(req_id, ("error", "not-leader"))
+                continue
+            try:
+                with self._lock:
+                    fut = self._dispatch(op, args)
+            except Exception:
+                send(req_id, ("error", "bad-request"))
+                continue
+            if fut is None:
+                send(req_id, ("error", "unknown-op"))
+                continue
+            fut.add_waiter(
+                lambda result, rid=req_id: send(rid, result))
+
+    def _dispatch(self, op: str, args: tuple):
+        svc = self.svc
+        if args:
+            ens = args[0]
+            if type(ens) is not int or not 0 <= ens < svc.n_ens:
+                raise ValueError(f"bad ensemble index {ens!r}")
+        fns = {"kput": svc.kput, "kget": svc.kget,
+               "kget_vsn": svc.kget_vsn, "kupdate": svc.kupdate,
+               "kput_once": svc.kput_once, "kmodify": svc.kmodify,
+               "kdelete": svc.kdelete,
+               "ksafe_delete": svc.ksafe_delete,
+               "kput_many": svc.kput_many, "kget_many": svc.kget_many,
+               "kupdate_many": svc.kupdate_many,
+               "kdelete_many": svc.kdelete_many}
+        fn = fns.get(op)
+        return None if fn is None else fn(*args)
+
+    def stop(self) -> None:
+        self._stop = True
+        self._repl_srv.close()
+        self._client_srv.close()
+        self.svc.stop()
+
+
+class _ThreadedAcceptor:
+    """Minimal threaded TCP acceptor: one handler thread per
+    connection (the group has a handful of peers, not thousands)."""
+
+    def __init__(self, host: str, port: int, handler) -> None:
+        self._handler = handler
+        self._sock = socket.create_server((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._closed = False
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            # daemon handler threads need no tracking: they die with
+            # their connection (and the process)
+            threading.Thread(target=self._run_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _run_conn(self, conn: socket.socket) -> None:
+        try:
+            self._handler(conn)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="replication-group replica host")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--repl-port", type=int, default=0)
+    ap.add_argument("--client-port", type=int, default=0)
+    ap.add_argument("--n-ens", type=int, default=64)
+    ap.add_argument("--group-size", type=int, default=3)
+    ap.add_argument("--n-slots", type=int, default=32)
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--fast", action="store_true")
+    args = ap.parse_args(argv)
+
+    from riak_ensemble_tpu.config import fast_test_config
+
+    srv = ReplicaServer(
+        args.n_ens, args.group_size, args.n_slots,
+        repl_port=args.repl_port, client_port=args.client_port,
+        host=args.host, data_dir=args.data_dir,
+        config=fast_test_config() if args.fast else None)
+    print(f"repgroup replica repl={srv.repl_port} "
+          f"client={srv.client_port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

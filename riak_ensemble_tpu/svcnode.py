@@ -121,6 +121,11 @@ class ServiceServer:
             return svc.kget_vsn(*args)
         if op == "kupdate":
             return svc.kupdate(*args)
+        if op == "kmodify":
+            # mod_fun arrives as a wire-safe funref tuple; resolution
+            # (and rejection of unregistered names) happens inside
+            # kmodify — the no-code-on-decode trust model holds
+            return svc.kmodify(*args)
         if op == "kput_once":
             return svc.kput_once(*args)
         if op == "kdelete":
@@ -319,6 +324,14 @@ class ServiceClient:
 
     async def kput_once(self, ens, key, value, **kw):
         return await self.call("kput_once", ens, key, value, **kw)
+
+    async def kmodify(self, ens, key, fnref, default, **kw):
+        """Server-side modify; ``fnref`` is a
+        :func:`riak_ensemble_tpu.funref.ref` tuple (names resolve in
+        the SERVER's registry, the MFA discipline of
+        riak_ensemble_peer:kmodify)."""
+        return await self.call("kmodify", ens, key, tuple(fnref),
+                               default, **kw)
 
     async def kdelete(self, ens, key, **kw):
         return await self.call("kdelete", ens, key, **kw)
