@@ -1595,13 +1595,18 @@ class BatchedEnsembleService:
                    if k else jnp.zeros((0, self.n_ens), bool))
         kind_j, slot_j, val_j = (jnp.asarray(kind), jnp.asarray(slot),
                                  jnp.asarray(val))
+        # EVERY input upload belongs to the h2d mark — an asarray
+        # inlined into the step call would bill its (synchronous)
+        # transfer to 'dispatch' and make the async-enqueue number
+        # read milliseconds of jitter it doesn't have (VERDICT r3 #4).
+        elect_j, cand_j = jnp.asarray(elect), jnp.asarray(cand)
+        up_j = self._up_device()
+        exp_e_j = None if exp_e is None else jnp.asarray(exp_e)
+        exp_s_j = None if exp_s is None else jnp.asarray(exp_s)
         t1 = time.perf_counter()
         state, won, res = self.engine.full_step(
-            self.state, jnp.asarray(elect), jnp.asarray(cand),
-            kind_j, slot_j, val_j,
-            lease_j, self._up_device(),
-            exp_epoch=None if exp_e is None else jnp.asarray(exp_e),
-            exp_seq=None if exp_s is None else jnp.asarray(exp_s))
+            self.state, elect_j, cand_j, kind_j, slot_j, val_j,
+            lease_j, up_j, exp_epoch=exp_e_j, exp_seq=exp_s_j)
         self.state = state
         t2 = time.perf_counter()
 

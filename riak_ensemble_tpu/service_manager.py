@@ -1,0 +1,419 @@
+"""Consensus-managed membership for the scale plane (VERDICT r3 #3).
+
+The reference's cluster story is one loop: every mutation flows
+through the root ensemble's kmodify (``riak_ensemble_root.erl:38-45``),
+gossip replicates the state, and every node's manager reconciles —
+starting wanted-but-missing peers and stopping running-but-unwanted
+ones (``riak_ensemble_manager.erl:610-641``, ``check_peers:697-715``).
+Round 3 bridged the scale plane's *endpoints* into that story
+(:mod:`riak_ensemble_tpu.service_directory`); tenant ensembles were
+still managed by direct ``create_ensemble``/``update_members`` calls
+on one process.  This module finishes the story:
+
+- **Tenant registry in cluster state.**  A scale-plane tenant is an
+  ensemble record ``("svct", name)`` with ``mod="svc_tenant"`` and NO
+  peer members (so actor reconciliation starts no processes for it),
+  created/retired through the root ensemble exactly like any other
+  ensemble and spread by gossip.
+- **Derived placement.**  A tenant's owner is not stored — it is the
+  rendezvous-hash winner over the svcnodes REGISTERED in the same
+  directory (``service_directory``).  Registering a new svcnode
+  through the root is therefore the entire join protocol: gossip
+  carries the registration, every reconciler recomputes placement,
+  and tenants rebalance with no further writes — "ensembles move via
+  gossip alone".
+- **Reconciliation loop.**  :class:`ServiceReconciler` (one per node
+  owning a :class:`BatchedEnsembleService`) is ``check_peers`` for
+  tenants: create wanted-but-missing rows, retire
+  running-but-unwanted ones, and apply per-tenant view changes from
+  the registry through ``update_members``.
+- **Handoff.**  When placement moves a tenant away, the retiring
+  owner atomically exports the tenant's keyed data and destroys the
+  row IN THE SAME TICK (no flush in between: late writes fail fast —
+  clients retry against the directory rather than writing into a
+  dropped copy), then offers the export to the new owner, which
+  imports before serving.  Payload values survive the move; object
+  versions restart on the new owner (a move is a logical re-ingest —
+  CAS tokens must be re-read, which the reference's clients already
+  tolerate across peer restarts).
+
+v1 boundaries (documented, not hidden): a tenant is placed on ONE
+svcnode (the repgroup is the cross-host availability story; compose
+by making the owner a replication-group leader); writes racing the
+exact export tick fail fast rather than forward.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import replace
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from riak_ensemble_tpu import funref
+from riak_ensemble_tpu import service_directory as sd
+from riak_ensemble_tpu import state as statelib
+from riak_ensemble_tpu.types import EnsembleInfo
+
+TENANT_MOD = "svc_tenant"
+
+
+def tenant_id(name: Any) -> Tuple[str, Any]:
+    return ("svct", name)
+
+
+def create_tenant(mgr, runtime, name: Any,
+                  view: Optional[List[bool]] = None,
+                  timeout: float = 30.0):
+    """Register a tenant through the root ensemble
+    (manager.erl:157-166 → root:set_ensemble).  Placement is derived,
+    so creation carries only the per-tenant peer view (None = all
+    peers of the owning service)."""
+    if view is not None:
+        view = [bool(b) for b in view]
+        if not any(view):
+            raise ValueError(
+                "a tenant view needs at least one member")
+    fut = mgr.create_ensemble(
+        tenant_id(name), None, [], TENANT_MOD, (view,), timeout)
+    return runtime.await_future(fut, timeout + 5.0)
+
+
+@funref.register("svct:set_args")
+def _set_args_fun(ens_id: Any, args: Tuple, _vsn, cs):
+    """Root-FSM mutator: read-modify-write of a tenant record ON THE
+    CURRENT consensus state (root.erl:74-90 discipline) — a
+    local-replica RMW would let a stale gossip copy no-op a retire or
+    silently drop one of two concurrent updates (review r4)."""
+    cur = cs.ensembles.get(ens_id)
+    if cur is None:
+        return "failed"
+    info = replace(cur, vsn=(cur.vsn[0], cur.vsn[1] + 1),
+                   args=tuple(args))
+    out = statelib.set_ensemble(ens_id, info, cs)
+    return out if out is not None else "failed"
+
+
+def _mutate_args(mgr, runtime, name: Any, args: Tuple,
+                 timeout: float):
+    from riak_ensemble_tpu import root as rootlib
+
+    fut = rootlib._call(mgr, mgr.node,
+                        funref.ref("svct:set_args", tenant_id(name),
+                                   tuple(args)), timeout)
+    return runtime.await_future(fut, timeout + 5.0)
+
+
+def retire_tenant(mgr, runtime, name: Any, timeout: float = 30.0):
+    """Retire a tenant cluster-wide: an atomic root-FSM update marks
+    its record retired at the next vsn.  Returns "failed" when the
+    root has no such tenant (e.g. the record hasn't reached consensus
+    yet — retry, don't assume done).  Reconcilers destroy local rows
+    on convergence."""
+    return _mutate_args(mgr, runtime, name, ("retired",), timeout)
+
+
+def set_tenant_view(mgr, runtime, name: Any, view: List[bool],
+                    timeout: float = 30.0):
+    """Consensus-managed per-tenant membership change: the new view
+    lands in the registry through an atomic root-FSM update, gossips,
+    and every owner's reconciler drives it into the device arrays via
+    update_members — never a direct call on the service."""
+    view = [bool(b) for b in view]
+    if not any(view):
+        raise ValueError("a tenant view needs at least one member")
+    return _mutate_args(mgr, runtime, name, (view,), timeout)
+
+
+def tenants(directory) -> Dict[Any, Optional[List[bool]]]:
+    """name -> view for every live tenant in the local directory."""
+    out = {}
+    for ens_id, info in directory.known_ensembles().items():
+        if (isinstance(ens_id, tuple) and len(ens_id) == 2
+                and ens_id[0] == "svct" and info.mod == TENANT_MOD
+                and tuple(info.args) != ("retired",)):
+            out[ens_id[1]] = info.args[0] if info.args else None
+    return out
+
+
+def place(name: Any, svcnodes: List[Any]) -> Optional[Any]:
+    """Rendezvous (highest-random-weight) placement: deterministic
+    from (tenant, registered svcnode set) alone, so every node
+    computes the same owner from its gossip replica with no placement
+    writes; adding a svcnode moves ~1/N of tenants (the minimal
+    reshuffle, unlike mod-N)."""
+    if not svcnodes:
+        return None
+
+    def weight(node):
+        h = hashlib.blake2b(repr((name, node)).encode(),
+                            digest_size=8).digest()
+        return int.from_bytes(h, "big")
+    return max(sorted(svcnodes, key=repr), key=weight)
+
+
+class ServiceReconciler:
+    """check_peers for the scale plane: converge the LOCAL batched
+    service onto the gossip-replicated tenant registry + svcnode
+    directory.
+
+    ``svc_name`` is this node's registration name in
+    ``service_directory``; ``resolve(svc_name)`` returns a handle to
+    another node's reconciler (tests: a dict of in-process
+    reconcilers; deployment: a ServiceClient dialed from the
+    directory address).  The handle needs ``offer_handoff`` and
+    ``has_tenant``.
+    """
+
+    def __init__(self, runtime, mgr, svc, svc_name: Any,
+                 resolve: Callable[[Any], Optional["ServiceReconciler"]],
+                 poll: float = 0.25) -> None:
+        assert svc.dynamic, "tenant reconciliation needs dynamic=True"
+        self.runtime = runtime
+        self.mgr = mgr
+        self.svc = svc
+        self.svc_name = svc_name
+        self.resolve = resolve
+        self.poll = poll
+        #: handoffs offered by retiring owners, pending import
+        self._inbox: Dict[Any, List[Tuple[Any, Any]]] = {}
+        #: tenants whose import future hasn't resolved yet
+        self._importing: Dict[Any, Any] = {}
+        #: bounded import retries per tenant (persistent quorum loss
+        #: must surface, not spin)
+        self._import_attempts: Dict[Any, int] = {}
+        self.max_import_attempts = 8
+        #: in-flight import payloads, for per-key result verification
+        self._import_data: Dict[Any, Tuple] = {}
+        #: grace ticks before creating a missing tenant EMPTY (gives a
+        #: live retiring owner time to offer the handoff instead)
+        self._want_since: Dict[Any, int] = {}
+        self.empty_grace_ticks = 8
+        self._tick_no = 0
+        self._timer = runtime.schedule(poll, self._on_tick)
+
+    # -- handoff surface (called by peer reconcilers) -----------------------
+
+    def offer_handoff(self, name: Any, data: List[Tuple[Any, Any]]
+                      ) -> bool:
+        """A retiring owner pushes a tenant's exported keyed data."""
+        self._inbox.setdefault(name, []).extend(data)
+        return True
+
+    def has_tenant(self, name: Any) -> bool:
+        return self.svc.resolve_ensemble(name) is not None \
+            or name in self._importing
+
+    # -- the loop -----------------------------------------------------------
+
+    def stop(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def _on_tick(self) -> None:
+        try:
+            self.tick()
+        except Exception:
+            # the loop must outlive any single bad pass (malformed
+            # registry data, a racing destroy): trace, keep ticking
+            import traceback
+            self.svc._emit("svc_reconcile_error",
+                           {"error": traceback.format_exc(limit=8)})
+        finally:
+            if self._timer is not None:
+                self._timer = self.runtime.schedule(self.poll,
+                                                    self._on_tick)
+
+    def tick(self) -> None:
+        """One reconciliation pass (manager.erl:610-641 discipline)."""
+        self._tick_no += 1
+        reg = tenants(self.mgr)
+        nodes = sorted(sd.list_services(self.mgr), key=repr)
+        mine = {n for n in reg if place(n, nodes) == self.svc_name}
+        running = set(self.svc._ens_names)
+
+        # retire: running but no longer placed here (moved/retired) —
+        # atomic export+destroy (late writes fail fast), then offer.
+        # A tenant mid-import is NOT retired yet: destroying it would
+        # fail the queued import ops and forward only the flushed
+        # subset (review r4) — the move waits one import cycle.
+        for name in sorted(running - mine, key=repr):
+            if name in self._importing:
+                continue
+            self._retire_local(name, reg, nodes)
+
+        # create: placed here but not running
+        for name in sorted(mine - running, key=repr):
+            if name in self._importing:
+                continue
+            self._adopt(name, reg[name])
+
+        # view changes from the registry → device arrays; and late
+        # handoffs for tenants we already adopted empty (grace lapsed
+        # or the retiring owner was transiently unreachable) merge in
+        # create-if-missing — local writes made since stay newest
+        for name in sorted(mine & running, key=repr):
+            self._apply_view(name, reg[name])
+            if name in self._inbox and name not in self._importing:
+                self._import(name, self._inbox.pop(name),
+                             create_only=True)
+
+        # resolved imports: verify per-key results — 'failed' entries
+        # (no quorum that flush) re-queue for a bounded retry instead
+        # of silently serving partial data (review r4)
+        for name in [n for n, f in self._importing.items() if f.done]:
+            fut = self._importing.pop(name)
+            self._check_import(name, fut)
+
+    def _retire_local(self, name: Any, reg, nodes) -> None:
+        svc = self.svc
+        ens = svc.resolve_ensemble(name)
+        if ens is None:
+            return
+        new_owner = place(name, nodes) if name in reg else None
+        data = self._export(ens)
+        # leftover inbox data (a handoff that raced this move) rides
+        # along for keys our committed export doesn't cover — it is
+        # strictly older, so export entries win
+        stale = self._inbox.pop(name, None)
+        if stale:
+            have = {k for k, _ in data}
+            data += [(k, v) for k, v in stale if k not in have]
+        svc.destroy_ensemble(name)
+        self._want_since.pop(name, None)
+        self._import_attempts.pop(name, None)
+        if new_owner is None or not data:
+            return
+        target = self.resolve(new_owner)
+        if target is not None:
+            target.offer_handoff(name, data)
+        # an unreachable new owner loses the push; it will adopt the
+        # tenant empty after its grace window — same availability
+        # floor as the reference when a node dies holding unhanded
+        # data (durability story: compose owners from repgroups)
+
+    def _export(self, ens: int) -> List[Tuple[Any, Any]]:
+        """Snapshot a tenant's keyed data from the host mirrors —
+        synchronous (no flush), which is what makes export+destroy
+        atomic within one tick."""
+        svc = self.svc
+        out = []
+        for key, slot in svc.key_slot[ens].items():
+            h = svc.slot_handle[ens].get(slot, 0)
+            if h:
+                out.append((key, svc.values[h]))
+        return out
+
+    def _adopt(self, name: Any, view) -> None:
+        svc = self.svc
+        if view is not None and not any(view):
+            # a malformed registry view must not crash the loop; it
+            # surfaces as a trace until the registry is corrected
+            self.svc._emit("svc_tenant_bad_view", {"name": name})
+            return
+        first = self._want_since.setdefault(name, self._tick_no)
+        if name not in self._inbox and self._tick_no - first < \
+                self.empty_grace_ticks:
+            # a live retiring owner may still be about to offer; only
+            # create EMPTY once the grace window passes
+            if self._anyone_else_has(name):
+                return
+        row = svc.create_ensemble(
+            name, None if view is None else np.asarray(view, bool))
+        if row is None:
+            return  # no capacity: retried next tick, inbox KEPT
+        self._want_since.pop(name, None)
+        data = self._inbox.pop(name, None)
+        if data:
+            self._import(name, data)
+        self.svc._emit("svc_tenant_adopt",
+                       {"name": name, "imported": len(data or ())})
+
+    def _import(self, name: Any, data: List[Tuple[Any, Any]],
+                create_only: bool = False) -> None:
+        """Start an import batch for an adopted tenant.  With
+        ``create_only`` (late handoffs merging into a live tenant)
+        each key lands via a (0,0)-CAS — create-if-missing — so local
+        writes made since the empty adoption stay newest."""
+        svc = self.svc
+        row = svc.resolve_ensemble(name)
+        if row is None:
+            self._inbox.setdefault(name, []).extend(data)
+            return
+        keys = [k for k, _ in data]
+        vals = [v for _, v in data]
+        self._import_data[name] = (data, create_only)
+        if create_only:
+            fut = svc.kupdate_many(row, keys, [(0, 0)] * len(keys),
+                                   vals)
+        else:
+            fut = svc.kput_many(row, keys, vals)
+        self._importing[name] = fut
+
+    def _check_import(self, name: Any, fut) -> None:
+        """Verify per-key import results; re-queue genuine failures
+        (no quorum that flush) for a bounded retry — a silently
+        partial import is data loss with no signal (review r4)."""
+        svc = self.svc
+        data, create_only = self._import_data.pop(
+            name, ((), False))
+        results = fut.value if isinstance(fut.value, list) else []
+        row = svc.resolve_ensemble(name)
+        lost: List[Tuple[Any, Any]] = []
+        for (key, val), res in zip(data, results):
+            if isinstance(res, tuple) and res[0] == "ok":
+                continue
+            # create_only 'failed' can mean the key already exists
+            # locally (expected: local write wins) — only keys with
+            # no committed local copy actually need the retry
+            if row is not None:
+                slot = svc.key_slot[row].get(key)
+                if slot is not None and \
+                        svc.slot_handle[row].get(slot, 0):
+                    continue
+            lost.append((key, val))
+        if not lost:
+            self._import_attempts.pop(name, None)
+            return
+        n = self._import_attempts.get(name, 0) + 1
+        self._import_attempts[name] = n
+        if n >= self.max_import_attempts:
+            svc._emit("svc_tenant_import_giveup",
+                      {"name": name, "keys": len(lost), "attempts": n})
+            return
+        svc._emit("svc_tenant_import_retry",
+                  {"name": name, "keys": len(lost), "attempt": n})
+        self._inbox.setdefault(name, []).extend(lost)
+
+    def _anyone_else_has(self, name: Any) -> bool:
+        for other in sd.list_services(self.mgr):
+            if other == self.svc_name:
+                continue
+            peer = self.resolve(other)
+            if peer is not None and peer.has_tenant(name):
+                return True
+        return False
+
+    def _apply_view(self, name: Any, view) -> None:
+        if view is None:
+            return
+        if not any(view):
+            self.svc._emit("svc_tenant_bad_view", {"name": name})
+            return
+        svc = self.svc
+        ens = svc.resolve_ensemble(name)
+        if ens is None:
+            return
+        want = np.asarray(view, bool)
+        cur = svc.member_np[ens]
+        pending = (svc._desired_mask[ens] or svc._pending_mask[ens]
+                   or svc._queued_mask[ens])
+        if (cur == want).all() or pending:
+            return
+        sel = np.zeros((svc.n_ens,), bool)
+        sel[ens] = True
+        nv = svc.member_np.copy()
+        nv[ens] = want
+        svc.update_members(sel, nv)
