@@ -290,6 +290,114 @@ def run_keyed_service(n_ens: int, n_peers: int, n_slots: int, k: int,
     return {"scalar": scalar_rate, "batched": ops / elapsed}
 
 
+def run_repgroup(seconds: float, smoke: bool) -> dict:
+    """Cross-host replication-group rung: a 3-host group (leader
+    in-process + 2 replica OS processes), fsync WALs, host-majority
+    commit barrier.  Measures the keyed client surface end to end —
+    what the availability story costs per op vs the single-process
+    service."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import textwrap
+
+    from riak_ensemble_tpu.parallel import repgroup
+    from riak_ensemble_tpu.parallel.batched_host import WallRuntime
+
+    n_ens, n_slots, k = (16, 16, 8) if smoke else (64, 32, 16)
+    tmp = tempfile.mkdtemp(prefix="bench_repgroup_")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = []
+    try:
+        for i in (1, 2):
+            child = textwrap.dedent(f"""
+                import os, sys
+                os.environ["JAX_PLATFORMS"] = "cpu"
+                sys.path.insert(0, {repo!r})
+                import jax
+                jax.config.update("jax_platforms", "cpu")
+                from riak_ensemble_tpu.parallel import repgroup
+                repgroup.main(["--n-ens", "{n_ens}", "--group-size",
+                               "3", "--n-slots", "{n_slots}",
+                               "--fast",
+                               "--data-dir", {tmp!r} + "/r{i}"])
+            """)
+            # stderr → DEVNULL and stdout drained by a daemon thread
+            # after the ready line: replicas live for the whole bench,
+            # and a chatty child blocking on a full 64 KiB pipe would
+            # stop acking and stall the quorum (review r4)
+            p = subprocess.Popen([sys.executable, "-c", child],
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.DEVNULL, text=True,
+                                 env=env)
+            procs.append(p)
+        import threading
+        ports = []
+        for p in procs:
+            line = p.stdout.readline()
+            assert line, "repgroup replica died before ready line"
+            parts = dict(kv.split("=") for kv in line.split()[2:])
+            ports.append(int(parts["repl"]))
+            threading.Thread(target=lambda f=p.stdout: [None for _
+                                                        in f],
+                             daemon=True).start()
+
+        from riak_ensemble_tpu.config import fast_test_config
+        svc = repgroup.ReplicatedService(
+            WallRuntime(), n_ens, 1, n_slots, group_size=3,
+            peers=[("127.0.0.1", p) for p in ports],
+            ack_timeout=60.0, max_ops_per_tick=k,
+            config=fast_test_config(), data_dir=tmp + "/leader")
+        repgroup.warmup_kernels(svc)
+        assert svc.takeover(), "repgroup bench: takeover failed"
+
+        keys = [f"key{j}" for j in range(k)]
+        vals = [b"v%d" % j for j in range(k // 2)]
+
+        def one_round():
+            futs = []
+            for e in range(n_ens):
+                futs.append(svc.kput_many(e, keys[:k // 2], vals))
+                futs.append(svc.kget_many(e, keys[k // 2:]))
+            while any(svc.queues):
+                svc.flush()
+            assert all(f.done for f in futs)
+            return n_ens * k
+
+        one_round()  # warm (slots, remote compile, sync settled)
+        svc.ack_timeout = 10.0
+        lat = []
+        ops = 0
+        t_end = time.perf_counter() + max(seconds, 1e-3)
+        t0 = time.perf_counter()
+        while time.perf_counter() < t_end or not lat:
+            tb = time.perf_counter()
+            ops += one_round()
+            lat.append(time.perf_counter() - tb)
+        elapsed = time.perf_counter() - t0
+        g = svc.stats()["group"]
+        assert g["quorum_failures"] == 0, g
+        assert g["peers_synced"] == 2, g
+        lat_ms = np.asarray(lat) * 1e3
+        svc.stop()
+        return {
+            "repgroup_ops_per_sec": round(ops / elapsed, 1),
+            "repgroup_p50_ms": round(float(np.percentile(lat_ms, 50)),
+                                     3),
+            "repgroup_p99_ms": round(float(np.percentile(lat_ms, 99)),
+                                     3),
+        }
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run(n_ens: int, n_peers: int, n_slots: int, k: int,
         seconds: float) -> float:
     import jax
@@ -523,6 +631,8 @@ def _stage_entry(args) -> None:
                   n_slots=args.n_slots, k=args.k)
     if args.stage == "kernel":
         out = {"kernel_rounds_per_sec": run(seconds=args.seconds, **shapes)}
+    elif args.stage == "repgroup":
+        out = run_repgroup(args.seconds, smoke=False)
     elif args.stage == "merkle":
         m = run_merkle(args.seconds, smoke=False)
         out = {"ladder_metric": m["metric"], "ladder_value": m["value"]}
@@ -575,6 +685,7 @@ def main() -> None:
         kernel_rounds = run(seconds=secs, **shapes)
         svc = run_service(seconds=secs, **shapes)
         svc["kernel_rounds_per_sec"] = kernel_rounds
+        svc.update(run_repgroup(secs, smoke=True))
         svc["platform"] = "smoke"
         label = "64_ens_5_peers_smoke"
     else:
@@ -641,6 +752,14 @@ def main() -> None:
                                300.0, force_cpu)
                 if r is not None:
                     svc["ladder"][r["ladder_metric"]] = r["ladder_value"]
+            # cross-host replication-group rung (3 OS processes,
+            # fsync WALs, host-majority barrier) — CPU-bound sockets
+            # + disk, so it runs whatever platform the headline took
+            r = _run_stage("repgroup", label, {}, args.seconds,
+                           420.0, force_cpu)
+            if r is not None:
+                svc.update({k: v for k, v in r.items()
+                            if k.startswith("repgroup_")})
         if svc is None:
             print(json.dumps({
                 "metric": "service_linearizable_kv_ops_per_sec",
@@ -677,6 +796,9 @@ def main() -> None:
         "mixed_p99_ms": (round(svc["mixed_p99_ms"], 3)
                          if svc.get("mixed_p99_ms") else None),
         "mixed_commit_fraction": svc.get("mixed_commit_fraction"),
+        "repgroup_ops_per_sec": svc.get("repgroup_ops_per_sec"),
+        "repgroup_p50_ms": svc.get("repgroup_p50_ms"),
+        "repgroup_p99_ms": svc.get("repgroup_p99_ms"),
         "latency_breakdown_ms": svc.get("latency_breakdown"),
         **{k: round(v, 1) for k, v in svc.get("ladder", {}).items()},
         "platform": svc.get("platform", "unknown"),

@@ -234,38 +234,58 @@ class ServiceReconciler:
         mine = {n for n in reg if place(n, nodes) == self.svc_name}
         running = set(self.svc._ens_names)
 
+        # grace bookkeeping only matters while a tenant is wanted
+        # here: entries for tenants that left placement before local
+        # creation would accumulate forever and poison the grace
+        # window on a much-later name reuse (review r4)
+        for name in [n for n in self._want_since if n not in mine]:
+            del self._want_since[name]
+
         # retire: running but no longer placed here (moved/retired) —
         # atomic export+destroy (late writes fail fast), then offer.
         # A tenant mid-import is NOT retired yet: destroying it would
         # fail the queued import ops and forward only the flushed
         # subset (review r4) — the move waits one import cycle.
+        # Each tenant's pass is contained: one malformed registry
+        # record (wrong-length view, a racing destroy) must not wedge
+        # reconciliation for every later-sorted tenant (review r4).
         for name in sorted(running - mine, key=repr):
             if name in self._importing:
                 continue
-            self._retire_local(name, reg, nodes)
+            self._contained(self._retire_local, name, reg, nodes)
 
         # create: placed here but not running
         for name in sorted(mine - running, key=repr):
             if name in self._importing:
                 continue
-            self._adopt(name, reg[name])
+            self._contained(self._adopt, name, reg[name])
 
         # view changes from the registry → device arrays; and late
         # handoffs for tenants we already adopted empty (grace lapsed
         # or the retiring owner was transiently unreachable) merge in
         # create-if-missing — local writes made since stay newest
         for name in sorted(mine & running, key=repr):
-            self._apply_view(name, reg[name])
+            self._contained(self._apply_view, name, reg[name])
             if name in self._inbox and name not in self._importing:
-                self._import(name, self._inbox.pop(name),
-                             create_only=True)
+                self._contained(self._import, name,
+                                self._inbox.pop(name),
+                                create_only=True)
 
         # resolved imports: verify per-key results — 'failed' entries
         # (no quorum that flush) re-queue for a bounded retry instead
         # of silently serving partial data (review r4)
         for name in [n for n, f in self._importing.items() if f.done]:
             fut = self._importing.pop(name)
-            self._check_import(name, fut)
+            self._contained(self._check_import, name, fut)
+
+    def _contained(self, fn, name, *args, **kw) -> None:
+        try:
+            fn(name, *args, **kw)
+        except Exception:
+            import traceback
+            self.svc._emit("svc_reconcile_tenant_error",
+                           {"name": name,
+                            "error": traceback.format_exc(limit=8)})
 
     def _retire_local(self, name: Any, reg, nodes) -> None:
         svc = self.svc
@@ -306,12 +326,21 @@ class ServiceReconciler:
                 out.append((key, svc.values[h]))
         return out
 
+    def _bad_view(self, name: Any, view) -> bool:
+        """Malformed registry views (no members, or a length that
+        doesn't match this service's peer count) surface as traces,
+        never as crashed ticks."""
+        if view is None:
+            return False
+        if not any(view) or len(view) != self.svc.n_peers:
+            self.svc._emit("svc_tenant_bad_view",
+                           {"name": name, "view": list(view)})
+            return True
+        return False
+
     def _adopt(self, name: Any, view) -> None:
         svc = self.svc
-        if view is not None and not any(view):
-            # a malformed registry view must not crash the loop; it
-            # surfaces as a trace until the registry is corrected
-            self.svc._emit("svc_tenant_bad_view", {"name": name})
+        if self._bad_view(name, view):
             return
         first = self._want_since.setdefault(name, self._tick_no)
         if name not in self._inbox and self._tick_no - first < \
@@ -360,6 +389,12 @@ class ServiceReconciler:
         data, create_only = self._import_data.pop(
             name, ((), False))
         results = fut.value if isinstance(fut.value, list) else []
+        if len(results) < len(data):
+            # an unrecognized/truncated result shape must default to
+            # LOST, not to success — this function exists to prevent
+            # silent partial imports (review r4)
+            results = list(results) + ["failed"] * (len(data)
+                                                    - len(results))
         row = svc.resolve_ensemble(name)
         lost: List[Tuple[Any, Any]] = []
         for (key, val), res in zip(data, results):
@@ -397,10 +432,7 @@ class ServiceReconciler:
         return False
 
     def _apply_view(self, name: Any, view) -> None:
-        if view is None:
-            return
-        if not any(view):
-            self.svc._emit("svc_tenant_bad_view", {"name": name})
+        if view is None or self._bad_view(name, view):
             return
         svc = self.svc
         ens = svc.resolve_ensemble(name)
