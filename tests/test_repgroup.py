@@ -411,3 +411,102 @@ def test_repgroup_linearizable_under_host_nemesis(tmp_path, seed):
             if p.poll() is None:
                 p.send_signal(signal.SIGCONT)
                 p.kill()
+
+
+def test_leader_kill9_promote_replica_no_acked_loss(tmp_path):
+    """The full machine-kill story with EVERY host a real OS process:
+    promote r1 to leader, ack writes through its client port, kill -9
+    the LEADER, promote r2 (promise round to the surviving majority +
+    newest-state adoption), and every acked write must be readable —
+    including the group-meta-in-the-commit-barrier property (review
+    r4): the restarted/overtaken group can never mistake a
+    data-bearing position for an older one."""
+    import asyncio
+
+    from riak_ensemble_tpu import svcnode
+
+    procs = {}
+    dirs = {}
+    try:
+        for name in ("r1", "r2", "r3"):
+            dirs[name] = str(tmp_path / name)
+            procs[name] = _spawn_replica(dirs[name])
+        _, r1_repl, r1_client = procs["r1"]
+        _, r2_repl, r2_client = procs["r2"]
+        _, r3_repl, _ = procs["r3"]
+
+        resp = _control(r1_repl, ("promote", [("127.0.0.1", r2_repl),
+                                              ("127.0.0.1", r3_repl)]))
+        assert resp[0] == "ok", resp
+
+        async def drive_writes():
+            c = svcnode.ServiceClient("127.0.0.1", r1_client)
+            await c.connect()
+            acked = {}
+            for i in range(10):
+                e = i % N_ENS
+                r = await c.kput(e, f"k{i}", b"v%d" % i, timeout=120.0)
+                assert r[0] == "ok", r
+                acked[(e, f"k{i}")] = b"v%d" % i
+            await c.close()
+            return acked
+
+        acked = asyncio.run(drive_writes())
+
+        # kill -9 the LEADER host
+        p1, _, _ = procs["r1"]
+        p1.send_signal(signal.SIGKILL)
+        p1.wait()
+
+        # promote r2: needs r3's grant (majority 2/3 with self)
+        resp = _control(r2_repl, ("promote", [("127.0.0.1", r1_repl),
+                                              ("127.0.0.1", r3_repl)]),
+                        timeout=300.0)
+        assert resp[0] == "ok", resp
+
+        async def read_back_and_write():
+            c = svcnode.ServiceClient("127.0.0.1", r2_client)
+            await c.connect()
+            for (e, key), val in acked.items():
+                r = await c.kget(e, key, timeout=120.0)
+                assert r == ("ok", val), (key, r)
+            r = await c.kput(0, "post-failover", b"new", timeout=120.0)
+            assert r[0] == "ok", r
+            await c.close()
+
+        asyncio.run(read_back_and_write())
+
+        # the restarted OLD leader rejoins as a fenced replica and
+        # re-syncs; after that, killing r3 leaves r2+r1 as the
+        # quorum — the rejoined ex-leader carries its share
+        _restart(procs, dirs, "r1")
+        deadline = time.monotonic() + 120.0
+        synced = False
+        while time.monotonic() < deadline:
+            st = _control(r2_repl, ("status",))
+            # status: (status, role, promised, applied_ge, applied_seq)
+            st1 = _control(r1_repl, ("status",))
+            if st1[1] == "replica" and st1[3] == st[3] \
+                    and st1[4] == st[4]:
+                synced = True
+                break
+            time.sleep(1.0)
+        assert synced, (st, st1)
+        p3, _, _ = procs["r3"]
+        p3.send_signal(signal.SIGKILL)
+        p3.wait()
+
+        async def final_check():
+            c = svcnode.ServiceClient("127.0.0.1", r2_client)
+            await c.connect()
+            r = await c.kget(0, "post-failover", timeout=120.0)
+            assert r == ("ok", b"new"), r
+            r = await c.kput(1, "final", b"z", timeout=120.0)
+            assert r[0] == "ok", r
+            await c.close()
+
+        asyncio.run(final_check())
+    finally:
+        for p, _, _ in procs.values():
+            if p.poll() is None:
+                p.kill()
