@@ -26,3 +26,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def soak_seeds(base):
+    """CI runs the fixed seed list; soak sweeps widen it via
+    RETPU_SOAK_SEEDS="start:count" (fresh seeds, not repeats) so
+    long-running nemesis soaks measure new schedules every run."""
+    spec = os.environ.get("RETPU_SOAK_SEEDS")
+    if not spec:
+        return base
+    start, count = (int(x) for x in spec.split(":"))
+    return list(range(start, start + count))

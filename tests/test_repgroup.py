@@ -26,6 +26,8 @@ import time
 import numpy as np
 import pytest
 
+import conftest
+
 jax = pytest.importorskip("jax")
 
 from riak_ensemble_tpu.config import fast_test_config  # noqa: E402
@@ -298,7 +300,7 @@ def test_partition_sigstop_excludes_then_heals(group):
         [("ok", b"1"), ("ok", b"2"), ("ok", b"3")]
 
 
-@pytest.mark.parametrize("seed", [1101, 1102])
+@pytest.mark.parametrize("seed", conftest.soak_seeds([1101, 1102]))
 def test_repgroup_linearizable_under_host_nemesis(tmp_path, seed):
     """sc.erl over host failure domains: random put/get/CAS load
     against the leader while a nemesis kill -9s, SIGSTOPs and

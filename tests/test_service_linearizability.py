@@ -15,6 +15,8 @@ import itertools
 import numpy as np
 import pytest
 
+import conftest
+
 jax = pytest.importorskip("jax")
 
 from riak_ensemble_tpu.config import fast_test_config  # noqa: E402
@@ -116,7 +118,7 @@ def _submit_batch(rng, svc, models, vals, vsns, seed):
     return pending
 
 
-@pytest.mark.parametrize("seed", [701, 702, 703, 704, 705, 706])
+@pytest.mark.parametrize("seed", conftest.soak_seeds([701, 702, 703, 704, 705, 706]))
 def test_service_linearizable_under_nemesis(seed):
     rng = np.random.default_rng(seed)
     runtime = Runtime(seed=seed)
@@ -192,7 +194,7 @@ def test_service_linearizable_under_nemesis(seed):
     assert svc.flushes >= ROUNDS // 2
 
 
-@pytest.mark.parametrize("seed", [801, 802, 803, 804])
+@pytest.mark.parametrize("seed", conftest.soak_seeds([801, 802, 803, 804]))
 def test_service_linearizable_across_launch_failures(seed):
     """Device-launch failures (XLA error / dead backend shapes) join
     the nemesis: a seeded ~15% of full_step launches raise, the
@@ -283,7 +285,7 @@ def test_service_linearizable_across_launch_failures(seed):
     assert failures > 0, "scheduled nemesis firing was not observed"
 
 
-@pytest.mark.parametrize("seed", [901, 902, 903, 904])
+@pytest.mark.parametrize("seed", conftest.soak_seeds([901, 902, 903, 904]))
 def test_service_linearizable_under_corruption_nemesis(seed):
     """Device-state corruption joins the nemesis (VERDICT r3 #9): the
     sweep flips object/tree-leaf/tree-node lanes on a minority of

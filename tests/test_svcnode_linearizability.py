@@ -18,6 +18,8 @@ import itertools
 import numpy as np
 import pytest
 
+import conftest
+
 jax = pytest.importorskip("jax")
 
 from riak_ensemble_tpu import svcnode  # noqa: E402
@@ -117,6 +119,6 @@ async def _scenario(seed: int) -> None:
     await server.stop()
 
 
-@pytest.mark.parametrize("seed", [7101, 7102, 7103])
+@pytest.mark.parametrize("seed", conftest.soak_seeds([7101, 7102, 7103]))
 def test_svcnode_concurrent_clients_linearizable(seed):
     asyncio.run(_scenario(seed))
