@@ -300,14 +300,18 @@ class ReplicaCore:
         """Grant iff strictly newer; the grant persists BEFORE it is
         answered (a granted promise that didn't survive a crash would
         let a deposed leader commit after our restart)."""
-        if ge > self.promised:
-            self.promised = ge
-            save_group_meta(self.svc, self.promised, self.applied_ge,
-                            self.applied_seq)
-            return ("promised", True, self.promised, self.applied_ge,
+        meta_lock = getattr(self.svc, "_meta_lock", None)
+        import contextlib
+        with (meta_lock if meta_lock is not None
+              else contextlib.nullcontext()):
+            if ge > self.promised:
+                self.promised = ge
+                save_group_meta(self.svc, self.promised,
+                                self.applied_ge, self.applied_seq)
+                return ("promised", True, self.promised,
+                        self.applied_ge, self.applied_seq)
+            return ("promised", False, self.promised, self.applied_ge,
                     self.applied_seq)
-        return ("promised", False, self.promised, self.applied_ge,
-                self.applied_seq)
 
     def handle_apply(self, frame: Tuple) -> Tuple:
         (_, ge, seq, k, want_vsn, elect_b, lease_b, kind_b, slot_b,
@@ -557,6 +561,10 @@ class ReplicatedService(BatchedEnsembleService):
         self.group_size = group_size
         self.ack_timeout = ack_timeout
         self.install_timeout = install_timeout
+        #: serializes promise grants against a takeover's commit point
+        #: (a promise granted mid-campaign must never be regressed by
+        #: the campaign's own meta write)
+        self._meta_lock = threading.Lock()
         self.core = ReplicaCore(self)
         self._ge = self.core.applied_ge
         self._grp_seq = self.core.applied_seq
@@ -634,19 +642,26 @@ class ReplicatedService(BatchedEnsembleService):
                 # the source is NOT stale relative to us
                 link.needs_sync = False
                 link.remote_state = (ge, int(age), int(aseq))
-            self._ge = ge
-            self._grp_seq = self.core.applied_seq
-            self.core.promised = ge
-            save_group_meta(self, ge, self.core.applied_ge,
-                            self._grp_seq)
+            # Commit point, atomic vs concurrent promise grants: a
+            # higher promise granted mid-campaign (another candidate
+            # raced us) means we are already fenced — stand down
+            # rather than regress the persisted promise.
+            with self._meta_lock:
+                if self.core.promised > ge:
+                    return False
+                self._ge = ge
+                self._grp_seq = self.core.applied_seq
+                self.core.promised = ge
+                save_group_meta(self, ge, self.core.applied_ge,
+                                self._grp_seq)
+                self._deposed = False
+                self._is_leader = True
             # links whose promise reported our adopted (ge, seq) hold
             # bit-equal state (same applied prefix) — no re-sync
             for link, age, aseq in grants:
                 if (age, aseq) == (self.core.applied_ge,
                                    self._grp_seq):
                     link.needs_sync = False
-            self._deposed = False
-            self._is_leader = True
             self._emit("grp_takeover", {"epoch": ge,
                                         "seq": self._grp_seq})
             return True
@@ -883,7 +898,9 @@ class ReplicaServer:
                  data_dir: Optional[str] = None,
                  config: Optional[Config] = None,
                  tick: float = 0.005,
-                 ack_timeout: float = 2.0) -> None:
+                 ack_timeout: float = 2.0,
+                 peers: Sequence[Tuple[str, int]] = (),
+                 auto_failover: Optional[float] = None) -> None:
         runtime = WallRuntime()
         if data_dir is not None and (
                 os.path.exists(os.path.join(data_dir, "META"))
@@ -909,6 +926,34 @@ class ReplicaServer:
             host, client_port, self._serve_client_conn)
         self.repl_port = self._repl_srv.port
         self.client_port = self._client_srv.port
+        #: automatic leader failover (the reference's peers self-elect
+        #: on follower timeout, peer.erl's following -> probe ->
+        #: election; here the follower signal is leader silence on the
+        #: replication port): when ``auto_failover`` seconds pass with
+        #: no leader-originated frame AND this host ranks first by
+        #: (applied_ge, applied_seq, address) among reachable peers,
+        #: it promotes itself — promise-round fencing makes duels
+        #: safe, ranking merely avoids most of them.
+        self.peer_addrs = [(str(h), int(p)) for h, p in peers]
+        self.auto_failover = auto_failover
+        self._host = host
+        self._last_leader_contact = time.monotonic()
+        #: stable random identity for election tie-breaks: the BIND
+        #: host can differ from the address peers dial (wildcards,
+        #: NAT), so ranking by exchanged ids — not addresses — is the
+        #: only comparison both sides compute identically
+        import random as _random
+        self.node_id = _random.getrandbits(63)
+        #: campaign flag: a takeover in progress must not hold the big
+        #: lock across its network rounds (two campaigners would
+        #: deadlock each other's promise handlers); applies are
+        #: busy-nacked instead
+        self._campaign = False
+        if auto_failover is not None:
+            assert self.peer_addrs, \
+                "auto failover needs the peer address list"
+            threading.Thread(target=self._failover_monitor,
+                             daemon=True).start()
 
     # restore() classmethod inherits BatchedEnsembleService.restore,
     # which forwards **kw to the constructor — group_size rides along.
@@ -926,8 +971,18 @@ class ReplicaServer:
             except (ConnectionError, OSError, wire.WireError):
                 return
             try:
-                with self._lock:
-                    resp = self._handle_repl(frame)
+                if frame and frame[0] == "promote":
+                    # promotion runs OUTSIDE the big lock: a campaign
+                    # holding it across network rounds would block
+                    # this node's promise/status handlers — two
+                    # campaigning nodes would deadlock each other's
+                    # promise grants.  Concurrent applies are fenced
+                    # by the campaign flag (busy-nacks) instead.
+                    peers = [(str(h), int(p)) for h, p in frame[1]]
+                    resp = self._promote(peers)
+                else:
+                    with self._lock:
+                        resp = self._handle_repl(frame)
             except Exception:
                 import traceback
                 self.svc._emit("grp_replica_error",
@@ -940,6 +995,10 @@ class ReplicaServer:
 
     def _handle_repl(self, frame: Tuple) -> Tuple:
         op = frame[0]
+        if op in ("hello", "apply", "install"):
+            # leader-originated traffic: the failover monitor's
+            # liveness signal
+            self._last_leader_contact = time.monotonic()
         if op == "hello":
             ge = int(frame[1])
             # a newer leader's handshake supersedes this host's own
@@ -952,8 +1011,17 @@ class ReplicaServer:
             ge = int(frame[1])
             if ge > self.core.promised:
                 self._step_down()
+                # granting a vote resets the election timer (the raft
+                # discipline): the rival we just granted is about to
+                # become leader — don't campaign over it
+                self._last_leader_contact = time.monotonic()
             return self.core.handle_promise(ge)
         if op == "apply":
+            if self._campaign:
+                # a campaign is installing/pulling state concurrently;
+                # the leader treats this like any missed ack (re-sync)
+                return ("nack", "busy", self.core.promised,
+                        self.core.applied_ge, self.core.applied_seq)
             if self.svc.is_leader:
                 # a live apply stream at a newer epoch deposes us;
                 # at an older epoch it is nacked by the core
@@ -961,17 +1029,18 @@ class ReplicaServer:
                     self._step_down()
             return self.core.handle_apply(frame)
         if op == "install":
+            if self._campaign:
+                return ("nack", "busy", self.core.promised,
+                        self.core.applied_ge, self.core.applied_seq)
             if int(frame[1]) >= self.core.promised:
                 self._step_down()
             return self.core.handle_install(frame)
         if op == "pull":
             return self.core.handle_pull()
-        if op == "promote":
-            peers = [(str(h), int(p)) for h, p in frame[1]]
-            return self._promote(peers)
         if op == "status":
             return ("status", self.role, self.core.promised,
-                    self.core.applied_ge, self.core.applied_seq)
+                    self.core.applied_ge, self.core.applied_seq,
+                    self.node_id)
         return ("error", "unknown-op")
 
     def _step_down(self) -> None:
@@ -981,10 +1050,16 @@ class ReplicaServer:
             self.svc._emit("grp_step_down", {})
 
     def _promote(self, peers: List[Tuple[str, int]]) -> Tuple:
-        if not self.svc._links:
-            self.svc.attach_peers(peers)
-        rebuild_derived(self.svc)
-        ok = self.svc.takeover()
+        if self._campaign:
+            return ("error", "busy")
+        self._campaign = True
+        try:
+            if not self.svc._links:
+                self.svc.attach_peers(peers)
+            rebuild_derived(self.svc)
+            ok = self.svc.takeover()
+        finally:
+            self._campaign = False
         if not ok:
             return ("error", "no-majority")
         if self._flush_thread is None:
@@ -992,6 +1067,84 @@ class ReplicaServer:
                 target=self._flush_loop, daemon=True)
             self._flush_thread.start()
         return ("ok", self.svc._ge)
+
+    # -- automatic leader failover ------------------------------------------
+
+    def _failover_monitor(self) -> None:
+        import random
+
+        poll = max(0.2, self.auto_failover / 4.0)
+        while not self._stop:
+            time.sleep(poll)
+            try:
+                self._failover_check(poll, random)
+            except Exception:
+                # the monitor must outlive any single bad pass — a
+                # dead monitor thread silently disables failover for
+                # this node forever (review r4)
+                import traceback
+                self.svc._emit("grp_failover_error",
+                               {"error": traceback.format_exc(
+                                   limit=8)})
+                self._last_leader_contact = time.monotonic()
+
+    def _failover_check(self, poll: float, random) -> None:
+        if self.svc.is_leader:
+            return
+        if time.monotonic() - self._last_leader_contact \
+                < self.auto_failover:
+            return
+        if not self._ranks_first():
+            # a better-positioned candidate exists; give it a
+            # cycle (its promotion will contact us)
+            self._last_leader_contact = time.monotonic() \
+                - self.auto_failover * 0.5
+            return
+        time.sleep(random.uniform(0.0, poll))  # duel jitter
+        # re-check AFTER the jitter: a rival may have won meanwhile
+        # (its promise/hello updated our contact clock) — usurping it
+        # at a higher epoch would ping-pong leadership (review r4)
+        if self.svc.is_leader or self._stop or \
+                time.monotonic() - self._last_leader_contact \
+                < self.auto_failover:
+            return
+        self.svc._emit("grp_auto_failover_attempt",
+                       {"ge": self.core.promised})
+        r = self._promote(self.peer_addrs)
+        if r[0] != "ok":
+            # no majority reachable (we may be the minority side
+            # of a partition): back off a full window
+            self._last_leader_contact = time.monotonic()
+
+    def _ranks_first(self) -> bool:
+        """True when this host holds the newest (applied_ge,
+        applied_seq) among REACHABLE peers — ties broken by the
+        EXCHANGED node ids (bind addresses aren't comparable: the
+        name peers dial can differ from --host) — so at most one
+        candidate per connected component normally attempts the
+        promise round."""
+        me = (self.core.applied_ge, self.core.applied_seq,
+              self.node_id)
+        for host, port in self.peer_addrs:
+            try:
+                with socket.create_connection((host, port),
+                                              timeout=2.0) as s:
+                    s.settimeout(5.0)
+                    send_frame(s, ("status",))
+                    r = recv_frame(s)
+            except (OSError, ConnectionError, wire.WireError):
+                continue
+            if r[0] != "status":
+                continue
+            if r[1] == "leader":
+                # a live leader exists; we were just out of touch
+                self._last_leader_contact = time.monotonic()
+                return False
+            other = (int(r[3]), int(r[4]),
+                     int(r[5]) if len(r) > 5 else 0)
+            if other > me:
+                return False
+        return True
 
     HEARTBEAT_EVERY = 1.0
 
@@ -1141,15 +1294,30 @@ def main(argv=None) -> int:
     ap.add_argument("--n-slots", type=int, default=32)
     ap.add_argument("--data-dir", default=None)
     ap.add_argument("--fast", action="store_true")
+    ap.add_argument("--peer", action="append", default=[],
+                    metavar="HOST:PORT",
+                    help="another replica host's replication port "
+                         "(repeat per peer; required for "
+                         "--auto-failover)")
+    ap.add_argument("--auto-failover", type=float, default=None,
+                    metavar="SECONDS",
+                    help="self-promote when no leader traffic for "
+                         "this long and this host ranks first among "
+                         "reachable peers")
     args = ap.parse_args(argv)
 
     from riak_ensemble_tpu.config import fast_test_config
 
+    peers = []
+    for spec in args.peer:
+        h, p = spec.rsplit(":", 1)
+        peers.append((h, int(p)))
     srv = ReplicaServer(
         args.n_ens, args.group_size, args.n_slots,
         repl_port=args.repl_port, client_port=args.client_port,
         host=args.host, data_dir=args.data_dir,
-        config=fast_test_config() if args.fast else None)
+        config=fast_test_config() if args.fast else None,
+        peers=peers, auto_failover=args.auto_failover)
     print(f"repgroup replica repl={srv.repl_port} "
           f"client={srv.client_port}", flush=True)
     try:

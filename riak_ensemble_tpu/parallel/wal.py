@@ -181,19 +181,25 @@ class ServiceWAL:
         self.dir_path = dir_path
         self.sync_mode = sync_mode
         self._store = _open_store(os.path.join(dir_path, "wal"))
+        # The underlying stores are not thread-safe; a replica host's
+        # promise grants (connection threads) and its apply/campaign
+        # writes (other threads) share one WAL.
+        import threading
+        self._lock = threading.Lock()
 
     def log(self, records: List[Tuple[Any, Any]]) -> None:
         """Append a batch and make it durable per the sync mode.  MUST
         complete before the writes it covers are acked."""
-        for key, value in records:
-            self._store.store(key, value)
-        if self.sync_mode == "fsync":
-            self._store.sync()
-        else:
-            # buffer mode promises PROCESS-crash safety: the records
-            # must at least reach the kernel before the ack — a
-            # userspace io buffer dies with the process.
-            self._flush_store()
+        with self._lock:
+            for key, value in records:
+                self._store.store(key, value)
+            if self.sync_mode == "fsync":
+                self._store.sync()
+            else:
+                # buffer mode promises PROCESS-crash safety: the
+                # records must at least reach the kernel before the
+                # ack — a userspace io buffer dies with the process.
+                self._flush_store()
 
     def _flush_store(self) -> None:
         flush = getattr(self._store, "flush", None)
@@ -205,24 +211,28 @@ class ServiceWAL:
     def delete(self, keys: List[Any]) -> None:
         """Remove records (e.g. a destroyed ensemble's kv entries)
         with the same durability barrier as :meth:`log`."""
-        for key in keys:
-            self._store.delete(key)
-        if self.sync_mode == "fsync":
-            self._store.sync()
-        else:
-            # Mirror log(): buffer mode still promises process-crash
-            # durability, and a destroy's kv deletions sitting in the
-            # userspace stdio buffer would die with the process — the
-            # destroyed tenant's records would replay into a recycled
-            # row (ADVICE r3).
-            self._flush_store()
+        with self._lock:
+            for key in keys:
+                self._store.delete(key)
+            if self.sync_mode == "fsync":
+                self._store.sync()
+            else:
+                # Mirror log(): buffer mode still promises
+                # process-crash durability, and a destroy's kv
+                # deletions sitting in the userspace stdio buffer
+                # would die with the process — the destroyed tenant's
+                # records would replay into a recycled row (ADVICE r3).
+                self._flush_store()
 
     def records(self) -> List[Tuple[Any, Any]]:
-        return [(k, self._store.fetch(k)) for k in self._store.keys()]
+        with self._lock:
+            return [(k, self._store.fetch(k))
+                    for k in self._store.keys()]
 
     @property
     def count(self) -> int:
-        return self._store.count()
+        with self._lock:
+            return self._store.count()
 
     def close(self) -> None:
         self._store.close()

@@ -48,7 +48,7 @@ def _free_port() -> int:
 
 
 def _spawn_replica(data_dir: str, repl_port: int = 0,
-                   client_port: int = 0):
+                   client_port: int = 0, extra=()):
     """One replica host process (CPU-pinned child; the sitecustomize
     TPU plugin would hang on the dead tunnel otherwise).  A RESTART
     must reuse its old ports — the leader's links keep dialing the
@@ -66,7 +66,7 @@ def _spawn_replica(data_dir: str, repl_port: int = 0,
                        "--n-slots", "{N_SLOTS}", "--fast",
                        "--repl-port", "{repl_port}",
                        "--client-port", "{client_port}",
-                       "--data-dir", {data_dir!r}])
+                       "--data-dir", {data_dir!r}] + {list(extra)!r})
     """)
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     p = subprocess.Popen([sys.executable, "-c", child],
@@ -508,6 +508,110 @@ def test_leader_kill9_promote_replica_no_acked_loss(tmp_path):
             await c.close()
 
         asyncio.run(final_check())
+    finally:
+        for p, _, _ in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+
+def test_auto_failover_elects_new_leader_without_operator(tmp_path):
+    """Automatic leader failover (the reference's peers self-elect on
+    follower timeout; no operator in the loop): a cold-started group
+    elects exactly one leader by itself, survives kill -9 of that
+    leader by electing another within the failover window, loses no
+    acked write, and a restarted ex-leader settles back in as a
+    fenced replica."""
+    import asyncio
+
+    from riak_ensemble_tpu import svcnode
+
+    names = ("r1", "r2", "r3")
+    repl_ports = {n: _free_port() for n in names}
+    procs = {}
+    dirs = {}
+
+    def spawn(name):
+        others = [f"--peer=127.0.0.1:{repl_ports[o]}"
+                  for o in names if o != name]
+        return _spawn_replica(
+            dirs[name], repl_port=repl_ports[name],
+            extra=["--auto-failover", "3.0"] + others)
+
+    def roles():
+        out = {}
+        for n in names:
+            p = procs[n][0]
+            if p.poll() is not None:
+                continue
+            try:
+                st = _control(repl_ports[n], ("status",), timeout=10.0)
+                out[n] = st[1]
+            except (OSError, ConnectionError):
+                pass
+        return out
+
+    def wait_one_leader(deadline=90.0, exclude=()):
+        end = time.monotonic() + deadline
+        while time.monotonic() < end:
+            r = roles()
+            leaders = [n for n, role in r.items() if role == "leader"]
+            if len(leaders) == 1 and leaders[0] not in exclude:
+                return leaders[0]
+            time.sleep(1.0)
+        raise AssertionError(f"no single leader emerged: {roles()}")
+
+    try:
+        for name in names:
+            dirs[name] = str(tmp_path / name)
+            procs[name] = spawn(name)
+
+        # -- cold start: the group elects a leader BY ITSELF ----------
+        leader = wait_one_leader()
+
+        async def write(client_port, items):
+            c = svcnode.ServiceClient("127.0.0.1", client_port)
+            await c.connect()
+            for (e, key), val in items.items():
+                r = await c.kput(e, key, val, timeout=120.0)
+                assert r[0] == "ok", (key, r)
+            await c.close()
+
+        acked = {(i % N_ENS, f"k{i}"): b"v%d" % i for i in range(8)}
+        asyncio.run(write(procs[leader][2], acked))
+
+        # -- kill -9 the elected leader: a successor self-promotes ----
+        p, _, _ = procs[leader]
+        p.send_signal(signal.SIGKILL)
+        p.wait()
+        new_leader = wait_one_leader(exclude=(leader,))
+        assert new_leader != leader
+
+        async def read_all(client_port):
+            c = svcnode.ServiceClient("127.0.0.1", client_port)
+            await c.connect()
+            for (e, key), val in acked.items():
+                r = await c.kget(e, key, timeout=120.0)
+                assert r == ("ok", val), (key, r)
+            r = await c.kput(0, "post", b"new", timeout=120.0)
+            assert r[0] == "ok", r
+            await c.close()
+
+        asyncio.run(read_all(procs[new_leader][2]))
+
+        # -- the restarted ex-leader (same auto-failover config)
+        #    settles in as a fenced replica, not a duelist ------------
+        procs[leader] = spawn(leader)
+        end = time.monotonic() + 60.0
+        while time.monotonic() < end:
+            r = roles()
+            if r.get(leader) == "replica" \
+                    and r.get(new_leader) == "leader":
+                break
+            time.sleep(1.0)
+        r = roles()
+        assert r.get(leader) == "replica", r
+        assert [n for n, role in r.items()
+                if role == "leader"] == [new_leader], r
     finally:
         for p, _, _ in procs.values():
             if p.poll() is None:
