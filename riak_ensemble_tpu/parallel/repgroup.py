@@ -1279,6 +1279,144 @@ class _ThreadedAcceptor:
             pass
 
 
+class GroupClient:
+    """Leader-discovering client for a replication group: give it the
+    hosts' CLIENT ports and it finds (and sticks to) the leader,
+    re-discovering on connection loss or ``("error", "not-leader")``
+    rejections — the role the reference's leader-routing client plays
+    (riak_ensemble_client via the router's leader cache).
+
+    Retry discipline mirrors the wire client's ambiguity rules:
+    a **not-leader rejection is safely retried** (the op was never
+    dispatched into a flush), while a ``DISCONNECTED`` mid-op result
+    stays ambiguous and surfaces to the caller — auto-retrying a
+    write whose first attempt may have committed would double-apply.
+    """
+
+    #: per-host TCP connect budget during discovery: a blackholed
+    #: machine (the very failure this client routes around) must cost
+    #: seconds, not the OS SYN-retry timeout
+    CONNECT_TIMEOUT = 5.0
+
+    def __init__(self, hosts, op_timeout: float = 30.0,
+                 discover_timeout: float = 60.0) -> None:
+        import asyncio
+
+        from riak_ensemble_tpu import svcnode
+
+        self._svcnode = svcnode
+        self.hosts = [(str(h), int(p)) for h, p in hosts]
+        self.op_timeout = op_timeout
+        self.discover_timeout = discover_timeout
+        self._client = None
+        self._leader_addr = None
+        #: serializes discovery so concurrent ops on a fresh client
+        #: can't each open (and leak) their own connection.  Ops
+        #: themselves still pipeline on the shared connection; a
+        #: leader change mid-overlap may turn a sibling op's result
+        #: ambiguous (DISCONNECTED) — within the documented contract.
+        self._dlock = asyncio.Lock()
+
+    async def _discover(self, budget: Optional[float] = None):
+        import asyncio
+
+        deadline = time.monotonic() + (self.discover_timeout
+                                       if budget is None else budget)
+        async with self._dlock:
+            if self._client is not None:  # a sibling already found it
+                return self._client
+            while time.monotonic() < deadline:
+                for addr in self.hosts:
+                    c = self._svcnode.ServiceClient(*addr)
+                    try:
+                        await asyncio.wait_for(c.connect(),
+                                               self.CONNECT_TIMEOUT)
+                        st = await c.call("stats", timeout=10.0)
+                    except (OSError, ConnectionError,
+                            asyncio.TimeoutError):
+                        await c.close()
+                        continue
+                    if isinstance(st, dict) \
+                            and st.get("group", {}).get("leader"):
+                        self._client, self._leader_addr = c, addr
+                        return c
+                    await c.close()
+                await asyncio.sleep(1.0)
+        raise TimeoutError(
+            f"no leader found among {self.hosts} within the budget")
+
+    async def call(self, op: str, *args, retryable: bool = False):
+        """One op against the current leader, re-discovering and
+        retrying ONLY on safe-to-retry outcomes: not-leader
+        rejections (never dispatched) always retry; 'failed' retries
+        only for ``retryable`` ops (reads — side-effect-free, and a
+        fresh leader legitimately answers 'failed' while re-syncing
+        its quorum) and only within one op_timeout — a permanently
+        dead ensemble also answers 'failed', and that must surface,
+        not spin; ambiguous losses surface as DISCONNECTED.  The
+        whole call is bounded by ~discover_timeout: nested discovery
+        consumes the call's remaining budget, never a fresh one."""
+        import asyncio
+
+        deadline = time.monotonic() + self.discover_timeout
+        failed_deadline = None
+        while True:
+            c = self._client
+            if c is None:
+                c = await self._discover(
+                    max(1.0, deadline - time.monotonic()))
+            try:
+                r = await c.call(op, *args, timeout=self.op_timeout)
+            except asyncio.TimeoutError:
+                r = self._svcnode.ServiceClient.DISCONNECTED
+            if r == ("error", "not-leader"):
+                await self._drop()
+                if time.monotonic() < deadline:
+                    continue
+            if retryable and r == "failed":
+                now = time.monotonic()
+                if failed_deadline is None:
+                    failed_deadline = min(deadline,
+                                          now + self.op_timeout)
+                if now < failed_deadline:
+                    await asyncio.sleep(0.5)
+                    continue
+            if r == self._svcnode.ServiceClient.DISCONNECTED:
+                # ambiguous: hand it to the caller, but drop the
+                # connection so the NEXT op re-discovers
+                await self._drop()
+            return r
+
+    async def _drop(self) -> None:
+        if self._client is not None:
+            await self._client.close()
+        self._client = None
+        self._leader_addr = None
+
+    async def close(self) -> None:
+        await self._drop()
+
+    # the common keyed surface
+    async def kput(self, ens, key, value):
+        return await self.call("kput", ens, key, value)
+
+    async def kget(self, ens, key):
+        return await self.call("kget", ens, key, retryable=True)
+
+    async def kget_vsn(self, ens, key):
+        return await self.call("kget_vsn", ens, key, retryable=True)
+
+    async def kupdate(self, ens, key, vsn, value):
+        return await self.call("kupdate", ens, key, tuple(vsn), value)
+
+    async def kdelete(self, ens, key):
+        return await self.call("kdelete", ens, key)
+
+    async def kmodify(self, ens, key, fnref, default):
+        return await self.call("kmodify", ens, key, tuple(fnref),
+                               default)
+
+
 # -- CLI ---------------------------------------------------------------------
 
 def main(argv=None) -> int:

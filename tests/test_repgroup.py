@@ -616,3 +616,75 @@ def test_auto_failover_elects_new_leader_without_operator(tmp_path):
         for p, _, _ in procs.values():
             if p.poll() is None:
                 p.kill()
+
+
+def test_group_client_follows_the_leader(tmp_path):
+    """The leader-routing client role: GroupClient discovers the
+    elected leader among the hosts' client ports, sticks to it, and
+    re-discovers across a leader kill — not-leader rejections retry
+    transparently (never dispatched), ambiguous disconnections
+    surface to the caller."""
+    import asyncio
+
+    names = ("r1", "r2", "r3")
+    repl_ports = {n: _free_port() for n in names}
+    procs = {}
+    dirs = {}
+
+    def spawn(name):
+        others = [f"--peer=127.0.0.1:{repl_ports[o]}"
+                  for o in names if o != name]
+        return _spawn_replica(
+            dirs[name], repl_port=repl_ports[name],
+            extra=["--auto-failover", "3.0"] + others)
+
+    try:
+        for name in names:
+            dirs[name] = str(tmp_path / name)
+            procs[name] = spawn(name)
+        hosts = [("127.0.0.1", procs[n][2]) for n in names]
+
+        async def scenario():
+            gc = repgroup.GroupClient(hosts, op_timeout=120.0,
+                                      discover_timeout=180.0)
+            # discovery alone elects nothing — the group self-elects;
+            # the client just has to find whoever won
+            r = await gc.kput(0, "a", b"1")
+            assert r[0] == "ok", r
+            leader_addr = gc._leader_addr
+            assert leader_addr is not None
+
+            # kill the discovered leader: the next ops re-discover
+            # the successor and proceed (the in-flight loss, if any,
+            # would surface as DISCONNECTED — ambiguous by contract)
+            victim = [n for n in names
+                      if procs[n][2] == leader_addr[1]][0]
+            p, _, _ = procs[victim]
+            p.send_signal(signal.SIGKILL)
+            p.wait()
+
+            r = await gc.kget(0, "a")
+            if r == ("error", "disconnected"):
+                # the loss raced the read — ambiguous per contract;
+                # a retried READ is always safe (and reads also ride
+                # out a fresh leader's re-sync via retryable)
+                r = await gc.kget(0, "a")
+            assert r == ("ok", b"1"), r
+            assert gc._leader_addr != leader_addr
+            # the write may hit the new leader mid-re-sync ('failed' =
+            # definitive no-ack) or lose a connection (ambiguous);
+            # retrying an idempotent overwrite is the TEST's choice
+            for _ in range(30):
+                r = await gc.kput(0, "b", b"2")
+                if isinstance(r, tuple) and r[0] == "ok":
+                    break
+                import asyncio as _a
+                await _a.sleep(1.0)
+            assert r[0] == "ok", r
+            await gc.close()
+
+        asyncio.run(scenario())
+    finally:
+        for p, _, _ in procs.values():
+            if p.poll() is None:
+                p.kill()
