@@ -118,6 +118,36 @@ def unpack_results(flat: np.ndarray, e: int, m: int, k: int,
     return won, quorum_ok, corrupt, committed, get_ok, found, value, vsn
 
 
+def warmup_kernels(svc: "BatchedEnsembleService") -> None:
+    """Pre-compile the launch path's XLA programs on a THROWAWAY
+    state (never the live one: a warmup launch that mutated
+    ``svc.state`` outside the real op stream would corrupt it — and
+    on a replication-group replica, diverge it from its group).
+    Flush depths are pow2-bucketed, so warming k in
+    {0, 1, 2, ..., max_k} covers every program a flush can launch;
+    without this, the first flush at each new depth pays a
+    tens-of-seconds compile in the middle of serving — the real p99
+    spike the steady-state breakdown can't show."""
+    import jax.numpy as jnp
+
+    e, m, s = svc.n_ens, svc.n_peers, svc.n_slots
+    st = svc.engine.init_state(e, m, s)
+    elect = jnp.zeros((e,), bool)
+    cand = jnp.zeros((e,), jnp.int32)
+    up = jnp.ones((e, m), bool)
+    k = 0
+    while True:
+        kind = jnp.zeros((k, e), jnp.int32)
+        lease = jnp.zeros((k, e), bool)
+        _, won, res = svc.engine.full_step(
+            st, elect, cand, kind, kind, kind, lease, up,
+            exp_epoch=kind, exp_seq=kind)
+        np.asarray(_pack_results(won, res, True))
+        if k >= svc.max_k:
+            break
+        k = 1 if k == 0 else k * 2
+
+
 class _LocalEngine:
     """Default engine adapter: the module kernels, single-process jit
     (data-parallel over whatever devices XLA picks).  A
@@ -320,6 +350,12 @@ class BatchedEnsembleService:
         #: queued device ROUNDS per ensemble (a batch entry occupies
         #: entry.n rounds) — drives flush depth and the burst trigger
         self._queue_rounds: List[int] = [0] * n_ens
+        #: ensembles with queued ops / pending recycles: flush and the
+        #: recycle drain iterate THESE, not range(n_ens) — at 10k
+        #: ensembles with sparse traffic the O(E) Python sweep per
+        #: flush would dwarf the work itself
+        self._active: set = set()
+        self._recycle_dirty: set = set()
         #: leader leases, host-side: ensemble -> expiry (runtime.now)
         self.lease_until = np.zeros((n_ens,), dtype=float)
         self.flushes = 0
@@ -446,6 +482,7 @@ class BatchedEnsembleService:
             self._fail_entry(row, op)
         self.queues[row] = []
         self._queue_rounds[row] = 0
+        self._active.discard(row)
         mask = np.zeros((self.n_ens,), bool)
         mask[row] = True
         jnp = self._jnp
@@ -691,7 +728,7 @@ class BatchedEnsembleService:
                 for key, s, g, p in keyslots:
                     r = results[p]
                     if isinstance(r, tuple) and r[0] == "ok":
-                        self._recycle_pending[ens].append((key, s, g))
+                        self._queue_recycle(ens, (key, s, g))
             fut.add_waiter(recycle)
         return fut
 
@@ -921,7 +958,7 @@ class BatchedEnsembleService:
 
         def recycle(result):
             if isinstance(result, tuple) and result[0] == "ok":
-                self._recycle_pending[ens].append((key, slot, gen))
+                self._queue_recycle(ens, (key, slot, gen))
         fut.add_waiter(recycle)
 
     def watch_leader(self, ens: int, fn) -> None:
@@ -1249,6 +1286,10 @@ class BatchedEnsembleService:
         svc.slot_gen = host["slot_gen"]
         svc.slot_handle = host["slot_handle"]
         svc._recycle_pending = host["recycle_pending"]
+        # restored pending recycles must re-enter the dirty set or
+        # the sparse drain would never revisit them (leaked slots)
+        svc._recycle_dirty = {e for e, p in
+                              enumerate(svc._recycle_pending) if p}
         svc.values = host["values"]
         svc._free_handles = host["free_handles"]
         svc._next_handle = host["next_handle"]
@@ -1457,7 +1498,10 @@ class BatchedEnsembleService:
         references them and the conditions still hold: no later put
         bumped the generation, nothing live is committed, and the key
         still owns the slot."""
-        for e in range(self.n_ens):
+        if not self._recycle_dirty:
+            return
+        dirty, self._recycle_dirty = self._recycle_dirty, set()
+        for e in dirty:
             pend = self._recycle_pending[e]
             if not pend:
                 continue
@@ -1479,6 +1523,8 @@ class BatchedEnsembleService:
                 # else: the slot was re-used meanwhile — drop the stale
                 # recycle request
             self._recycle_pending[e] = keep
+            if keep:  # still blocked: revisit on a later drain
+                self._recycle_dirty.add(e)
 
     def _push(self, ens: int, op) -> None:
         """Enqueue one pending entry (timestamped for the queue-wait
@@ -1486,7 +1532,13 @@ class BatchedEnsembleService:
         op.t_enq = time.perf_counter()
         self.queues[ens].append(op)
         self._queue_rounds[ens] += op.n
+        self._active.add(ens)
         self._maybe_kick(ens)
+
+    def _queue_recycle(self, ens: int, item: Tuple[Any, int, int]
+                       ) -> None:
+        self._recycle_pending[ens].append(item)
+        self._recycle_dirty.add(ens)
 
     def _maybe_kick(self, ens: int) -> None:
         """Burst trigger: a queue that just reached a full launch's
@@ -1509,7 +1561,7 @@ class BatchedEnsembleService:
             # guard) keeps draining — including its sub-threshold
             # tail, which is part of the same burst, not a fresh
             # trickle that should wait for the tick.
-            if any(self.queues):
+            if self._active:
                 self._kick_pending = True
                 self.runtime.defer(kick)
         self.runtime.defer(kick)
@@ -1851,7 +1903,10 @@ class BatchedEnsembleService:
 
     def flush(self) -> int:
         """One device launch for everything queued; returns ops served."""
-        k = min(self.max_k, max(self._queue_rounds, default=0))
+        active = self._active
+        k = min(self.max_k,
+                max((self._queue_rounds[e] for e in active),
+                    default=0))
         if k == 0 and not self._election_inputs()[0].any():
             return 0
         # Bucket the batch depth to the next power of two (capped at
@@ -1871,8 +1926,12 @@ class BatchedEnsembleService:
         val = np.zeros((k, self.n_ens), dtype=np.int32)
         exp_e = np.zeros((k, self.n_ens), dtype=np.int32)
         exp_s = np.zeros((k, self.n_ens), dtype=np.int32)
-        taken: List[List[Any]] = []
-        for e in range(self.n_ens):
+        #: (ensemble, taken ops) pairs — ACTIVE ensembles only (the
+        #: op matrices stay full-width [K, E]; only the host loops
+        #: skip idle columns)
+        taken: List[Tuple[int, List[Any]]] = []
+        still_active = set()
+        for e in sorted(active):
             q = self.queues[e]
             ops: List[Any] = []
             rounds = idx = 0
@@ -1893,7 +1952,10 @@ class BatchedEnsembleService:
                     break
             self.queues[e] = q[idx:]
             self._queue_rounds[e] -= rounds
-            taken.append(ops)
+            if self.queues[e]:
+                still_active.add(e)
+            if ops:
+                taken.append((e, ops))
             j = 0
             for op in ops:
                 if isinstance(op, _PendingBatch):
@@ -1912,6 +1974,7 @@ class BatchedEnsembleService:
                     exp_e[j, e], exp_s[j, e] = op.exp
                     j += 1
 
+        self._active = still_active
         try:
             planes = self._launch(kind, slot, val, k, want_vsn=True,
                                   exp_e=exp_e, exp_s=exp_s,
@@ -1927,7 +1990,7 @@ class BatchedEnsembleService:
             # catch covers ONLY the launch: an exception from a
             # client's future-waiter inside the resolve loop must not
             # fail ops that committed on device.
-            for e, ops in enumerate(taken):
+            for e, ops in taken:
                 for op in ops:
                     self._fail_entry(e, op)
             raise
@@ -1956,7 +2019,7 @@ class BatchedEnsembleService:
         # analyzable (VERDICT r2 weak #2).
         rec = self._lat_last
         self._lat_last = {}
-        oldest = min((op.t_enq for ops in taken for op in ops
+        oldest = min((op.t_enq for _e, ops in taken for op in ops
                       if op.t_enq), default=t_wal)
         rec["queue_wait"] = max(0.0, t_wal - oldest
                                 - rec.get("total", 0.0))
@@ -1995,7 +2058,7 @@ class BatchedEnsembleService:
         vsn_l = vsn.tolist()
         puts = (eng.OP_PUT, eng.OP_CAS)
         recs = []
-        for e, ops in enumerate(taken):
+        for e, ops in taken:
             j = -1
             for op in ops:
                 if isinstance(op, _PendingBatch):
@@ -2054,8 +2117,8 @@ class BatchedEnsembleService:
             for i in range(op.n):
                 self._release_handle(handle_l[i])
                 if op.keys is not None:
-                    self._recycle_pending[e].append(
-                        (op.keys[i], slot_l[i], gen_l[i]))
+                    self._queue_recycle(e, (op.keys[i], slot_l[i],
+                                            gen_l[i]))
         op.accum.fill(op.fut, op.pos.tolist(), ["failed"] * op.n,
                       self._safe_resolve)
 
@@ -2073,7 +2136,7 @@ class BatchedEnsembleService:
             # this put bumped the generation): queue it for recycling
             # or the slot leaks until the key is deleted.
             if op.key is not None:
-                self._recycle_pending[e].append((op.key, op.slot, op.gen))
+                self._queue_recycle(e, (op.key, op.slot, op.gen))
         self._safe_resolve(op.fut, "failed")
 
     def _resolve_batch(self, e: int, j: int, op: _PendingBatch,
@@ -2092,7 +2155,10 @@ class BatchedEnsembleService:
             gen_l = op.gen.tolist()
             keys = op.keys if op.keys is not None else [None] * n
             slot_handle = self.slot_handle[e]
+            # direct append binding for the hot loop; one dirty mark
+            # covers every recycle this batch queues
             recycle = self._recycle_pending[e].append
+            self._recycle_dirty.add(e)
             release = self._release_handle
             for comm, s, h, g, key, vs in zip(comm_l, slot_l,
                                               handle_l, gen_l, keys,
@@ -2145,7 +2211,7 @@ class BatchedEnsembleService:
         # scalar indexing costs ~5x more than list indexing at
         # thousands of ops per flush.
         if committed is None:  # k == 0: election-only launch, no ops
-            assert not any(taken), "ops taken but no result planes"
+            assert not taken, "ops taken but no result planes"
             self._drain_recycles()
             return 0
         committed_l = committed.tolist()
@@ -2155,10 +2221,7 @@ class BatchedEnsembleService:
         vsn_l = vsn.tolist()
         served = 0
         puts = (eng.OP_PUT, eng.OP_CAS)
-        for e in range(self.n_ens):
-            ops = taken[e]
-            if not ops:
-                continue
+        for e, ops in taken:
             slot_handle = self.slot_handle[e]
             j = -1
             for op in ops:

@@ -93,7 +93,8 @@ from riak_ensemble_tpu import wire
 from riak_ensemble_tpu.config import Config
 from riak_ensemble_tpu.ops import engine as eng
 from riak_ensemble_tpu.parallel.batched_host import (
-    BatchedEnsembleService, WallRuntime, _PendingBatch)
+    BatchedEnsembleService, WallRuntime, _PendingBatch,
+    warmup_kernels)
 
 _HDR = struct.Struct(">I")
 #: install frames carry full engine-state snapshots
@@ -252,7 +253,7 @@ def _entries_meta(entries, kind: np.ndarray, slot: np.ndarray,
     meta: List[Tuple] = []
     if entries is None:
         return meta
-    for e, ops in enumerate(entries):
+    for e, ops in entries:
         j = -1
         for op in ops:
             if isinstance(op, _PendingBatch):
@@ -849,35 +850,6 @@ class ReplicatedService(BatchedEnsembleService):
             link.close()
 
 
-def warmup_kernels(svc: BatchedEnsembleService) -> None:
-    """Pre-compile the apply path's XLA programs on a THROWAWAY state
-    (never the live lane: a warmup launch that mutated ``svc.state``
-    outside the apply stream would diverge this replica from its
-    group).  Flush depths are pow2-bucketed, so warming k in
-    {0, 1, 2, ..., max_k} covers every program a leader can ship;
-    without this, the first real apply pays a tens-of-seconds compile
-    inside the leader's ack window and gets this replica marked stale.
-    """
-    import jax.numpy as jnp
-
-    from riak_ensemble_tpu.parallel.batched_host import _pack_results
-
-    e, s = svc.n_ens, svc.n_slots
-    st = svc.engine.init_state(e, 1, s)
-    elect = jnp.zeros((e,), bool)
-    cand = jnp.zeros((e,), jnp.int32)
-    up = jnp.ones((e, 1), bool)
-    k = 0
-    while True:
-        kind = jnp.zeros((k, e), jnp.int32)
-        lease = jnp.zeros((k, e), bool)
-        _, won, res = svc.engine.full_step(
-            st, elect, cand, kind, kind, kind, lease, up,
-            exp_epoch=kind, exp_seq=kind)
-        np.asarray(_pack_results(won, res, True))
-        if k >= svc.max_k:
-            break
-        k = 1 if k == 0 else k * 2
 
 
 # -- the replica host process ------------------------------------------------
@@ -1370,7 +1342,7 @@ class GroupClient:
             except asyncio.TimeoutError:
                 r = self._svcnode.ServiceClient.DISCONNECTED
             if r == ("error", "not-leader"):
-                await self._drop()
+                await self._drop(c)
                 if time.monotonic() < deadline:
                     continue
             if retryable and r == "failed":
@@ -1384,10 +1356,17 @@ class GroupClient:
             if r == self._svcnode.ServiceClient.DISCONNECTED:
                 # ambiguous: hand it to the caller, but drop the
                 # connection so the NEXT op re-discovers
-                await self._drop()
+                await self._drop(c)
             return r
 
-    async def _drop(self) -> None:
+    async def _drop(self, failed=None) -> None:
+        """Compare-and-drop: only tear down the shared connection if
+        it is STILL the one that failed — a stale result from an old
+        connection must not close a freshly discovered healthy leader
+        out from under sibling ops."""
+        if failed is not None and self._client is not failed:
+            await failed.close()
+            return
         if self._client is not None:
             await self._client.close()
         self._client = None

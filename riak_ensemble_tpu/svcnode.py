@@ -375,7 +375,8 @@ async def serve(n_ens: int, n_peers: int, n_slots: int,
                 tick: float = 0.005,
                 config: Optional[Config] = None,
                 engine: Any = None, dynamic: Optional[bool] = None,
-                data_dir: Optional[str] = None) -> ServiceServer:
+                data_dir: Optional[str] = None,
+                warm: bool = False) -> ServiceServer:
     """Bring up runtime + service + server; returns the started
     server (call ``await server.stop()`` to tear down).
 
@@ -408,6 +409,12 @@ async def serve(n_ens: int, n_peers: int, n_slots: int,
         svc = BatchedEnsembleService(
             runtime, n_ens, n_peers, n_slots, tick=tick, config=cfg,
             engine=engine, dynamic=bool(dynamic), data_dir=data_dir)
+    if warm:
+        # pre-compile the pow2 flush-depth ladder so no client ever
+        # pays a mid-serving first-compile inside its op latency
+        from riak_ensemble_tpu.parallel.batched_host import (
+            warmup_kernels)
+        warmup_kernels(svc)
     server = ServiceServer(svc, host, port)
     await server.start()
     return server
@@ -431,6 +438,10 @@ def main(argv=None) -> int:
     ap.add_argument("--data-dir", default=None,
                     help="durability root (WAL + checkpoints); acked "
                          "writes survive crashes")
+    ap.add_argument("--warm", action="store_true",
+                    help="pre-compile the flush-depth ladder before "
+                         "accepting clients (slower boot, no "
+                         "mid-serving compile spikes)")
     args = ap.parse_args(argv)
 
     async def run() -> None:
@@ -438,7 +449,8 @@ def main(argv=None) -> int:
             args.n_ens, args.n_peers, args.n_slots, args.host,
             args.port, args.tick,
             config=fast_test_config() if args.fast else None,
-            dynamic=args.dynamic, data_dir=args.data_dir)
+            dynamic=args.dynamic, data_dir=args.data_dir,
+            warm=args.warm)
         print(f"svcnode serving {args.n_ens} ensembles on "
               f"{server.host}:{server.port}", flush=True)
         try:
