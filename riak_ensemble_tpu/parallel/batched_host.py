@@ -1179,7 +1179,7 @@ class BatchedEnsembleService:
         assert path is not None, "save() needs a path or data_dir"
         self._in_save = True
         try:
-            while any(self.queues):
+            while self._active:
                 self.flush()
         finally:
             self._in_save = False
@@ -1596,7 +1596,7 @@ class BatchedEnsembleService:
                 val: np.ndarray, k: int, want_vsn: bool,
                 exp_e: Optional[np.ndarray] = None,
                 exp_s: Optional[np.ndarray] = None,
-                entries: Optional[List[List[Any]]] = None,
+                entries: Optional[List[Tuple[int, List[Any]]]] = None,
                 elect: Optional[np.ndarray] = None,
                 cand: Optional[np.ndarray] = None,
                 lease_ok: Optional[np.ndarray] = None):
@@ -1606,8 +1606,9 @@ class BatchedEnsembleService:
         exchange.  Returns np result arrays (vsn None unless asked —
         it is the largest transfer and bulk callers rarely need it).
 
-        ``entries`` is the flush's taken queue entries (None for bulk
-        execute); the base launch doesn't need them, but the
+        ``entries`` is the flush's taken queue entries as
+        (ensemble, ops) pairs over the ACTIVE ensembles (None for
+        bulk execute); the base launch doesn't need them, but the
         replicated subclass (:mod:`..parallel.repgroup`) ships their
         key/payload metadata to its peer hosts.  ``elect``/``cand``/
         ``lease_ok`` may be passed precomputed so a wrapper that must

@@ -166,9 +166,11 @@ def result_crc(committed: Optional[np.ndarray],
 
 def dump_state(svc: BatchedEnsembleService) -> Tuple:
     """Full snapshot of one host's lane: every engine array plus the
-    keyed host mirrors a promoted leader needs.  Wire-safe (no
-    pickle: the group transport keeps the no-code-on-decode trust
-    model of the cluster transport)."""
+    keyed host mirrors a promoted leader needs — including the
+    dynamic-lifecycle directory (live rows, free pool, tenant names)
+    when the group serves dynamic tenants.  Wire-safe (no pickle: the
+    group transport keeps the no-code-on-decode trust model of the
+    cluster transport)."""
     fields = []
     for name, arr in zip(eng.EngineState._fields, svc.state):
         a = np.asarray(arr)
@@ -179,6 +181,11 @@ def dump_state(svc: BatchedEnsembleService) -> Tuple:
         list(svc.values.items()),
         int(svc._next_handle),
         _pack_i32(svc.leader_np),
+        bool(svc.dynamic),
+        _pack_bool(svc._live),
+        list(svc._free_rows),
+        list(svc._ens_names.items()),
+        _pack_bool(svc.member_np.ravel()),
     )
     return (tuple(fields), host)
 
@@ -198,7 +205,8 @@ def install_state(svc: BatchedEnsembleService, dump: Tuple) -> None:
         new[name] = jnp.asarray(
             np.frombuffer(raw, np.dtype(dt)).reshape(shape))
     svc.state = eng.EngineState(**new)
-    key_slot, slot_handle, values, next_handle, leader_b = host
+    (key_slot, slot_handle, values, next_handle, leader_b, dynamic,
+     live_b, free_rows, ens_names, member_b) = host
     svc.key_slot = [dict(pairs) for pairs in key_slot]
     svc.slot_handle = [{int(s): int(h) for s, h in pairs}
                        for pairs in slot_handle]
@@ -206,6 +214,22 @@ def install_state(svc: BatchedEnsembleService, dump: Tuple) -> None:
     svc._next_handle = int(next_handle)
     svc._free_handles = []
     svc.leader_np = _unpack_i32(leader_b, (svc.n_ens,))
+    svc.member_np = _unpack_bool(member_b,
+                                 svc.n_ens * svc.n_peers).reshape(
+        svc.n_ens, svc.n_peers)
+    if bool(dynamic) != svc.dynamic:
+        # a mixed group would HALF-sync (directory dropped or stale):
+        # fail the install loudly instead (review r4)
+        raise ValueError(
+            f"lifecycle-mode mismatch: snapshot dynamic={bool(dynamic)}"
+            f" vs this lane dynamic={svc.dynamic} — every group host "
+            "must run the same --dynamic setting")
+    if bool(dynamic):
+        svc.dynamic = True
+        svc._live = _unpack_bool(live_b, svc.n_ens)
+        svc._free_rows = [int(r) for r in free_rows]
+        svc._ens_names = dict(ens_names)
+        svc._row_name = {r: n for n, r in svc._ens_names.items()}
     rebuild_derived(svc)
 
 
@@ -396,6 +420,38 @@ class ReplicaCore:
         else:
             if key is not None:
                 svc.key_slot[e].pop(key, None)
+
+    def handle_lcl(self, frame: Tuple) -> Tuple:
+        """Replicated dynamic-lifecycle op (create/destroy ensemble):
+        rides the SAME (epoch, seq) stream as applies — lifecycle
+        mutates device rows and the tenant directory, so an
+        unreplicated create would diverge the lanes.  Deterministic
+        by the same induction: identical directories evolve
+        identically, so row assignment and even failure outcomes
+        (name taken, no capacity) match bit-for-bit."""
+        _, ge, seq, kind, name, view_b = frame
+        svc = self.svc
+        if ge != self.promised or ge < self.applied_ge:
+            return ("nack", "epoch", self.promised, self.applied_ge,
+                    self.applied_seq)
+        if seq == self.applied_seq and ge == self.applied_ge:
+            return ("applied", ge, seq, self.last_crc)
+        if seq != self.applied_seq + 1:
+            return ("nack", "seq", self.promised, self.applied_ge,
+                    self.applied_seq)
+        if kind == "create":
+            view = (None if view_b is None
+                    else _unpack_bool(view_b, svc.n_peers))
+            row = BatchedEnsembleService.create_ensemble(
+                svc, name, view)
+            crc = row if row is not None else -1
+        else:
+            ok = BatchedEnsembleService.destroy_ensemble(svc, name)
+            crc = 1 if ok else 0
+        self.applied_ge, self.applied_seq = ge, seq
+        self.last_crc = crc
+        save_group_meta(svc, self.promised, ge, seq)
+        return ("applied", ge, seq, crc)
 
     def handle_install(self, frame: Tuple) -> Tuple:
         _, ge, seq, dump = frame
@@ -831,6 +887,69 @@ class ReplicatedService(BatchedEnsembleService):
                 "membership and is fixed at construction")
         return super().update_members(sel, new_view)
 
+    # -- replicated dynamic lifecycle ---------------------------------------
+
+    def create_ensemble(self, name, view=None):
+        """Dynamic tenant creation with the SAME host-quorum barrier
+        as writes: the op rides the group (epoch, seq) stream, every
+        lane applies it deterministically (identical directories →
+        identical row assignment and failure outcomes), and the row
+        is returned only after a host majority acked.  Raises on lost
+        quorum — the local create stands (minority residue healed by
+        re-sync on heal), but the caller must not act on it."""
+        row, _ = self._lifecycle("create", name, view)
+        return row
+
+    def destroy_ensemble(self, name):
+        _, ok = self._lifecycle("destroy", name, None)
+        return ok
+
+    def _lifecycle(self, kind: str, name, view):
+        if not self._links and self.group_size == 1:
+            if kind == "create":
+                return super().create_ensemble(name, view), None
+            return None, super().destroy_ensemble(name)
+        if not self.is_leader:
+            raise DeposedError("not the group leader")
+        seq = self._grp_seq + 1
+        view_b = None if view is None else _pack_bool(
+            np.asarray(view, bool))
+        frame = ("lcl", self._ge, seq, kind, name, view_b)
+        sends = [(l, l.post(frame)) for l in self._links
+                 if not l.needs_sync]
+        if kind == "create":
+            row = super().create_ensemble(name, view)
+            ok = None
+            crc = row if row is not None else -1
+        else:
+            row = None
+            ok = super().destroy_ensemble(name)
+            crc = 1 if ok else 0
+        self._grp_seq = seq
+        self.core.applied_ge = self._ge
+        self.core.applied_seq = seq
+        self.core.last_crc = crc
+        if self._wal is not None:
+            save_group_meta(self, self.core.promised, self._ge, seq)
+        acked = 0
+        deadline = time.monotonic() + self.ack_timeout
+        for link, t in sends:
+            r = PeerLink.wait(t, deadline)
+            if r is not None and r[0] == "applied" \
+                    and int(r[3]) == crc:
+                acked += 1
+            elif r is not None and r[0] == "nack" and r[1] == "epoch" \
+                    and int(r[2]) > self._ge:
+                self._note_depose(int(r[2]))
+                link.needs_sync = True
+            else:
+                link.needs_sync = True
+        if (1 + acked) < (self.group_size // 2 + 1) or self._deposed:
+            raise RuntimeError(
+                f"lifecycle {kind} {name!r}: no host quorum "
+                f"({1 + acked}/{self.group_size})")
+        return row, ok
+
     def stats(self) -> Dict[str, Any]:
         s = super().stats()
         s["group"] = {
@@ -872,20 +991,22 @@ class ReplicaServer:
                  tick: float = 0.005,
                  ack_timeout: float = 2.0,
                  peers: Sequence[Tuple[str, int]] = (),
-                 auto_failover: Optional[float] = None) -> None:
+                 auto_failover: Optional[float] = None,
+                 dynamic: bool = False) -> None:
         runtime = WallRuntime()
         if data_dir is not None and (
                 os.path.exists(os.path.join(data_dir, "META"))
                 or os.path.exists(os.path.join(data_dir, "CURRENT"))):
+            dyn_kw = {"dynamic": True} if dynamic else {}
             self.svc = ReplicatedService.restore(
                 runtime, data_dir, group_size=group_size,
                 data_dir=data_dir, config=config,
-                ack_timeout=ack_timeout)
+                ack_timeout=ack_timeout, **dyn_kw)
         else:
             self.svc = ReplicatedService(
                 runtime, n_ens, 1, n_slots, group_size=group_size,
                 data_dir=data_dir, config=config,
-                ack_timeout=ack_timeout)
+                ack_timeout=ack_timeout, dynamic=dynamic)
         self.core = self.svc.core
         warmup_kernels(self.svc)
         self.tick = tick
@@ -967,7 +1088,7 @@ class ReplicaServer:
 
     def _handle_repl(self, frame: Tuple) -> Tuple:
         op = frame[0]
-        if op in ("hello", "apply", "install"):
+        if op in ("hello", "apply", "install", "lcl"):
             # leader-originated traffic: the failover monitor's
             # liveness signal
             self._last_leader_contact = time.monotonic()
@@ -1000,6 +1121,14 @@ class ReplicaServer:
                 if int(frame[1]) > self.core.promised:
                     self._step_down()
             return self.core.handle_apply(frame)
+        if op == "lcl":
+            if self._campaign:
+                return ("nack", "busy", self.core.promised,
+                        self.core.applied_ge, self.core.applied_seq)
+            if self.svc.is_leader and \
+                    int(frame[1]) > self.core.promised:
+                self._step_down()
+            return self.core.handle_lcl(frame)
         if op == "install":
             if self._campaign:
                 return ("nack", "busy", self.core.promised,
@@ -1128,7 +1257,7 @@ class ReplicaServer:
                 continue
             try:
                 with self._lock:
-                    if any(self.svc.queues) or \
+                    if self.svc._active or \
                             self.svc._election_inputs()[0].any():
                         self.svc.flush()
                         last_beat = time.monotonic()
@@ -1174,6 +1303,42 @@ class ReplicaServer:
                 continue
             if not self.svc.is_leader:
                 send(req_id, ("error", "not-leader"))
+                continue
+            if op in ("create_ensemble", "destroy_ensemble",
+                      "resolve_ensemble"):
+                # synchronous replicated lifecycle (quorum-barriered)
+                try:
+                    with self._lock:
+                        if op == "create_ensemble":
+                            view = args[1] if len(args) > 1 else None
+                            if args[0] in self.svc._ens_names:
+                                # duplicate != capacity: an
+                                # orchestrator reacting to
+                                # "no-capacity" must not act on a
+                                # false premise (review r4)
+                                resp = ("error", "exists")
+                            else:
+                                row = self.svc.create_ensemble(
+                                    args[0], view)
+                                resp = (("ok", row)
+                                        if row is not None
+                                        else ("error", "no-capacity"))
+                        elif op == "destroy_ensemble":
+                            resp = (("ok",)
+                                    if self.svc.destroy_ensemble(
+                                        args[0])
+                                    else ("error", "unknown"))
+                        else:
+                            row = self.svc.resolve_ensemble(args[0])
+                            resp = (("ok", row) if row is not None
+                                    else ("error", "unknown"))
+                except DeposedError:
+                    # deposed between the role check and the lock: the
+                    # op was never dispatched — clients re-route
+                    resp = ("error", "not-leader")
+                except Exception:
+                    resp = ("error", "failed")
+                send(req_id, resp)
                 continue
             try:
                 with self._lock:
@@ -1416,6 +1581,9 @@ def main(argv=None) -> int:
                     help="another replica host's replication port "
                          "(repeat per peer; required for "
                          "--auto-failover)")
+    ap.add_argument("--dynamic", action="store_true",
+                    help="dynamic tenant lifecycle (replicated "
+                         "create/destroy over the group)")
     ap.add_argument("--auto-failover", type=float, default=None,
                     metavar="SECONDS",
                     help="self-promote when no leader traffic for "
@@ -1434,7 +1602,8 @@ def main(argv=None) -> int:
         repl_port=args.repl_port, client_port=args.client_port,
         host=args.host, data_dir=args.data_dir,
         config=fast_test_config() if args.fast else None,
-        peers=peers, auto_failover=args.auto_failover)
+        peers=peers, auto_failover=args.auto_failover,
+        dynamic=args.dynamic)
     print(f"repgroup replica repl={srv.repl_port} "
           f"client={srv.client_port}", flush=True)
     try:

@@ -191,7 +191,10 @@ class ServiceReconciler:
         self._want_since: Dict[Any, int] = {}
         self.empty_grace_ticks = 8
         self._tick_no = 0
-        self._timer = runtime.schedule(poll, self._on_tick)
+        #: poll=None -> caller-driven tick() (WallRuntime deployments
+        #: and repgroup owners drive it from their own loops)
+        self._timer = (runtime.schedule(poll, self._on_tick)
+                       if poll is not None else None)
 
     # -- handoff surface (called by peer reconcilers) -----------------------
 

@@ -150,6 +150,10 @@ class ServiceServer:
                 if len(args) > 1 and args[1] is not None:
                     import numpy as np
                     view = np.asarray(args[1], bool)
+                if name in self.svc._ens_names:
+                    # duplicate != capacity (an orchestrator must not
+                    # provision more rows over an idempotent retry)
+                    return ("error", "exists")
                 row = self.svc.create_ensemble(name, view)
                 return (("ok", row) if row is not None
                         else ("error", "no-capacity"))

@@ -1,0 +1,164 @@
+"""Dynamic tenants on replication groups — multi-tenancy composed
+with host-fault tolerance: create/destroy ride the group's
+(epoch, seq) stream with the same host-quorum barrier as writes, the
+tenant directory survives leader death (snapshot installs carry it),
+and the consensus-managed reconciler can place tenants on a
+replication-group owner."""
+
+import signal
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from riak_ensemble_tpu import service_directory as sd  # noqa: E402
+from riak_ensemble_tpu import service_manager as sm  # noqa: E402
+from riak_ensemble_tpu.config import fast_test_config  # noqa: E402
+from riak_ensemble_tpu.parallel import repgroup  # noqa: E402
+from riak_ensemble_tpu.parallel.batched_host import WallRuntime  # noqa: E402
+from riak_ensemble_tpu.testing import ManagedCluster  # noqa: E402
+
+from test_repgroup import (  # noqa: E402
+    GROUP, N_ENS, N_SLOTS, _control, _restart, _settle,
+    _spawn_replica, _wait_synced)
+
+
+def _dyn_group(tmp_path, procs, dirs):
+    for name in ("r1", "r2"):
+        dirs[name] = str(tmp_path / name)
+        procs[name] = _spawn_replica(dirs[name], extra=["--dynamic"])
+    svc = repgroup.ReplicatedService(
+        WallRuntime(), N_ENS, 1, N_SLOTS, group_size=GROUP,
+        peers=[("127.0.0.1", procs[n][1]) for n in ("r1", "r2")],
+        ack_timeout=60.0, config=fast_test_config(), dynamic=True,
+        data_dir=str(tmp_path / "leader"))
+    repgroup.warmup_kernels(svc)
+    assert svc.takeover()
+    return svc
+
+
+def test_replicated_lifecycle_and_directory_survives_leader_death(
+        tmp_path):
+    import asyncio
+
+    from riak_ensemble_tpu import svcnode
+
+    procs = {}
+    dirs = {}
+    try:
+        svc = _dyn_group(tmp_path, procs, dirs)
+
+        # replicated create: quorum-barriered, deterministic rows
+        orders = svc.create_ensemble("orders")
+        billing = svc.create_ensemble("billing")
+        assert orders is not None and billing is not None
+        assert svc.create_ensemble("orders") is None  # name taken
+        r = _settle(svc, [svc.kput(orders, "k", b"ord"),
+                          svc.kput(billing, "k", b"bil")])
+        assert all(x[0] == "ok" for x in r)
+
+        # replicated destroy + row recycling across the group
+        assert svc.destroy_ensemble("billing")
+        billing2 = svc.create_ensemble("billing2")
+        assert billing2 is not None
+        r = _settle(svc, [svc.kput(billing2, "k", b"bil2")])
+        assert r[0][0] == "ok"
+
+        # a killed replica restarts and re-syncs a snapshot that
+        # CARRIES the tenant directory
+        p1, _, _ = procs["r1"]
+        p1.send_signal(signal.SIGKILL)
+        p1.wait()
+        _restart(procs, dirs, "r1")
+        _wait_synced(svc, 2)
+
+        # leader dies; promote r1 — every lifecycle outcome must be
+        # visible through the replica's own directory
+        svc.stop()
+        _, r1_repl, r1_client = procs["r1"]
+        _, r2_repl, _ = procs["r2"]
+        resp = _control(r1_repl, ("promote",
+                                  [("127.0.0.1", r2_repl)]),
+                        timeout=300.0)
+        assert resp[0] == "ok", resp
+
+        async def check():
+            c = svcnode.ServiceClient("127.0.0.1", r1_client)
+            await c.connect()
+            r = await c.call("resolve_ensemble", "orders",
+                             timeout=120.0)
+            assert r == ("ok", orders), r
+            assert await c.kget(orders, "k", timeout=120.0) == \
+                ("ok", b"ord")
+            r = await c.call("resolve_ensemble", "billing",
+                             timeout=120.0)
+            assert r == ("error", "unknown"), r
+            r = await c.call("resolve_ensemble", "billing2",
+                             timeout=120.0)
+            assert r == ("ok", billing2), r
+            assert await c.kget(billing2, "k", timeout=120.0) == \
+                ("ok", b"bil2")
+            # and the promoted leader can keep doing lifecycle ops
+            r = await c.call("create_ensemble", "fresh",
+                             timeout=120.0)
+            assert r[0] == "ok", r
+            await c.close()
+
+        asyncio.run(check())
+    finally:
+        for p, _, _ in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+
+def test_reconciler_places_tenants_on_a_replication_group(tmp_path):
+    """The full composition: a consensus-managed tenant (root
+    ensemble + gossip) reconciled onto an owner that is itself a
+    replication GROUP — multi-tenancy over machine-fault tolerance.
+    The reconciler is caller-driven (poll=None) since the group runs
+    on wall time."""
+    procs = {}
+    dirs = {}
+    mc = ManagedCluster(seed=8, nodes=("node0",))
+    mc.enable("node0")
+    try:
+        svc = _dyn_group(tmp_path, procs, dirs)
+        registry = {}
+        rec = sm.ServiceReconciler(mc.runtime, mc.mgr("node0"), svc,
+                                   "grp@node0", registry.get,
+                                   poll=None)
+        registry["grp@node0"] = rec
+        r = sd.register_service(mc.mgr("node0"), mc.runtime,
+                                "grp@node0", "127.0.0.1", 1,
+                                (N_ENS, 1, N_SLOTS))
+        assert r == "ok", r
+        assert sm.create_tenant(mc.mgr("node0"), mc.runtime,
+                                "orders") == "ok"
+        deadline = time.monotonic() + 60.0
+        while svc.resolve_ensemble("orders") is None:
+            mc.runtime.run_for(0.5)
+            rec.tick()
+            assert time.monotonic() < deadline, \
+                "tenant never reconciled onto the group"
+
+        ens = svc.resolve_ensemble("orders")
+        r = _settle(svc, [svc.kput(ens, "k", b"v")])
+        assert r[0][0] == "ok"
+        # replicas carry the tenant too (quorum-barriered lifecycle):
+        # the write above could not have acked otherwise
+        assert svc.stats()["group"]["quorum_failures"] == 0
+
+        # retire through the root -> reconciler destroys on the group
+        assert sm.retire_tenant(mc.mgr("node0"), mc.runtime,
+                                "orders") == "ok"
+        deadline = time.monotonic() + 60.0
+        while svc.resolve_ensemble("orders") is not None:
+            mc.runtime.run_for(0.5)
+            rec.tick()
+            assert time.monotonic() < deadline, \
+                "retired tenant never destroyed on the group"
+    finally:
+        for p, _, _ in procs.values():
+            if p.poll() is None:
+                p.kill()
