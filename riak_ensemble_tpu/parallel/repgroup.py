@@ -198,6 +198,14 @@ def install_state(svc: BatchedEnsembleService, dump: Tuple) -> None:
     import jax.numpy as jnp
 
     fields, host = dump
+    if bool(host[5]) != svc.dynamic:
+        # a mixed group would HALF-sync (directory dropped or stale):
+        # fail BEFORE any mutation — a torn half-install would leave
+        # snapshot arrays over stale derived mirrors (review r4)
+        raise ValueError(
+            f"lifecycle-mode mismatch: snapshot dynamic={bool(host[5])}"
+            f" vs this lane dynamic={svc.dynamic} — every group host "
+            "must run the same --dynamic setting")
     by_name = {name: (dt, shape, raw) for name, dt, shape, raw in fields}
     new = {}
     for name in eng.EngineState._fields:
@@ -217,13 +225,6 @@ def install_state(svc: BatchedEnsembleService, dump: Tuple) -> None:
     svc.member_np = _unpack_bool(member_b,
                                  svc.n_ens * svc.n_peers).reshape(
         svc.n_ens, svc.n_peers)
-    if bool(dynamic) != svc.dynamic:
-        # a mixed group would HALF-sync (directory dropped or stale):
-        # fail the install loudly instead (review r4)
-        raise ValueError(
-            f"lifecycle-mode mismatch: snapshot dynamic={bool(dynamic)}"
-            f" vs this lane dynamic={svc.dynamic} — every group host "
-            "must run the same --dynamic setting")
     if bool(dynamic):
         svc.dynamic = True
         svc._live = _unpack_bool(live_b, svc.n_ens)
@@ -451,6 +452,11 @@ class ReplicaCore:
         self.applied_ge, self.applied_seq = ge, seq
         self.last_crc = crc
         save_group_meta(svc, self.promised, ge, seq)
+        if svc._wal is not None \
+                and svc._wal.count >= svc.wal_compact_records:
+            rebuild_derived(svc)
+            svc.save()
+            save_group_meta(svc, self.promised, ge, seq)
         return ("applied", ge, seq, crc)
 
     def handle_install(self, frame: Tuple) -> Tuple:
@@ -915,6 +921,24 @@ class ReplicatedService(BatchedEnsembleService):
         view_b = None if view is None else _pack_bool(
             np.asarray(view, bool))
         frame = ("lcl", self._ge, seq, kind, name, view_b)
+        # syncing links get the (non-blocking) snapshot queued ahead,
+        # exactly like the write path — otherwise an idle group's
+        # lifecycle ops would exclude a stale link forever (review r4)
+        snapshot = None
+        for link in self._links:
+            inst_t = link.install_ticket
+            if inst_t is not None and inst_t.event.is_set():
+                r = inst_t.result
+                link.install_ticket = None
+                if r is not None and r[0] == "installed":
+                    link.needs_sync = False
+            if link.needs_sync and link.connected \
+                    and link.install_ticket is None:
+                if snapshot is None:
+                    snapshot = ("install", self._ge, self._grp_seq,
+                                dump_state(self))
+                link.install_ticket = link.post(snapshot)
+                self.group_stats["resyncs"] += 1
         sends = [(l, l.post(frame)) for l in self._links
                  if not l.needs_sync]
         if kind == "create":
@@ -944,7 +968,9 @@ class ReplicatedService(BatchedEnsembleService):
                 link.needs_sync = True
             else:
                 link.needs_sync = True
+        self.group_stats["applies"] += 1
         if (1 + acked) < (self.group_size // 2 + 1) or self._deposed:
+            self.group_stats["quorum_failures"] += 1
             raise RuntimeError(
                 f"lifecycle {kind} {name!r}: no host quorum "
                 f"({1 + acked}/{self.group_size})")

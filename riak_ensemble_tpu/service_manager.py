@@ -218,19 +218,25 @@ class ServiceReconciler:
     def _on_tick(self) -> None:
         try:
             self.tick()
-        except Exception:
-            # the loop must outlive any single bad pass (malformed
-            # registry data, a racing destroy): trace, keep ticking
-            import traceback
-            self.svc._emit("svc_reconcile_error",
-                           {"error": traceback.format_exc(limit=8)})
         finally:
             if self._timer is not None:
                 self._timer = self.runtime.schedule(self.poll,
                                                     self._on_tick)
 
     def tick(self) -> None:
-        """One reconciliation pass (manager.erl:610-641 discipline)."""
+        """One reconciliation pass (manager.erl:610-641 discipline).
+        Exception-shielded HERE, not in the timer wrapper, so
+        caller-driven (poll=None) loops get the same crash isolation
+        — one bad pass (malformed registry data, a repgroup lifecycle
+        losing its quorum) must never kill the owner's drive loop."""
+        try:
+            self._tick_body()
+        except Exception:
+            import traceback
+            self.svc._emit("svc_reconcile_error",
+                           {"error": traceback.format_exc(limit=8)})
+
+    def _tick_body(self) -> None:
         self._tick_no += 1
         reg = tenants(self.mgr)
         nodes = sorted(sd.list_services(self.mgr), key=repr)

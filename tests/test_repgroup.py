@@ -527,14 +527,19 @@ def test_auto_failover_elects_new_leader_without_operator(tmp_path):
 
     names = ("r1", "r2", "r3")
     repl_ports = {n: _free_port() for n in names}
+    client_ports = {n: _free_port() for n in names}
     procs = {}
     dirs = {}
 
     def spawn(name):
+        # restarts preserve BOTH ports and the failover/peer config —
+        # a respawned host that can't campaign (or moved its client
+        # port) would break the self-healing story mid-test
         others = [f"--peer=127.0.0.1:{repl_ports[o]}"
                   for o in names if o != name]
         return _spawn_replica(
             dirs[name], repl_port=repl_ports[name],
+            client_port=client_ports[name],
             extra=["--auto-failover", "3.0"] + others)
 
     def roles():
@@ -628,14 +633,19 @@ def test_group_client_follows_the_leader(tmp_path):
 
     names = ("r1", "r2", "r3")
     repl_ports = {n: _free_port() for n in names}
+    client_ports = {n: _free_port() for n in names}
     procs = {}
     dirs = {}
 
     def spawn(name):
+        # restarts preserve BOTH ports and the failover/peer config —
+        # a respawned host that can't campaign (or moved its client
+        # port) would break the self-healing story mid-test
         others = [f"--peer=127.0.0.1:{repl_ports[o]}"
                   for o in names if o != name]
         return _spawn_replica(
             dirs[name], repl_port=repl_ports[name],
+            client_port=client_ports[name],
             extra=["--auto-failover", "3.0"] + others)
 
     try:

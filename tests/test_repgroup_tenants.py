@@ -162,3 +162,108 @@ def test_reconciler_places_tenants_on_a_replication_group(tmp_path):
         for p, _, _ in procs.values():
             if p.poll() is None:
                 p.kill()
+
+
+@pytest.mark.parametrize("seed", [3101])
+def test_repgroup_linearizable_across_leader_failovers(tmp_path, seed):
+    """sc.erl under MACHINE churn with no protected roles: a random
+    workload rides GroupClient while the nemesis kill -9s and restarts
+    ANY host — leaders included — so the history spans automatic
+    re-elections, re-syncs and fencing.  Ambiguity discipline: in a
+    replication group a 'failed' write is AMBIGUOUS (the batch lost
+    its host quorum but applied on the leader's lane, and that lane
+    may win the next election by newest-state rank), so it joins the
+    plausible set via timeout_write — only ACKED writes pin state,
+    and losing one raises Violation."""
+    import asyncio
+
+    import numpy as np
+
+    from riak_ensemble_tpu.linearizability import KeyModel
+    from riak_ensemble_tpu.types import NOTFOUND
+
+    names = ("r1", "r2", "r3")
+    procs = {}
+    dirs = {}
+    import test_repgroup as tr
+    repl_ports = {n: tr._free_port() for n in names}
+    client_ports = {n: tr._free_port() for n in names}
+
+    def spawn(name):
+        # restarts must preserve ports AND the failover/peer config:
+        # tr._restart would drop --auto-failover, leaving the group
+        # unable to re-elect after enough churn (review r4)
+        others = [f"--peer=127.0.0.1:{repl_ports[o]}"
+                  for o in names if o != name]
+        return _spawn_replica(
+            dirs[name], repl_port=repl_ports[name],
+            client_port=client_ports[name],
+            extra=["--auto-failover", "3.0"] + others)
+
+    rng = np.random.default_rng(seed)
+    models = {}
+    vals = iter(range(1, 10_000))
+
+    def model(e, k):
+        return models.setdefault((e, k), KeyModel(f"{e}/k{k}"))
+
+    try:
+        for n in names:
+            dirs[n] = str(tmp_path / n)
+            procs[n] = spawn(n)
+        hosts = [("127.0.0.1", procs[n][2]) for n in names]
+
+        async def run():
+            gc = repgroup.GroupClient(hosts, op_timeout=60.0,
+                                      discover_timeout=240.0)
+            for rnd in range(10):
+                # nemesis: kill or restart ANY host (leader included)
+                r = rng.random()
+                dead = [n for n in names
+                        if procs[n][0].poll() is not None]
+                alive = [n for n in names if n not in dead]
+                if r < 0.3 and len(alive) > 2:
+                    victim = alive[int(rng.integers(len(alive)))]
+                    p, _, _ = procs[victim]
+                    p.send_signal(signal.SIGKILL)
+                    p.wait()
+                elif r < 0.6 and dead:
+                    procs[dead[0]] = spawn(dead[0])
+
+                for _ in range(4):
+                    e = int(rng.integers(N_ENS))
+                    k = int(rng.integers(2))
+                    m = model(e, k)
+                    if rng.random() < 0.6:
+                        v = next(vals)
+                        op = m.invoke_write(v)
+                        res = await gc.kput(e, f"k{k}",
+                                            v.to_bytes(4, "big"))
+                        if isinstance(res, tuple) and res[0] == "ok":
+                            m.ack_write(op)
+                        else:
+                            m.timeout_write(op)  # ambiguous
+                    else:
+                        res = await gc.kget(e, f"k{k}")
+                        if isinstance(res, tuple) and res[0] == "ok":
+                            v = res[1]
+                            m.ack_read(v if v is NOTFOUND else
+                                       int.from_bytes(v, "big"))
+
+            # quiesce: restart everyone, then read back every key
+            for n in names:
+                if procs[n][0].poll() is not None:
+                    procs[n] = spawn(n)
+            for (e, k), m in models.items():
+                res = await gc.kget(e, f"k{k}")
+                assert isinstance(res, tuple) and res[0] == "ok", res
+                v = res[1]
+                m.ack_read(v if v is NOTFOUND
+                           else int.from_bytes(v, "big"))
+            await gc.close()
+
+        asyncio.run(run())
+    finally:
+        for p, _, _ in procs.values():
+            if p.poll() is None:
+                p.kill()
