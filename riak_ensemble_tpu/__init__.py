@@ -31,7 +31,13 @@ L6    client API               client, netnode (async), svcnode
                                (scale-path TCP front-end + client)
 --    batched TPU engine       ops.engine, parallel.mesh,
                                parallel.batched_host (the scale-path
-                               service), parallel.distributed
+                               service), parallel.distributed,
+                               parallel.repgroup (replica quorum
+                               across machine failure domains +
+                               GroupClient), service_manager
+                               (consensus-managed tenant placement),
+                               synctree.remote_sync (streamed wire
+                               Merkle exchange)
 --    wire safety              wire (restricted codec + native/
                                wirecodec.cc C++ extension), funref
 --    testing/verification     testing, linearizability, utils.trace

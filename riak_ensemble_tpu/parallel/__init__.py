@@ -1,5 +1,7 @@
-"""Device-mesh parallelism for the batched consensus engine."""
+"""Device-mesh and cross-host parallelism for the batched engine."""
 
 from riak_ensemble_tpu.parallel.mesh import (  # noqa: F401
     ShardedEngine, make_mesh,
 )
+# repgroup imports lazily via `from riak_ensemble_tpu.parallel import
+# repgroup` (it pulls jax at module import, same as mesh)
