@@ -398,8 +398,11 @@ def run_repgroup(seconds: float, smoke: bool) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def run(n_ens: int, n_peers: int, n_slots: int, k: int,
-        seconds: float) -> float:
+def _make_workload(n_ens: int, n_peers: int, n_slots: int, k: int):
+    """Shared kernel-stage workload: elected engine state + one fixed
+    [K, E] op plane (seed 0).  Used by BOTH the throughput stage and
+    the stepprobe so the stepprobe's budget calibration measures the
+    same computation the stages will run."""
     import jax
     import jax.numpy as jnp
 
@@ -409,6 +412,7 @@ def run(n_ens: int, n_peers: int, n_slots: int, k: int,
     up = jnp.ones((n_ens, n_peers), bool)
     state, won = eng.elect_step(
         state, jnp.ones((n_ens,), bool), jnp.zeros((n_ens,), jnp.int32), up)
+    jax.block_until_ready(state)
 
     rng = np.random.default_rng(0)
     kind = jnp.asarray(rng.choice([eng.OP_PUT, eng.OP_GET], (k, n_ens)),
@@ -416,6 +420,15 @@ def run(n_ens: int, n_peers: int, n_slots: int, k: int,
     slot = jnp.asarray(rng.integers(0, n_slots, (k, n_ens)), jnp.int32)
     val = jnp.asarray(rng.integers(1, 1 << 20, (k, n_ens)), jnp.int32)
     lease_ok = jnp.ones((k, n_ens), bool)
+    return eng, state, won, up, kind, slot, val, lease_ok
+
+
+def run(n_ens: int, n_peers: int, n_slots: int, k: int,
+        seconds: float) -> float:
+    import jax
+
+    eng, state, won, up, kind, slot, val, lease_ok = _make_workload(
+        n_ens, n_peers, n_slots, k)
 
     # Compile + warm up.  NOTE: no device→host transfers before or
     # inside the timed region — on the tunneled single-chip platform a
@@ -452,6 +465,107 @@ def run(n_ens: int, n_peers: int, n_slots: int, k: int,
     ok = np.asarray(res.committed | res.get_ok | (np.asarray(kind) == 0))
     assert ok.all(), "bench: ops failed"
     return n_ens * k * iters / elapsed
+
+
+def run_stepprobe(n_ens: int, n_peers: int, n_slots: int, k: int,
+                  n_steps: int = 5) -> dict:
+    """Single-launch latency evidence for a flickering accelerator.
+
+    Observed round 4: the tunneled TPU answered the preflight probe,
+    compiled every stage kernel (persistent cache confirms), then
+    executed launches so slowly that every throughput stage blew its
+    budget — and the tunnel died again ~50 min later.  The
+    calibrate-then-loop stages need tens of sequential launches; this
+    stage instead times INDIVIDUAL kv_step_scan launches and persists
+    each measurement the moment it exists (``RETPU_STEPPROBE_OUT``),
+    so even ONE completed step inside an alive-window yields an
+    honest, conservative (sync-overhead-included) throughput figure:
+    ``n_ens * k / step_s``.
+    """
+    import jax
+
+    out_path = os.environ.get("RETPU_STEPPROBE_OUT")
+    partial: dict = {"n_ens": n_ens, "k": k,
+                     "platform": jax.devices()[0].platform}
+
+    def persist() -> None:
+        # Atomic replace: the parent kills this process with SIGKILL
+        # on timeout, and a torn in-place write would corrupt the very
+        # measurements this file exists to save.
+        if out_path:
+            tmp = out_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(partial, f)
+            os.replace(tmp, out_path)
+
+    persist()
+    t0 = time.perf_counter()
+    eng, state, _won, up, kind, slot, val, lease_ok = _make_workload(
+        n_ens, n_peers, n_slots, k)
+    partial["init_elect_s"] = time.perf_counter() - t0
+    persist()
+
+    t0 = time.perf_counter()
+    state, _ = eng.kv_step_scan(state, kind, slot, val, lease_ok, up)
+    jax.block_until_ready(state)
+    partial["first_step_s"] = time.perf_counter() - t0  # includes compile
+    persist()
+
+    steps: list = []
+    partial["steps_s"] = steps
+    for _ in range(n_steps):
+        t0 = time.perf_counter()
+        state, _ = eng.kv_step_scan(state, kind, slot, val, lease_ok, up)
+        jax.block_until_ready(state)
+        steps.append(time.perf_counter() - t0)
+        persist()
+    med = sorted(steps)[len(steps) // 2]
+    partial["median_step_s"] = med
+    partial["single_step_ops_per_sec"] = n_ens * k / med
+    persist()
+    return partial
+
+
+def _run_stepprobe(timeout: float, shapes: dict) -> "dict | None":
+    """Run the stepprobe stage in a killable subprocess, recovering
+    PARTIAL measurements (steps persisted before a timeout kill) via
+    the RETPU_STEPPROBE_OUT side file."""
+    import tempfile
+
+    fd, path = tempfile.mkstemp(suffix=".json")
+    os.close(fd)
+    try:
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--stage", "stepprobe"]
+        for f, v in shapes.items():
+            cmd += [f"--{f.replace('_', '-')}", str(v)]
+        result, err = _spawn_stage(
+            cmd, timeout, env=dict(os.environ, RETPU_STEPPROBE_OUT=path))
+        if result is not None:
+            return result
+        try:
+            with open(path) as f:
+                partial = json.load(f)
+            partial["spawn_error"] = err
+        except (OSError, json.JSONDecodeError):
+            # No side file at all — preserve WHY (timeout vs crash) so
+            # a dead round is triageable from the emitted JSON.
+            return {"error": err}
+    finally:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+    steps = partial.get("steps_s") or []
+    if not steps and "first_step_s" not in partial:
+        return partial  # died before any launch completed; keep why
+    partial["partial"] = True
+    if steps:
+        med = sorted(steps)[len(steps) // 2]
+        partial["median_step_s"] = med
+        partial["single_step_ops_per_sec"] = (
+            partial["n_ens"] * partial["k"] / med)
+    return partial
 
 
 def run_merkle(seconds: float, smoke: bool) -> dict:
@@ -564,30 +678,16 @@ _ATTEMPTS = (
 )
 
 
-def _run_stage(stage: str, label: str, shapes: dict, seconds: float,
-               timeout: float, force_cpu: bool):
-    """Run one stage in a subprocess; parse its JSON line; None on
-    timeout/crash (a wedged TPU RPC ignores signals — only a
-    subprocess kill reliably unsticks the bench).
-
-    The budget scales with the requested measurement time (the
-    constant part covers compile + warmup + transfers).  The worker
-    runs in its own session and the whole process GROUP is killed on
-    timeout — a wedged tunnel helper holding the inherited stdout
-    pipe would otherwise block the drain forever.
-    """
+def _spawn_stage(cmd, timeout: float, env=None):
+    """One killable worker subprocess: own session (the whole process
+    GROUP is killed on timeout — a wedged tunnel helper holding the
+    inherited stdout pipe would otherwise block the drain forever),
+    last-JSON-line result parse.  Returns (parsed, error_string)."""
     import signal
 
-    timeout = timeout + max(0.0, (seconds - 3.0) * 4.0)
-    cmd = [sys.executable, os.path.abspath(__file__), "--stage", stage,
-           "--seconds", str(seconds)]
-    for f, v in shapes.items():
-        cmd += [f"--{f.replace('_', '-')}", str(v)]
-    if force_cpu:
-        cmd.append("--force-cpu")
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True,
-                            start_new_session=True)
+                            env=env, start_new_session=True)
     try:
         out, err = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
@@ -596,22 +696,40 @@ def _run_stage(stage: str, label: str, shapes: dict, seconds: float,
         except (ProcessLookupError, PermissionError):
             proc.kill()
         try:
-            out, err = proc.communicate(timeout=15)
+            proc.communicate(timeout=15)
         except subprocess.TimeoutExpired:
-            out = err = ""
-        print(f"# stage {stage}@{label}: timeout after {timeout}s",
-              file=sys.stderr)
-        return None
+            pass
+        return None, f"timeout after {timeout}s"
     if proc.returncode != 0:
-        print(f"# stage {stage}@{label}: rc={proc.returncode} "
-              f"{err[-400:]}", file=sys.stderr)
-        return None
+        return None, f"rc={proc.returncode} {err[-400:]}"
     for line in reversed(out.strip().splitlines()):
         try:
-            return json.loads(line)
+            return json.loads(line), None
         except json.JSONDecodeError:
             continue
-    return None
+    return None, "no json line"
+
+
+def _run_stage(stage: str, label: str, shapes: dict, seconds: float,
+               timeout: float, force_cpu: bool):
+    """Run one stage in a subprocess; parse its JSON line; None on
+    timeout/crash (a wedged TPU RPC ignores signals — only a
+    subprocess kill reliably unsticks the bench).
+
+    The budget scales with the requested measurement time (the
+    constant part covers compile + warmup + transfers).
+    """
+    timeout = timeout + max(0.0, (seconds - 3.0) * 4.0)
+    cmd = [sys.executable, os.path.abspath(__file__), "--stage", stage,
+           "--seconds", str(seconds)]
+    for f, v in shapes.items():
+        cmd += [f"--{f.replace('_', '-')}", str(v)]
+    if force_cpu:
+        cmd.append("--force-cpu")
+    result, err = _spawn_stage(cmd, timeout)
+    if err is not None:
+        print(f"# stage {stage}@{label}: {err}", file=sys.stderr)
+    return result
 
 
 def _stage_entry(args) -> None:
@@ -631,6 +749,8 @@ def _stage_entry(args) -> None:
                   n_slots=args.n_slots, k=args.k)
     if args.stage == "kernel":
         out = {"kernel_rounds_per_sec": run(seconds=args.seconds, **shapes)}
+    elif args.stage == "stepprobe":
+        out = run_stepprobe(**shapes)
     elif args.stage == "repgroup":
         out = run_repgroup(args.seconds, smoke=False)
     elif args.stage == "merkle":
@@ -657,7 +777,7 @@ def main() -> None:
                          "reconfig = BASELINE.md ladder #4 / #5")
     ap.add_argument("--stage",
                     choices=("kernel", "service", "merkle", "reconfig",
-                             "probe"),
+                             "probe", "stepprobe"),
                     help="internal: run one stage in-process")
     ap.add_argument("--n-ens", type=int, default=10_000)
     ap.add_argument("--n-peers", type=int, default=5)
@@ -760,14 +880,34 @@ def main() -> None:
             if r is not None:
                 svc.update({k: v for k, v in r.items()
                             if k.startswith("repgroup_")})
+        # Flicker-window evidence (round 4): the preflight saw a live
+        # accelerator but the headline landed on a CPU rung (or not at
+        # all) — the chip is answering yet too slow/unstable for the
+        # throughput loops.  Time single launches with a generous
+        # budget; each completed launch is persisted, so even a
+        # short alive-window produces a real-TPU datapoint.
+        stepprobe = None
+        if (probe is not None and probe.get("platform") != "cpu"
+                and (svc is None or svc.get("platform") == "cpu")):
+            stepprobe = _run_stepprobe(
+                600.0, dict(n_ens=10_000, n_peers=5, n_slots=128, k=64))
+            if (stepprobe is not None
+                    and stepprobe.get("platform") == "cpu"):
+                # The subprocess silently fell back to CPU (tunnel died
+                # between preflight and here) — NOT TPU evidence.
+                stepprobe = {"error": "stepprobe subprocess landed on "
+                                      "cpu (accelerator gone)"}
         if svc is None:
             print(json.dumps({
                 "metric": "service_linearizable_kv_ops_per_sec",
                 "value": 0, "unit": "ops/sec", "vs_baseline": 0.0,
                 "error": "every stage attempt timed out or crashed "
                          "(TPU backend unreachable?)",
+                "tpu_stepprobe": stepprobe,
             }))
             sys.exit(1)
+        if stepprobe is not None:
+            svc["tpu_stepprobe"] = stepprobe
 
     baseline = 1_000_000.0  # north-star target (BASELINE.md)
     print(json.dumps({
@@ -800,6 +940,7 @@ def main() -> None:
         "repgroup_p50_ms": svc.get("repgroup_p50_ms"),
         "repgroup_p99_ms": svc.get("repgroup_p99_ms"),
         "latency_breakdown_ms": svc.get("latency_breakdown"),
+        "tpu_stepprobe": svc.get("tpu_stepprobe"),
         **{k: round(v, 1) for k, v in svc.get("ladder", {}).items()},
         "platform": svc.get("platform", "unknown"),
     }))

@@ -20,12 +20,14 @@ from __future__ import annotations
 import datetime
 import json
 import os
-import subprocess
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 LOG = os.path.join(HERE, ".attempts", "tpu_probe_log.txt")
 OUT = os.path.join(HERE, "BENCH_TPU_attempt.json")
+
+sys.path.insert(0, HERE)
+import bench as _bench  # noqa: E402  (light import; no JAX init)
 
 
 def note(msg: str) -> None:
@@ -37,35 +39,13 @@ def note(msg: str) -> None:
     print(f"{stamp} {msg}", flush=True)
 
 
-def run_stage(args, timeout):
+def run_stage(args, timeout, env=None):
     """One bench stage in a killable subprocess (a wedged TPU RPC
-    ignores signals; only a process-group kill unsticks it)."""
-    import signal
-
+    ignores signals; only a process-group kill unsticks it).
+    Delegates the spawn/kill/parse lifecycle to bench._spawn_stage so
+    the two harnesses cannot diverge."""
     cmd = [sys.executable, os.path.join(HERE, "bench.py")] + args
-    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True,
-                            start_new_session=True)
-    try:
-        out, err = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            proc.kill()
-        try:
-            proc.communicate(timeout=15)
-        except subprocess.TimeoutExpired:
-            pass
-        return None, "timeout"
-    if proc.returncode != 0:
-        return None, f"rc={proc.returncode} {err[-300:]}"
-    for line in reversed(out.strip().splitlines()):
-        try:
-            return json.loads(line), None
-        except json.JSONDecodeError:
-            continue
-    return None, "no json"
+    return _bench._spawn_stage(cmd, timeout, env=env)
 
 
 def main() -> int:
@@ -84,16 +64,78 @@ def main() -> int:
         with open(OUT, "w") as f:
             json.dump(results, f, indent=1)
 
+    # STEPPROBE FIRST (round-4 lesson): the 03:17Z alive-window
+    # compiled every stage kernel but executed launches too slowly
+    # for any throughput stage to finish inside its budget, and the
+    # tunnel died again ~50 min later with zero numbers banked.
+    # Single-launch timings persist incrementally, so even a brief
+    # window yields an honest ops/s figure — and the measured step
+    # latency then sizes the real ladder's budgets (or tells us to
+    # keep the small shapes first).
+    sp = _bench._run_stepprobe(
+        900.0, dict(n_ens=10_000, n_peers=5, n_slots=128, k=64))
+    if sp is not None and sp.get("platform") == "cpu":
+        # The subprocess silently fell back to CPU: the tunnel died
+        # between the preflight and here.  A CPU step time would size
+        # TPU budgets wrong AND masquerade as TPU evidence.
+        note("stepprobe landed on cpu — accelerator gone; aborting ladder")
+        results["stepprobe"] = {"error": "cpu fallback (accelerator gone)"}
+        persist()
+        return 3
+    results["stepprobe"] = sp
+    persist()
+    step_s = (sp or {}).get("median_step_s")
+    note(f"stepprobe: {json.dumps(sp)[:200] if sp else 'no launch completed'}")
+
+    completed_any = sp is not None and (
+        sp.get("steps_s") or "first_step_s" in sp)
+    if not completed_any:
+        # The chip could not finish ONE launch in 900 s.  Running the
+        # full ladder (~7 more stages of near-guaranteed timeouts)
+        # would burn ~an hour of probe cadence against a backend that
+        # failed the cheapest possible operation — bail and let the
+        # next probe cycle try again.
+        note("stepprobe completed zero launches — skipping ladder")
+        persist()
+        return 3
+
+    # Budgets adapt to the measured launch latency: each throughput
+    # stage needs ~15 sequential launches beyond compile (warmup +
+    # 3-step calibration + >=10-iteration loop).
+    slow = step_s is None or step_s > 5.0
+    pad = 300.0 + (20.0 * step_s if step_s else 0.0)
+    big = max(480.0, min(1800.0, pad))
+
     # Stage order mirrors bench.py: kernel FIRST (d2h degrades later
     # dispatch on the tunneled chip), then service, ladder, A/B.
+    # On a slow chip the 1k shape runs FIRST so a short alive-window
+    # banks the small number before the big shape gambles the rest.
     shapes = ["--n-ens", "10000", "--n-peers", "5", "--n-slots", "128",
               "--k", "64"]
-    stages = [
-        ("kernel", ["--stage", "kernel", "--seconds", "3"] + shapes, 480),
-        ("service", ["--stage", "service", "--seconds", "3"] + shapes, 480),
-        ("merkle", ["--stage", "merkle", "--seconds", "3"], 420),
-        ("reconfig", ["--stage", "reconfig", "--seconds", "3"], 420),
-    ]
+    small = ["--n-ens", "1000", "--n-peers", "5", "--n-slots", "128",
+             "--k", "32"]
+    if slow:
+        stages = [
+            ("kernel_1k", ["--stage", "kernel", "--seconds", "3"] + small,
+             big),
+            ("service_1k", ["--stage", "service", "--seconds", "3"] + small,
+             big),
+            ("kernel", ["--stage", "kernel", "--seconds", "3"] + shapes,
+             big),
+            ("service", ["--stage", "service", "--seconds", "3"] + shapes,
+             big),
+            ("merkle", ["--stage", "merkle", "--seconds", "3"], 420),
+            ("reconfig", ["--stage", "reconfig", "--seconds", "3"], 420),
+        ]
+    else:
+        stages = [
+            ("kernel", ["--stage", "kernel", "--seconds", "3"] + shapes,
+             big),
+            ("service", ["--stage", "service", "--seconds", "3"] + shapes,
+             big),
+            ("merkle", ["--stage", "merkle", "--seconds", "3"], 420),
+            ("reconfig", ["--stage", "reconfig", "--seconds", "3"], 420),
+        ]
     ok = True
     for name, args, budget in stages:
         r, err = run_stage(args, budget)
@@ -101,10 +143,9 @@ def main() -> int:
             note(f"stage {name} FAILED ({err})")
             results[name] = {"error": err}
             ok = False
-            # Fall back to the 1k shape once for the big stages.
-            if name in ("kernel", "service"):
-                small = ["--n-ens", "1000", "--n-peers", "5",
-                         "--n-slots", "128", "--k", "32"]
+            # Fall back to the 1k shape once for the big stages
+            # (unless the slow ladder already ran the 1k rung first).
+            if name in ("kernel", "service") and not slow:
                 r2, err2 = run_stage(
                     ["--stage", name, "--seconds", "3"] + small, 360)
                 if r2 is not None:
@@ -116,31 +157,26 @@ def main() -> int:
         persist()
 
     # Pallas quorum A/B: the same kernel stage with the Pallas reduce
-    # flag — the delta promised since round 1.
-    env = dict(os.environ, RETPU_PALLAS_QUORUM="1")
-    cmd = [sys.executable, os.path.join(HERE, "bench.py"), "--stage",
-           "kernel", "--seconds", "3"] + shapes
-    import signal
-    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True,
-                            env=env, start_new_session=True)
-    try:
-        out, _ = proc.communicate(timeout=480)
-        for line in reversed(out.strip().splitlines()):
-            try:
-                results["kernel_pallas_quorum"] = json.loads(line)
-                note("pallas A/B ok: "
-                     + json.dumps(results['kernel_pallas_quorum'])[:200])
-                break
-            except json.JSONDecodeError:
-                continue
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            proc.kill()
-        note("pallas A/B timeout")
-        results["kernel_pallas_quorum"] = {"error": "timeout"}
+    # flag — the delta promised since round 1.  The A/B delta is a
+    # ratio, so it must run at the SAME shape as the baseline kernel
+    # number that actually banked: 10k only if the 10k kernel stage
+    # succeeded; otherwise 1k (whose baseline is kernel_1k / the 1k
+    # fallback).  Re-running a shape that already timed out would be
+    # a guaranteed re-timeout.
+    kern10k = results.get("kernel") or {}
+    at_10k = "error" not in kern10k and kern10k.get("shape") is None
+    ab_shapes = shapes if at_10k else small
+    r, err = run_stage(
+        ["--stage", "kernel", "--seconds", "3"] + ab_shapes, big,
+        env=dict(os.environ, RETPU_PALLAS_QUORUM="1"))
+    if r is not None:
+        if not at_10k:
+            r = {"shape": "1k_ens_5_peers", **r}
+        results["kernel_pallas_quorum"] = r
+        note(f"pallas A/B ok: {json.dumps(r)[:200]}")
+    else:
+        note(f"pallas A/B FAILED ({err})")
+        results["kernel_pallas_quorum"] = {"error": err}
         ok = False
     persist()
     note(f"ladder complete ok={ok} -> {OUT}")
