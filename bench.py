@@ -526,10 +526,18 @@ def run_stepprobe(n_ens: int, n_peers: int, n_slots: int, k: int,
     return partial
 
 
+#: the shape single-launch TPU evidence is gathered at (matches the
+#: full ladder's headline shape) — shared with tpu_attempt.py.
+STEPPROBE_SHAPES = dict(n_ens=10_000, n_peers=5, n_slots=128, k=64)
+
+
 def _run_stepprobe(timeout: float, shapes: dict) -> "dict | None":
     """Run the stepprobe stage in a killable subprocess, recovering
     PARTIAL measurements (steps persisted before a timeout kill) via
-    the RETPU_STEPPROBE_OUT side file."""
+    the RETPU_STEPPROBE_OUT side file.  A subprocess that silently
+    landed on CPU (tunnel died between the caller's preflight and the
+    probe — not TPU evidence) comes back as
+    ``{"error": ..., "cpu_fallback": True}``."""
     import tempfile
 
     fd, path = tempfile.mkstemp(suffix=".json")
@@ -542,6 +550,10 @@ def _run_stepprobe(timeout: float, shapes: dict) -> "dict | None":
         result, err = _spawn_stage(
             cmd, timeout, env=dict(os.environ, RETPU_STEPPROBE_OUT=path))
         if result is not None:
+            if result.get("platform") == "cpu":
+                return {"error": "stepprobe subprocess landed on cpu "
+                                 "(accelerator gone)",
+                        "cpu_fallback": True}
             return result
         try:
             with open(path) as f:
@@ -556,6 +568,9 @@ def _run_stepprobe(timeout: float, shapes: dict) -> "dict | None":
             os.remove(path)
         except OSError:
             pass
+    if partial.get("platform") == "cpu":
+        return {"error": "stepprobe subprocess landed on cpu "
+                         "(accelerator gone)", "cpu_fallback": True}
     steps = partial.get("steps_s") or []
     if not steps and "first_step_s" not in partial:
         return partial  # died before any launch completed; keep why
@@ -889,14 +904,7 @@ def main() -> None:
         stepprobe = None
         if (probe is not None and probe.get("platform") != "cpu"
                 and (svc is None or svc.get("platform") == "cpu")):
-            stepprobe = _run_stepprobe(
-                600.0, dict(n_ens=10_000, n_peers=5, n_slots=128, k=64))
-            if (stepprobe is not None
-                    and stepprobe.get("platform") == "cpu"):
-                # The subprocess silently fell back to CPU (tunnel died
-                # between preflight and here) — NOT TPU evidence.
-                stepprobe = {"error": "stepprobe subprocess landed on "
-                                      "cpu (accelerator gone)"}
+            stepprobe = _run_stepprobe(600.0, STEPPROBE_SHAPES)
         if svc is None:
             print(json.dumps({
                 "metric": "service_linearizable_kv_ops_per_sec",

@@ -193,34 +193,40 @@ def build_uppers(leaves: jax.Array) -> jax.Array:
 
 def _gather_children(arr: jax.Array, parent_idx: jax.Array,
                      n: int) -> jax.Array:
-    """Gather the 16 children of ``parent_idx [E]`` from a per-replica
-    level array ``arr [E, Ml, n, LANES]`` → ``[E, Ml, 16, LANES]``
-    (zero-padded beyond ``n``, matching :func:`_fold_blocks`)."""
-    idx = (parent_idx[:, None] * TREE_WIDTH
-           + jnp.arange(TREE_WIDTH, dtype=jnp.int32)[None, :])   # [E, 16]
+    """Gather the 16 children of ``parent_idx [E, W]`` from a
+    per-replica level array ``arr [E, Ml, n, LANES]`` →
+    ``[E, Ml, W, 16, LANES]`` (zero-padded beyond ``n``, matching
+    :func:`_fold_blocks`)."""
+    e, w = parent_idx.shape
+    ml = arr.shape[1]
+    idx = (parent_idx[..., None] * TREE_WIDTH
+           + jnp.arange(TREE_WIDTH, dtype=jnp.int32))        # [E, W, 16]
     valid = idx < n
-    idxc = jnp.clip(idx, 0, n - 1)
-    g = jnp.take_along_axis(arr, idxc[:, None, :, None], axis=2)
-    return jnp.where(valid[:, None, :, None], g, jnp.uint32(0))
+    idxc = jnp.clip(idx, 0, n - 1).reshape(e, 1, w * TREE_WIDTH, 1)
+    g = jnp.take_along_axis(arr, idxc, axis=2)
+    g = g.reshape(e, ml, w, TREE_WIDTH, hashk.LANES)
+    return jnp.where(valid[:, None, :, :, None], g, jnp.uint32(0))
 
 
 def _verify_path(tree_leaf: jax.Array, tree_node: jax.Array,
                  slot: jax.Array) -> jax.Array:
-    """Root-ward path verification for one slot per ensemble: recompute
-    each stored parent on the path from its stored children and compare
-    (``get_path``/``verify_hash``, synctree.erl:302-340).  Returns
-    ``[E, Ml]`` bool — replica's tree corrupted on this path."""
+    """Root-ward path verification for W slots per ensemble: recompute
+    each stored parent on the paths from its stored children and
+    compare (``get_path``/``verify_hash``, synctree.erl:302-340).
+    ``slot [E, W]`` → ``[E, Ml, W]`` bool — replica's tree corrupted
+    on lane w's path."""
     s = tree_leaf.shape[-2]
     offs, _ = _tree_offsets(s)
     sizes = tree_sizes(s)
-    bad = jnp.zeros(tree_leaf.shape[:2], bool)
+    e, ml = tree_leaf.shape[:2]
+    bad = jnp.zeros((e, ml, slot.shape[1]), bool)
     child_arr, child_n, idx = tree_leaf, s, slot
     for off, n in zip(offs, sizes):
-        pidx = idx // TREE_WIDTH
+        pidx = idx // TREE_WIDTH                             # [E, W]
         expect = hashk.fold(_gather_children(child_arr, pidx, child_n))
         level = jax.lax.slice_in_dim(tree_node, off, off + n, axis=2)
         stored = jnp.take_along_axis(
-            level, pidx[:, None, None, None], axis=2)[..., 0, :]
+            level, pidx[:, None, :, None], axis=2)           # [E,Ml,W,L]
         bad = bad | (expect != stored).any(-1)
         child_arr, child_n, idx = level, n, pidx
     return bad
@@ -229,8 +235,8 @@ def _verify_path(tree_leaf: jax.Array, tree_node: jax.Array,
 def _write_path(tree_leaf: jax.Array, tree_node: jax.Array,
                 slot: jax.Array, new_leaf: jax.Array,
                 mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """Set slot's leaf to ``new_leaf [E, LANES]`` on replicas in
-    ``mask [E, Ml]`` and recompute their root-ward path — the
+    """Set lane w's leaf to ``new_leaf [E, W, LANES]`` on replicas in
+    ``mask [E, Ml, W]`` and recompute their root-ward paths — the
     synchronous write-path hash update (``update_hash`` +
     ``update_path``, peer.erl:1731-1738, synctree.erl:201-209).
     Non-writing replicas' nodes are untouched (a recompute would
@@ -238,32 +244,30 @@ def _write_path(tree_leaf: jax.Array, tree_node: jax.Array,
 
     HBM discipline: updates are SCATTERS at the touched (slot, path)
     positions, not full-plane ``where`` rewrites — per round only
-    O(E·M·height·LANES) elements move, not the whole
+    O(E·M·W·height·LANES) elements move, not the whole
     ``[E, M, S(+U), LANES]`` tree (inside the kv scan the carried
     buffers alias, so the scatter lowers to an in-place update).
-    Masked-off replicas scatter their CURRENT value back (a no-op
-    write) rather than being excluded — the indices stay dense.
+    Masked-off lanes aim out of bounds and are DROPPED, which keeps
+    duplicate in-bounds targets conflict-free: lanes sharing a parent
+    all scatter the identical post-update fold of its 16 children.
     """
-    e, ml = mask.shape
+    e, ml, w = mask.shape
     s = tree_leaf.shape[-2]
-    offs, _ = _tree_offsets(s)
+    offs, total = _tree_offsets(s)
     sizes = tree_sizes(s)
-    eidx = jnp.arange(e, dtype=jnp.int32)[:, None]           # [E, 1]
-    midx = jnp.arange(ml, dtype=jnp.int32)[None, :]          # [1, Ml]
-    cur_leaf = jnp.take_along_axis(
-        tree_leaf, slot[:, None, None, None], axis=2)[..., 0, :]
-    leaf_vals = jnp.where(mask[:, :, None],
-                          new_leaf[:, None, :], cur_leaf)    # [E, Ml, L]
-    tree_leaf = tree_leaf.at[eidx, midx, slot[:, None]].set(leaf_vals)
+    eidx = jnp.arange(e, dtype=jnp.int32)[:, None, None]     # [E, 1, 1]
+    midx = jnp.arange(ml, dtype=jnp.int32)[None, :, None]    # [1, Ml, 1]
+    sl = jnp.where(mask, slot[:, None, :], s)                # [E, Ml, W]
+    tree_leaf = tree_leaf.at[eidx, midx, sl].set(
+        jnp.broadcast_to(new_leaf[:, None], (e, ml, w, hashk.LANES)),
+        mode="drop")
     child_arr, child_n, idx = tree_leaf, s, slot
     node = tree_node
     for off, n in zip(offs, sizes):
-        pidx = idx // TREE_WIDTH
+        pidx = idx // TREE_WIDTH                             # [E, W]
         parent = hashk.fold(_gather_children(child_arr, pidx, child_n))
-        stored = jnp.take_along_axis(
-            node, (off + pidx)[:, None, None, None], axis=2)[..., 0, :]
-        vals = jnp.where(mask[:, :, None], parent, stored)
-        node = node.at[eidx, midx, (off + pidx)[:, None]].set(vals)
+        tgt = jnp.where(mask, off + pidx[:, None, :], total)
+        node = node.at[eidx, midx, tgt].set(parent, mode="drop")
         child_arr, child_n = (
             jax.lax.slice_in_dim(node, off, off + n, axis=2), n)
         idx = pidx
@@ -345,6 +349,18 @@ def _quorum_met(ack: jax.Array, heard: jax.Array, view_mask: jax.Array,
         from riak_ensemble_tpu.ops.pallas_quorum import quorum_met_epallas
         res = quorum_met_epallas(ack, heard & ~ack, view_mask)
         return res == quorum_lib.MET
+    if PALLAS_QUORUM and axis_name is None and ack.ndim == 3:
+        # Wide-round shape [E, W, Ml] (every K/V round since the lane
+        # refactor — W=1 included): flatten the lane axis into the
+        # ensemble axis for the kernel, whose contract is [E', Ml].
+        from riak_ensemble_tpu.ops.pallas_quorum import quorum_met_epallas
+        e, w, ml = ack.shape
+        vm = jnp.broadcast_to(view_mask, (e, w) + view_mask.shape[-2:]) \
+            if view_mask.ndim == 4 else view_mask
+        res = quorum_met_epallas(
+            ack.reshape(e * w, ml), (heard & ~ack).reshape(e * w, ml),
+            vm.reshape(e * w, *vm.shape[-2:]))
+        return (res == quorum_lib.MET).reshape(e, w)
     res = quorum_met_batch(
         ack, heard & ~ack, view_mask,
         jnp.full(ack.shape[:-1], -1, jnp.int32),
@@ -358,17 +374,17 @@ def _latest_among(pe: jax.Array, ps: jax.Array, pv: jax.Array,
     """Batched ``get_latest_obj`` (peer.erl:1623-1662): the newest
     (epoch, seq) object among the replicas in ``ok`` (already filtered
     for reachability AND hash validity — the extra-check of
-    :1646-1649), via a three-stage masked max-reduce over the peer
-    axis.  pe/ps/pv/ok are ``[E, Ml]``.
+    :1646-1649), via a three-stage masked max-reduce over the trailing
+    peer axis.  pe/ps/pv/ok are ``[..., Ml]``.
 
-    Returns (epoch [E], seq [E], val [E], found [E]).
+    Returns (epoch [...], seq [...], val [...], found [...]).
     """
     exists = ps > 0                                          # seq>=1 once written
     h = ok & exists
     neg = jnp.int32(-1)
-    emax = _pmax(jnp.where(h, pe, neg), axis_name)           # [E]
-    smax = _pmax(jnp.where(h & (pe == emax[:, None]), ps, neg), axis_name)
-    on_max = h & (pe == emax[:, None]) & (ps == smax[:, None])
+    emax = _pmax(jnp.where(h, pe, neg), axis_name)           # [...]
+    smax = _pmax(jnp.where(h & (pe == emax[..., None]), ps, neg), axis_name)
+    on_max = h & (pe == emax[..., None]) & (ps == smax[..., None])
     vmax = _pmax(jnp.where(on_max, pv, jnp.iinfo(jnp.int32).min), axis_name)
     found = smax > 0
     return (jnp.maximum(emax, 0), jnp.maximum(smax, 0),
@@ -481,10 +497,35 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
               exp_epoch: Optional[jax.Array] = None,
               exp_seq: Optional[jax.Array] = None
               ) -> Tuple[EngineState, KvResult]:
-    """One K/V protocol round given a precomputed context."""
+    """One WIDE K/V protocol round given a precomputed context.
+
+    kind/slot/val/lease_ok/exp_epoch/exp_seq are ``[E, W]``: W
+    conflict-free op lanes per ensemble — the host schedules ops so
+    that the valid slots within a row are DISTINCT (duplicate-slot
+    ops go to later rounds), which is SURVEY §2.7's "conflict-free
+    slots advance in one batched kernel step".  Lanes see the
+    pre-round state (atomic for CAS because no other lane touches the
+    same slot) and commit seqs in lane order, so on a corruption-free
+    tree the result is bit-identical to applying the lanes as W
+    sequential 1-op rounds.  ``kv_step`` is exactly that with W = 1.
+
+    Corruption caveat: lanes verify against the PRE-round tree, so
+    when two lanes' paths share an out-of-band-corrupted internal
+    node, a sequential application could let the first lane's read
+    repair heal the shared path before the second lane's gate runs;
+    the wide round instead excludes the replica on BOTH lanes and
+    flags it in ``tree_corrupt`` — strictly more conservative (an
+    unhealed path is never trusted), healed by the same repair/scrub
+    machinery one round later.
+    """
+    e, ml = state.epoch.shape
     s = state.obj_epoch.shape[-1]
-    heard, leader_up = ctx.heard, ctx.leader_up
-    lead_epoch, epoch_ok = ctx.lead_epoch, ctx.epoch_ok
+    w = kind.shape[1]
+    heard = ctx.heard                                        # [E, Ml]
+    heard3 = heard[:, :, None]                               # [E, Ml, 1]
+    leader_up = ctx.leader_up[:, None]                       # [E, 1]
+    lead_epoch = ctx.lead_epoch[:, None]
+    epoch_ok = ctx.epoch_ok[:, None]
     if exp_epoch is None:
         exp_epoch = jnp.zeros_like(kind)
     if exp_seq is None:
@@ -494,31 +535,32 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
     is_get = kind == OP_GET
     is_cas = kind == OP_CAS
     active = is_put | is_get | is_cas
-    slot_valid = (slot >= 0) & (slot < s)
+    slot_valid = (slot >= 0) & (slot < s)                    # [E, W]
     slot_c = jnp.clip(slot, 0, s - 1)
 
-    # Per-replica object at the slot: ONE gather per plane (invalid
-    # slots read the absent object — raw values kept for the
-    # write-back scatter, which must not damage the clipped slot).
+    # Per-replica object at each lane's slot: ONE gather per plane
+    # (invalid slots read the absent object).
     def at_slot(plane):
         return jnp.take_along_axis(
-            plane, slot_c[:, None, None], axis=2)[..., 0]    # [E, Ml]
-    pe_raw, ps_raw, pv_raw = (at_slot(state.obj_epoch),
-                              at_slot(state.obj_seq),
-                              at_slot(state.obj_val))
-    pe = jnp.where(slot_valid[:, None], pe_raw, 0)
-    ps = jnp.where(slot_valid[:, None], ps_raw, 0)
-    pv = jnp.where(slot_valid[:, None], pv_raw, 0)
+            plane, slot_c[:, None, :], axis=2)               # [E, Ml, W]
+    sv = slot_valid[:, None, :]
+    pe = jnp.where(sv, at_slot(state.obj_epoch), 0)
+    ps = jnp.where(sv, at_slot(state.obj_seq), 0)
+    pv = jnp.where(sv, at_slot(state.obj_val), 0)
 
     # Integrity gate (tree-is-truth, synctree.erl:44-73): the object
     # must match its leaf, and the slot's root-ward path must verify.
     leaf = jnp.take_along_axis(
-        state.tree_leaf, slot_c[:, None, None, None], axis=2)[..., 0, :]
-    leaf_ok = (leaf == hashk.obj_leaf_hash(pe, ps, pv)).all(-1)  # [E, Ml]
+        state.tree_leaf, slot_c[:, None, :, None], axis=2)   # [E,Ml,W,L]
+    leaf_ok = (leaf == hashk.obj_leaf_hash(pe, ps, pv)).all(-1)
     path_bad = _verify_path(state.tree_leaf, state.tree_node, slot_c)
-    replica_ok = heard & leaf_ok & ~path_bad
-    tree_corrupt = ((path_bad | ~leaf_ok) & heard
-                    & (active & slot_valid)[:, None])
+    replica_ok = heard3 & leaf_ok & ~path_bad                # [E, Ml, W]
+    tree_corrupt = ((path_bad | ~leaf_ok) & heard3
+                    & (active & slot_valid)[:, None, :]).any(-1)
+
+    # Peer-axis reductions run on the transposed [E, W, Ml] layout
+    # (reduce_peers/quorum_met_batch contract: peers trailing).
+    ok_t = replica_ok.transpose(0, 2, 1)                     # [E, W, Ml]
 
     # Read: newest object among valid replicas (hash extra-check).
     # ``obj_found`` is "some object exists" — possibly a tombstone
@@ -528,10 +570,11 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
     # but read back as notfound, exactly like the reference's notfound
     # obj (peer.erl:1568-1584).
     rd_epoch, rd_seq, rd_val, obj_found = _latest_among(
-        pe, ps, pv, replica_ok, axis_name)
+        pe.transpose(0, 2, 1), ps.transpose(0, 2, 1),
+        pv.transpose(0, 2, 1), ok_t, axis_name)              # each [E, W]
     found = obj_found & (rd_val != 0)
-    n_ok = reduce_peers(replica_ok.astype(jnp.int32), axis_name)
-    all_ok = n_ok == ctx.n_member                            # [E]
+    n_ok = reduce_peers(ok_t.astype(jnp.int32), axis_name)   # [E, W]
+    all_ok = n_ok == ctx.n_member[:, None]
 
     get_gate = is_get & leader_up & (lease_ok | epoch_ok)
     stale = obj_found & (rd_epoch != lead_epoch)
@@ -551,7 +594,11 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
     # would let a single GET tombstone over a committed object.
     # Out-of-range slots never held data: plain notfound.
     nf = get_gate & ~obj_found
-    nf_quorum = _quorum_met(replica_ok, heard, state.view_mask, axis_name)
+    nf_quorum = _quorum_met(
+        ok_t, jnp.broadcast_to(heard[:, None, :], ok_t.shape),
+        jnp.broadcast_to(state.view_mask[:, None],
+                         (e, w) + state.view_mask.shape[1:]),
+        axis_name)                                           # [E, W]
     nf_write = nf & slot_valid & ~all_ok & epoch_ok & nf_quorum
     get_ok = ((get_gate & obj_found & (~stale | rewrite))
               | (nf & (all_ok | ~slot_valid | nf_write)))
@@ -559,13 +606,12 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
     # Commit path (shared by put, CAS, rewrite and notfound
     # tombstone).  CAS compares the expected version against the
     # slot's CURRENT stored version atomically within this round (the
-    # do_kupdate (epoch, seq) equality, peer.erl:259-270, with the
-    # key-hashed worker's serialization guaranteed by sequential
-    # rounds); expecting (0, 0) on an absent slot is create-if-missing
+    # do_kupdate (epoch, seq) equality, peer.erl:259-270 — atomic
+    # because no other lane in the round touches this slot);
+    # expecting (0, 0) on an absent slot is create-if-missing
     # (do_kput_once, :278-284).  A tombstone counts as an existing
     # version for the compare (ksafe_delete reads the tombstone's vsn)
     # but val 0 still reads back notfound.
-    new_seq = state.obj_seq_ctr + 1                          # [E]
     put_commit = is_put & epoch_ok & slot_valid
     exp_absent = (exp_epoch == 0) & (exp_seq == 0)
     # (0, 0) matches a tombstone as well as true absence — put-once
@@ -580,48 +626,52 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
                  | (exp_absent & obj_found & (rd_val == 0))
                  | (exp_absent & ~obj_found & nf_quorum))
     cas_commit = is_cas & epoch_ok & slot_valid & vsn_match
-    commit = put_commit | cas_commit | rewrite | nf_write
+    commit = put_commit | cas_commit | rewrite | nf_write    # [E, W]
     wval = jnp.where(is_put | is_cas, val,
                      jnp.where(rewrite, rd_val, 0))
+
+    # Commit seqs advance in lane order (obj_sequence, peer.erl:1776-
+    # 1791): lane w's seq is ctr + (commits among lanes <= w), exactly
+    # the values W sequential rounds would assign.
+    ranks = jnp.cumsum(commit.astype(jnp.int32), axis=1)     # [E, W]
+    new_seq = state.obj_seq_ctr[:, None] + ranks
 
     # Read repair (maybe_repair, peer.erl:1518-1536): a successful
     # current-epoch read heals reachable replicas that lag the winning
     # version or failed the integrity gate (re-writing the slot also
     # recomputes their hash path, healing tree corruption).
-    plain_read = get_ok & obj_found & ~rewrite
-    divergent = heard & ((pe != rd_epoch[:, None]) | (ps != rd_seq[:, None])
-                         | ~leaf_ok | path_bad)
-    repair = plain_read[:, None] & divergent                 # [E, Ml]
+    plain_read = get_ok & obj_found & ~rewrite               # [E, W]
+    divergent = heard3 & ((pe != rd_epoch[:, None, :])
+                          | (ps != rd_seq[:, None, :])
+                          | ~leaf_ok | path_bad)
+    repair = plain_read[:, None, :] & divergent              # [E, Ml, W]
 
-    w_epoch = jnp.where(commit, lead_epoch, rd_epoch)        # [E]
+    w_epoch = jnp.where(commit, lead_epoch, rd_epoch)        # [E, W]
     w_seq = jnp.where(commit, new_seq, rd_seq)
     w_val = jnp.where(commit, wval, rd_val)
-    # do_write is always False for invalid slots (commit/repair both
-    # require slot_valid through their gates), so the scatter at the
-    # CLIPPED slot writes the raw current value back — a no-op.
-    do_write = (commit[:, None] & heard) | repair            # [E, Ml]
+    do_write = (commit[:, None, :] & heard3) | repair        # [E, Ml, W]
 
     # Scatter, not full-plane where: per round only the touched slot
-    # column moves through HBM (in place inside the kv scan's carry).
-    eidx = jnp.arange(state.obj_epoch.shape[0],
-                      dtype=jnp.int32)[:, None]
-    midx = jnp.arange(state.obj_epoch.shape[1],
-                      dtype=jnp.int32)[None, :]
-    sl2 = slot_c[:, None]
+    # columns move through HBM (in place inside the kv scan's carry).
+    # Non-writing lanes aim out of bounds and are dropped, so clipped
+    # invalid slots can never collide with a real lane's write.
+    eidx = jnp.arange(e, dtype=jnp.int32)[:, None, None]
+    midx = jnp.arange(ml, dtype=jnp.int32)[None, :, None]
+    sl2 = jnp.where(do_write, slot_c[:, None, :], s)         # [E, Ml, W]
 
-    def set_slot(plane, new, raw):
-        """at_slot's scatter twin: write `new` on do_write replicas,
-        the gathered current value back otherwise (no-op)."""
+    def set_slot(plane, new):
         return plane.at[eidx, midx, sl2].set(
-            jnp.where(do_write, new[:, None], raw))
+            jnp.broadcast_to(new[:, None, :], (e, ml, w)), mode="drop")
 
-    obj_epoch = set_slot(state.obj_epoch, w_epoch, pe_raw)
-    obj_seq = set_slot(state.obj_seq, w_seq, ps_raw)
-    obj_val = set_slot(state.obj_val, w_val, pv_raw)
-    obj_seq_ctr = jnp.where(commit, new_seq, state.obj_seq_ctr)
+    obj_epoch = set_slot(state.obj_epoch, w_epoch)
+    obj_seq = set_slot(state.obj_seq, w_seq)
+    obj_val = set_slot(state.obj_val, w_val)
+    obj_seq_ctr = state.obj_seq_ctr + ranks[:, -1]
 
-    # Synchronous tree maintenance: leaf + root-ward path, same round.
-    new_leaf = hashk.obj_leaf_hash(w_epoch, w_seq, w_val)    # [E, LANES]
+    # Synchronous tree maintenance: leaves + root-ward paths, same
+    # round.  Lanes sharing a path parent recompute it identically
+    # from the post-scatter children, so duplicate targets agree.
+    new_leaf = hashk.obj_leaf_hash(w_epoch, w_seq, w_val)    # [E, W, L]
     tree_leaf, tree_node = _write_path(
         state.tree_leaf, state.tree_node, slot_c, new_leaf, do_write)
 
@@ -639,7 +689,7 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
         found=found & get_ok,
         value=jnp.where(get_ok & found, rd_val, 0),
         obj_vsn=jnp.stack([out_epoch, out_seq], -1),
-        quorum_ok=epoch_ok,
+        quorum_ok=jnp.broadcast_to(ctx.epoch_ok[:, None], commit.shape),
         tree_corrupt=tree_corrupt,
     )
     new_state = state._replace(obj_epoch=obj_epoch, obj_seq=obj_seq,
@@ -683,9 +733,21 @@ def kv_step(state: EngineState, kind: jax.Array, slot: jax.Array,
       :1568-1584) — all batched across ensembles.
     """
     ctx = _kv_context(state, up, axis_name)
-    state, res = _kv_round(state, ctx, kind, slot, val, lease_ok,
-                           axis_name, exp_epoch, exp_seq)
-    return _adopt_epochs(state, ctx), res
+    state, res = _kv_round(
+        state, ctx, kind[:, None], slot[:, None], val[:, None],
+        lease_ok[:, None], axis_name,
+        None if exp_epoch is None else exp_epoch[:, None],
+        None if exp_seq is None else exp_seq[:, None])
+    return _adopt_epochs(state, ctx), _squeeze_lane(res)
+
+
+def _squeeze_lane(res: KvResult) -> KvResult:
+    """Collapse a W=1 wide result back to the scalar [E] shapes
+    (tree_corrupt is already lane-reduced to [E, Ml])."""
+    return res._replace(
+        committed=res.committed[:, 0], get_ok=res.get_ok[:, 0],
+        found=res.found[:, 0], value=res.value[:, 0],
+        obj_vsn=res.obj_vsn[:, 0], quorum_ok=res.quorum_ok[:, 0])
 
 
 def _adopt_epochs(state: EngineState, ctx: _KvCtx) -> EngineState:
@@ -721,6 +783,46 @@ def kv_step_scan(state: EngineState, kind: jax.Array, slot: jax.Array,
     Ballot state (epoch/leader/views) is invariant across the rounds,
     so the round context — including its peer-axis collectives — is
     computed once outside the scan.
+    """
+    ctx = _kv_context(state, up, axis_name)
+    if exp_epoch is None:
+        exp_epoch = jnp.zeros_like(kind)
+    if exp_seq is None:
+        exp_seq = jnp.zeros_like(kind)
+
+    def body(st, op):
+        k, sl, v, lz, xe, xs = op
+        st2, r = _kv_round(st, ctx, k[:, None], sl[:, None], v[:, None],
+                           lz[:, None], axis_name, xe[:, None],
+                           xs[:, None])
+        return st2, _squeeze_lane(r)
+
+    state, res = jax.lax.scan(
+        body, state, (kind, slot, val, lease_ok, exp_epoch, exp_seq))
+    return _adopt_epochs(state, ctx), res
+
+
+@functools.partial(jax.jit, static_argnames=("axis_name",))
+def kv_step_scan_wide(state: EngineState, kind: jax.Array,
+                      slot: jax.Array, val: jax.Array,
+                      lease_ok: jax.Array, up: jax.Array,
+                      axis_name: Optional[str] = None,
+                      exp_epoch: Optional[jax.Array] = None,
+                      exp_seq: Optional[jax.Array] = None
+                      ) -> Tuple[EngineState, KvResult]:
+    """G sequential WIDE rounds of W conflict-free lanes per launch.
+
+    kind/slot/val/lease_ok (and exp_epoch/exp_seq): ``[G, E, W]``.
+    The host schedules each flush's ops so a round's valid slots are
+    distinct within every ensemble (duplicate-slot ops land in later
+    rounds — occurrence-index grouping), which keeps per-key
+    serialization while amortizing the round's fixed cost (context
+    reuse, quorum reduces, gather/scatter launch overhead) over W ops
+    instead of 1.  Results are stacked ``[G, E, W]``.
+
+    Equivalent by construction to ``kv_step_scan`` over the same ops
+    flattened to ``[G*W, E]`` in (group, lane) order — differentially
+    tested in tests/test_engine_wide.py.
     """
     ctx = _kv_context(state, up, axis_name)
     if exp_epoch is None:
@@ -1048,4 +1150,20 @@ def full_step(state: EngineState, elect: jax.Array, cand: jax.Array,
     state, res = kv_step_scan(state, kind, slot, val, lease_ok, up,
                               axis_name=axis_name, exp_epoch=exp_epoch,
                               exp_seq=exp_seq)
+    return state, won, res
+
+
+def full_step_wide(state: EngineState, elect: jax.Array, cand: jax.Array,
+                   kind: jax.Array, slot: jax.Array, val: jax.Array,
+                   lease_ok: jax.Array, up: jax.Array,
+                   axis_name: Optional[str] = None,
+                   exp_epoch: Optional[jax.Array] = None,
+                   exp_seq: Optional[jax.Array] = None
+                   ) -> Tuple[EngineState, jax.Array, KvResult]:
+    """``full_step`` with ``[G, E, W]`` conflict-free op planes (see
+    :func:`kv_step_scan_wide`) — the wide-scheduled flagship step."""
+    state, won = elect_step(state, elect, cand, up, axis_name=axis_name)
+    state, res = kv_step_scan_wide(
+        state, kind, slot, val, lease_ok, up, axis_name=axis_name,
+        exp_epoch=exp_epoch, exp_seq=exp_seq)
     return state, won, res

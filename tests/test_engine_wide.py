@@ -1,0 +1,154 @@
+"""Wide-round equivalence: kv_step_scan_wide over scheduled planes is
+bit-identical to kv_step_scan over the same ops in (group, lane)
+order, and the scheduler's plans are well-formed (per-slot order
+preserved, lanes conflict-free) — the correctness contract of
+SURVEY §2.7's "conflict-free slots advance in one batched kernel
+step".
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from riak_ensemble_tpu.ops import engine as eng  # noqa: E402
+from riak_ensemble_tpu.ops import schedule as sched  # noqa: E402
+
+KINDS = np.array([eng.OP_NOOP, eng.OP_GET, eng.OP_PUT, eng.OP_CAS])
+
+
+def _random_planes(rng, k, e, n_slots, p_noop=0.2, p_dup=0.5,
+                   p_invalid=0.05):
+    """Random mixed-op [K, E] planes with engineered slot duplicates
+    (the scheduler's whole reason to exist)."""
+    kind = rng.choice(KINDS, (k, e), p=[p_noop, 0.35, 0.35, 0.1])
+    slot = rng.integers(0, n_slots, (k, e), dtype=np.int32)
+    # Force duplicate chains: some rows reuse the previous row's slot.
+    for i in range(1, k):
+        reuse = rng.random(e) < p_dup
+        slot[i, reuse] = slot[i - 1, reuse]
+    slot[rng.random((k, e)) < p_invalid] = -1
+    val = rng.integers(1, 1 << 20, (k, e), dtype=np.int32)
+    lease = rng.random((k, e)) < 0.5
+    # CAS expectations: mostly misses, some (0, 0) create-if-missing.
+    xe = rng.integers(0, 3, (k, e), dtype=np.int32)
+    xs = rng.integers(0, 3, (k, e), dtype=np.int32)
+    return kind.astype(np.int32), slot, val, lease, xe, xs
+
+
+def _scalar_oracle(state, planes, up):
+    """Apply the plan's serialization through the scalar scan."""
+    kind, slot, val, lease, xe, xs = planes
+    plan = sched.schedule_wide(kind, slot, val, lease, xe, xs)
+    ok, _ = sched.flat_order(plan)
+    ee = np.arange(kind.shape[1])[None, :]
+    reorder = lambda p: jnp.asarray(p[ok, ee])  # noqa: E731
+    st, res = eng.kv_step_scan(
+        state, reorder(kind), reorder(slot), reorder(val),
+        reorder(lease), up, exp_epoch=reorder(xe), exp_seq=reorder(xs))
+    return st, res, plan, ok
+
+
+def _elected_state(rng, e, m, s):
+    state = eng.init_state(e, m, s)
+    up = jnp.ones((e, m), bool)
+    state, won = eng.elect_step(
+        state, jnp.ones((e,), bool), jnp.zeros((e,), jnp.int32), up)
+    assert bool(np.asarray(won).all())
+    return state, up
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_wide_equals_sequential(seed):
+    rng = np.random.default_rng(seed)
+    e, m, s, k = 17, 3, 32, 12
+    state, up = _elected_state(rng, e, m, s)
+
+    planes = _random_planes(rng, k, e, s)
+    st_seq, res_seq, plan, ok = _scalar_oracle(state, planes, up)
+
+    st_w, res_w = eng.kv_step_scan_wide(
+        state, jnp.asarray(plan.kind), jnp.asarray(plan.slot),
+        jnp.asarray(plan.val), jnp.asarray(plan.lease_ok), up,
+        exp_epoch=jnp.asarray(plan.exp_epoch),
+        exp_seq=jnp.asarray(plan.exp_seq))
+
+    # Final state bit-equal.
+    for name, a, b in zip(st_seq._fields, st_seq, st_w):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"state field {name}")
+
+    # Per-op results: route the wide [G, E, W] results back to original
+    # (k, e) order and compare against the sequential results (which
+    # ran in plan order — invert that reorder).
+    ee = np.arange(e)[None, :]
+    inv = np.empty_like(ok)
+    inv[ok, ee] = np.arange(k)[:, None] * np.ones((1, e), np.int32)
+    active = planes[0] != eng.OP_NOOP  # NOOP padding routes to (0, 0):
+    #                                    its routed result is undefined
+    for field in ("committed", "get_ok", "found", "value", "obj_vsn"):
+        wide = sched.route_results(plan, np.asarray(getattr(res_w, field)))
+        seq = np.asarray(getattr(res_seq, field))[inv, ee]
+        np.testing.assert_array_equal(wide[active], seq[active],
+                                      err_msg=field)
+
+
+def test_wide_with_down_peers_and_duplicates():
+    """Quorum edges (down peers) and all-duplicate columns (degenerate
+    W=1 chains) under the wide path."""
+    rng = np.random.default_rng(7)
+    e, m, s, k = 9, 5, 16, 8
+    state, up = _elected_state(rng, e, m, s)
+    up = np.array(up)
+    up[::3, m - 2:] = False  # minority down in every 3rd ensemble
+    up = jnp.asarray(up)
+
+    kind, slot, val, lease, xe, xs = _random_planes(rng, k, e, s)
+    slot[:, 0] = 5  # one column: every op on the same slot
+    planes = (kind, slot, val, lease, xe, xs)
+    st_seq, res_seq, plan, ok = _scalar_oracle(state, planes, up)
+    st_w, res_w = eng.kv_step_scan_wide(
+        state, jnp.asarray(plan.kind), jnp.asarray(plan.slot),
+        jnp.asarray(plan.val), jnp.asarray(plan.lease_ok), up,
+        exp_epoch=jnp.asarray(plan.exp_epoch),
+        exp_seq=jnp.asarray(plan.exp_seq))
+    for name, a, b in zip(st_seq._fields, st_seq, st_w):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"state field {name}")
+    # The all-duplicates column serialized into k groups.
+    assert plan.map_g[:, 0].max() >= (np.asarray(kind)[:, 0]
+                                      != eng.OP_NOOP).sum() - 1
+
+
+def test_schedule_preserves_per_slot_order():
+    rng = np.random.default_rng(3)
+    k, e, s = 20, 5, 8
+    kind, slot, val, lease, xe, xs = _random_planes(rng, k, e, s,
+                                                    p_dup=0.7)
+    plan = sched.schedule_wide(kind, slot, val, lease, xe, xs)
+    active = kind != eng.OP_NOOP
+    for col in range(e):
+        for sl in np.unique(slot[:, col]):
+            if sl < 0:
+                continue
+            ops = np.where(active[:, col] & (slot[:, col] == sl))[0]
+            groups = plan.map_g[ops, col]
+            # same-slot ops occupy strictly increasing groups (k order)
+            assert (np.diff(groups) > 0).all()
+    # Within a (group, ensemble): valid slots distinct.
+    g, w = plan.kind.shape[0], plan.kind.shape[2]
+    for gi in range(g):
+        for col in range(e):
+            sls = plan.slot[gi, col][plan.kind[gi, col] != eng.OP_NOOP]
+            sls = sls[sls >= 0]
+            assert len(set(sls.tolist())) == len(sls)
+
+
+def test_schedule_width_cap_degenerates_to_sequential():
+    rng = np.random.default_rng(11)
+    kind, slot, val, lease, xe, xs = _random_planes(rng, 6, 4, 64,
+                                                    p_dup=0.0)
+    plan = sched.schedule_wide(kind, slot, val, lease, xe, xs,
+                               max_width=2)
+    assert plan.kind.shape[2] == 1 and plan.kind.shape[0] >= 6

@@ -72,17 +72,15 @@ def main() -> int:
     # window yields an honest ops/s figure — and the measured step
     # latency then sizes the real ladder's budgets (or tells us to
     # keep the small shapes first).
-    sp = _bench._run_stepprobe(
-        900.0, dict(n_ens=10_000, n_peers=5, n_slots=128, k=64))
-    if sp is not None and sp.get("platform") == "cpu":
-        # The subprocess silently fell back to CPU: the tunnel died
-        # between the preflight and here.  A CPU step time would size
-        # TPU budgets wrong AND masquerade as TPU evidence.
+    sp = _bench._run_stepprobe(900.0, _bench.STEPPROBE_SHAPES)
+    results["stepprobe"] = sp
+    if sp is not None and sp.get("cpu_fallback"):
+        # The tunnel died between the preflight and here; a CPU step
+        # time would size TPU budgets wrong AND masquerade as TPU
+        # evidence.
         note("stepprobe landed on cpu — accelerator gone; aborting ladder")
-        results["stepprobe"] = {"error": "cpu fallback (accelerator gone)"}
         persist()
         return 3
-    results["stepprobe"] = sp
     persist()
     step_s = (sp or {}).get("median_step_s")
     note(f"stepprobe: {json.dumps(sp)[:200] if sp else 'no launch completed'}")
