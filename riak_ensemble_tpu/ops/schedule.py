@@ -23,7 +23,7 @@ plane shapes, not one per flush.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -57,7 +57,8 @@ def _pow2_at_least(n: int) -> int:
 def schedule_wide(kind: np.ndarray, slot: np.ndarray, val: np.ndarray,
                   lease_ok: np.ndarray,
                   exp_epoch: np.ndarray, exp_seq: np.ndarray,
-                  max_width: int = 0) -> WidePlan:
+                  max_width: int = 0,
+                  max_groups: int = 0) -> Optional[WidePlan]:
     """Pack ``[K, E]`` planes into a :class:`WidePlan`.
 
     Vectorized (no per-op Python loop): occurrence indices come from a
@@ -69,6 +70,11 @@ def schedule_wide(kind: np.ndarray, slot: np.ndarray, val: np.ndarray,
     lanes to later groups would complicate ordering, so instead the
     cap simply falls back to W=1 scheduling when a flush is wider —
     callers use it to bound plane memory; 0 = no cap).
+
+    ``max_groups`` > 0 returns None as soon as the duplicate chains
+    run deeper than that many groups — the caller will take its
+    scalar path, so the lane sort and plane packing (about two thirds
+    of the scheduling cost) are skipped for those flushes.
     """
     k_depth, n_ens = kind.shape
     kind = np.ascontiguousarray(kind, np.int32)
@@ -100,6 +106,9 @@ def schedule_wide(kind: np.ndarray, slot: np.ndarray, val: np.ndarray,
     chain_slot = np.where(active & (slot >= 0), slot, -1 - kk)
     group = _rank_in_runs(ee, chain_slot)
     group[~active] = 0
+    if max_groups and active.any() \
+            and int(group[active].max()) + 1 > max_groups:
+        return None  # deep duplicate chains: caller's scalar path
 
     # Lane = rank of k among ACTIVE ops in the same (e, group);
     # inactives share a sentinel group key, so they never dilute a

@@ -75,6 +75,22 @@ def _pack_results(won, res: eng.KvResult, want_vsn: bool):
     return jnp.concatenate([jnp.packbits(flags), ints_u8])
 
 
+def _wide_to_packed_layout(res: eng.KvResult, g: int, w: int,
+                           e: int) -> eng.KvResult:
+    """Reshape a wide [G, E, W] result into the packed [G*W, E] layout
+    (lane-major per group) so :func:`_pack_results`/
+    :func:`unpack_results` serve both step flavors unchanged; the
+    launch path then routes rows back to op order via the plan's
+    (map_g, map_w)."""
+    def t(x):
+        return x.transpose(0, 2, 1).reshape(g * w, e)
+    return res._replace(
+        committed=t(res.committed), get_ok=t(res.get_ok),
+        found=t(res.found), value=t(res.value),
+        obj_vsn=res.obj_vsn.transpose(0, 2, 1, 3).reshape(g * w, e, 2),
+        quorum_ok=t(res.quorum_ok))
+
+
 def unpack_results(flat: np.ndarray, e: int, m: int, k: int,
                    want_vsn: bool):
     """Invert :func:`_pack_results`: one packed uint8 vector →
@@ -146,6 +162,22 @@ def warmup_kernels(svc: "BatchedEnsembleService") -> None:
         if k >= svc.max_k:
             break
         k = 1 if k == 0 else k * 2
+    if svc._wide and getattr(svc.engine, "full_step_wide", None):
+        # The wide gate admits plans with G in {1, 2} and pow2 W up to
+        # _pow2_at_least(flush depth) — a non-pow2 max_k still
+        # schedules into the NEXT pow2 width, so warm through it.
+        w_max = 1 << (max(svc.max_k, 1) - 1).bit_length()
+        for g in (1, 2):
+            w = 1
+            while w <= w_max:
+                kind = jnp.zeros((g, e, w), jnp.int32)
+                lease = jnp.zeros((g, e, w), bool)
+                _, won, res = svc.engine.full_step_wide(
+                    st, elect, cand, kind, kind, kind, lease, up,
+                    exp_epoch=kind, exp_seq=kind)
+                np.asarray(_pack_results(
+                    won, _wide_to_packed_layout(res, g, w, e), True))
+                w *= 2
 
 
 class _LocalEngine:
@@ -157,6 +189,7 @@ class _LocalEngine:
 
     init_state = staticmethod(eng.init_state)
     full_step = staticmethod(eng.full_step)
+    full_step_wide = staticmethod(eng.full_step_wide)
     rebuild_trees = staticmethod(eng.rebuild_trees)
     exchange_step = staticmethod(eng.exchange_step)
     reconfig_step = staticmethod(eng.reconfig_step)
@@ -391,6 +424,16 @@ class BatchedEnsembleService:
         self._timer: Optional[Timer] = None
         self._kick_pending = False  # burst flush queued (see _maybe_kick)
         self._jnp = jnp
+        #: opt-in wide rounds (RETPU_WIDE=1): a flush whose host op
+        #: planes schedule into <= 2 conflict-free wide rounds
+        #: launches through ``full_step_wide`` (ops/schedule.py);
+        #: deeper duplicate chains and device-resident planes keep the
+        #: scalar scan.  Replication note: the schedule is a pure
+        #: function of the shipped [K, E] planes, so replica hosts
+        #: recompute it bit-identically — but the flag itself must
+        #: match across a replication group (a mismatch diverges seq
+        #: assignment; the ack CRC detects it and forces re-sync).
+        self._wide = os.environ.get("RETPU_WIDE", "") == "1"
         #: per-flush latency breakdown records (bounded); see
         #: :meth:`latency_breakdown`.  Collection is always on — the
         #: clock reads are nanoseconds against millisecond launches.
@@ -1659,32 +1702,70 @@ class BatchedEnsembleService:
         self.lat_records.append(rec)
         return out
 
+    def _wide_plan(self, kind, slot, val, k, exp_e, exp_s):
+        """Schedule host [K, E] planes into conflict-free wide rounds
+        when enabled and profitable (G <= 2 — the warmed shapes); None
+        keeps the scalar scan.  Pure function of the op planes, so a
+        replication-group replica recomputes the identical plan from
+        the shipped planes."""
+        if (not self._wide or k <= 1 or isinstance(kind, jax.Array)
+                or getattr(self.engine, "full_step_wide", None) is None):
+            return None
+        from riak_ensemble_tpu.ops import schedule as sched_mod
+        zeros = np.zeros((k, self.n_ens), np.int32)
+        return sched_mod.schedule_wide(
+            kind, slot, val, zeros,  # lease rides [E]-broadcast instead
+            zeros if exp_e is None else exp_e,
+            zeros if exp_s is None else exp_s,
+            max_groups=2)
+
     def _launch_inner(self, elect, cand, now, lease_ok, kind, slot,
                       val, k, want_vsn, exp_e, exp_s):
         jnp = self._jnp
         t0 = time.perf_counter()
 
+        plan = self._wide_plan(kind, slot, val, k, exp_e, exp_s)
         # h2d slimming (the tunnel link is the throughput ceiling in
         # both directions): the lease plane uploads as [E] and
-        # broadcasts to [K, E] device-side; the up mask uploads only
-        # when the failure detector actually changed it.
-        lease_j = (jnp.broadcast_to(jnp.asarray(lease_ok),
-                                    (k, self.n_ens))
-                   if k else jnp.zeros((0, self.n_ens), bool))
-        kind_j, slot_j, val_j = (jnp.asarray(kind), jnp.asarray(slot),
-                                 jnp.asarray(val))
+        # broadcasts to the op-plane shape device-side; the up mask
+        # uploads only when the failure detector actually changed it.
         # EVERY input upload belongs to the h2d mark — an asarray
         # inlined into the step call would bill its (synchronous)
         # transfer to 'dispatch' and make the async-enqueue number
         # read milliseconds of jitter it doesn't have (VERDICT r3 #4).
+        if plan is not None:
+            g_b, _, w_b = plan.kind.shape
+            lease_j = jnp.broadcast_to(
+                jnp.asarray(lease_ok)[None, :, None],
+                (g_b, self.n_ens, w_b))
+            kind_j, slot_j, val_j = (jnp.asarray(plan.kind),
+                                     jnp.asarray(plan.slot),
+                                     jnp.asarray(plan.val))
+            exp_e_j = jnp.asarray(plan.exp_epoch)
+            exp_s_j = jnp.asarray(plan.exp_seq)
+        else:
+            lease_j = (jnp.broadcast_to(jnp.asarray(lease_ok),
+                                        (k, self.n_ens))
+                       if k else jnp.zeros((0, self.n_ens), bool))
+            kind_j, slot_j, val_j = (jnp.asarray(kind),
+                                     jnp.asarray(slot),
+                                     jnp.asarray(val))
+            exp_e_j = None if exp_e is None else jnp.asarray(exp_e)
+            exp_s_j = None if exp_s is None else jnp.asarray(exp_s)
         elect_j, cand_j = jnp.asarray(elect), jnp.asarray(cand)
         up_j = self._up_device()
-        exp_e_j = None if exp_e is None else jnp.asarray(exp_e)
-        exp_s_j = None if exp_s is None else jnp.asarray(exp_s)
         t1 = time.perf_counter()
-        state, won, res = self.engine.full_step(
-            self.state, elect_j, cand_j, kind_j, slot_j, val_j,
-            lease_j, up_j, exp_epoch=exp_e_j, exp_seq=exp_s_j)
+        if plan is not None:
+            state, won, res = self.engine.full_step_wide(
+                self.state, elect_j, cand_j, kind_j, slot_j, val_j,
+                lease_j, up_j, exp_epoch=exp_e_j, exp_seq=exp_s_j)
+            res = _wide_to_packed_layout(res, g_b, w_b, self.n_ens)
+            k_eff = g_b * w_b
+        else:
+            state, won, res = self.engine.full_step(
+                self.state, elect_j, cand_j, kind_j, slot_j, val_j,
+                lease_j, up_j, exp_epoch=exp_e_j, exp_seq=exp_s_j)
+            k_eff = k
         self.state = state
         t2 = time.perf_counter()
 
@@ -1703,8 +1784,22 @@ class BatchedEnsembleService:
         self._lat_last = {"h2d": t1 - t0, "dispatch": t2 - t1,
                           "device_d2h": t3 - t2}
         (won_np, quorum_ok, corrupt_np, committed, get_ok, found,
-         value, vsn) = unpack_results(flat, e, m, k, want_vsn)
+         value, vsn) = unpack_results(flat, e, m, k_eff, want_vsn)
         corrupt = corrupt_np if k else None
+        if plan is not None:
+            # Route the [G*W, E] results back to the caller's [K, E]
+            # op order; padding/NOOP rows read garbage lanes, so they
+            # are masked to the scalar path's NOOP results (all-false,
+            # zero value/vsn).
+            ee_idx = np.arange(e, dtype=np.int32)[None, :]
+            fl = plan.map_g * w_b + plan.map_w
+            act = np.asarray(kind) != eng.OP_NOOP
+            committed = committed[fl, ee_idx] & act
+            get_ok = get_ok[fl, ee_idx] & act
+            found = found[fl, ee_idx] & act
+            value = np.where(act, value[fl, ee_idx], 0)
+            if vsn is not None:
+                vsn = np.where(act[..., None], vsn[fl, ee_idx], 0)
 
         # Host mirror: a won election installed our candidate.
         self.leader_np = np.where(won_np, cand, self.leader_np)

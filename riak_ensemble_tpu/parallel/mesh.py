@@ -85,6 +85,14 @@ _SCAN_RESULT_SPECS = eng.KvResult(
     quorum_ok=P(None, "ens"), tree_corrupt=P(None, "ens", "peer"),
 )
 
+# kv_step_scan_wide stacks [G, E, W] (tree_corrupt: [G, E, Ml]).
+_WIDE_RESULT_SPECS = eng.KvResult(
+    committed=P(None, "ens", None), get_ok=P(None, "ens", None),
+    found=P(None, "ens", None), value=P(None, "ens", None),
+    obj_vsn=P(None, "ens", None, None), quorum_ok=P(None, "ens", None),
+    tree_corrupt=P(None, "ens", "peer"),
+)
+
 
 class ShardedEngine:
     """Engine kernels shard_map'd over a ('ens', 'peer') mesh.
@@ -123,6 +131,16 @@ class ShardedEngine:
              P(None, "ens"), P(None, "ens"), P(None, "ens"),
              P("ens", "peer"), P(None, "ens"), P(None, "ens")),
             (_STATE_SPECS, P("ens"), _SCAN_RESULT_SPECS))
+        self._full_wide = smap(
+            lambda st, el, ca, k, sl, v, lz, up, xe, xs:
+                eng.full_step_wide(
+                    st, el, ca, k, sl, v, lz, up, axis_name=ax,
+                    exp_epoch=xe, exp_seq=xs),
+            (_STATE_SPECS, P("ens"), P("ens"), P(None, "ens", None),
+             P(None, "ens", None), P(None, "ens", None),
+             P(None, "ens", None), P("ens", "peer"),
+             P(None, "ens", None), P(None, "ens", None)),
+            (_STATE_SPECS, P("ens"), _WIDE_RESULT_SPECS))
         self._reconfig = smap(
             lambda st, pr, nv, up: eng.reconfig_step(st, pr, nv, up,
                                                      axis_name=ax),
@@ -192,6 +210,15 @@ class ShardedEngine:
         exp_epoch, exp_seq = _default_exp(kind, exp_epoch, exp_seq)
         return self._full(state, elect, cand, kind, slot, val, lease_ok,
                           up, exp_epoch, exp_seq)
+
+    def full_step_wide(self, state, elect, cand, kind, slot, val,
+                       lease_ok, up, exp_epoch=None, exp_seq=None):
+        """Wide-scheduled flagship step over the mesh: [G, E, W]
+        conflict-free planes (:func:`riak_ensemble_tpu.ops.engine.
+        kv_step_scan_wide`)."""
+        exp_epoch, exp_seq = _default_exp(kind, exp_epoch, exp_seq)
+        return self._full_wide(state, elect, cand, kind, slot, val,
+                               lease_ok, up, exp_epoch, exp_seq)
 
     def reconfig_step(self, state, propose, new_view, up):
         """Joint-consensus membership change over the mesh

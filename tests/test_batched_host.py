@@ -627,6 +627,15 @@ def test_launch_failure_fails_ops_instead_of_orphaning():
                 raise RuntimeError("injected device failure")
             return _LocalEngine.full_step(*a, **kw)
 
+        # a RETPU_WIDE=1 run launches through the wide twin — the
+        # injection must cover whichever flavor the flush takes
+        @classmethod
+        def full_step_wide(cls, *a, **kw):
+            if cls.fail_next:
+                cls.fail_next = False
+                raise RuntimeError("injected device failure")
+            return _LocalEngine.full_step_wide(*a, **kw)
+
     runtime = Runtime(seed=50)
     svc = BatchedEnsembleService(runtime, 4, 3, 8, tick=None,
                                  config=fast_test_config(),
