@@ -81,8 +81,11 @@ def fold(children: jnp.ndarray) -> jnp.ndarray:
     lane = jnp.arange(LANES, dtype=jnp.uint32)
     h = _fmix(children * _C1 + pos + lane)
     acc = h.sum(axis=-2, dtype=jnp.uint32)
-    # one cross-lane stir so lane j depends on lane j-1
-    acc = acc ^ jnp.roll(acc, 1, axis=-1)
+    # two cross-lane stirs: after roll(1)+fmix then roll(2), lane j
+    # reads lanes {j, j-1, j-2, j-3} — a change in ANY input lane
+    # avalanches every output lane (test_fold_avalanche pins ~50%)
+    acc = _fmix(acc ^ jnp.roll(acc, 1, axis=-1))
+    acc = acc ^ jnp.roll(acc, 2, axis=-1)
     return _fmix(acc ^ np.uint32(width))
 
 

@@ -34,16 +34,18 @@ class WidePlan(NamedTuple):
     """Scheduled planes + the result-routing map.
 
     kind/slot/val/lease_ok/exp_epoch/exp_seq: ``[G, E, W]`` (padding
-    lanes are OP_NOOP at slot -1).  ``map_g``/``map_w``: ``[K, E]``
-    int32 — original op (k, e)'s group and lane, for routing
-    ``KvResult[G, E, W]`` back to per-op order (padding/NOOP inputs
-    map to their own lanes too, so the routing is total).
+    lanes are OP_NOOP at slot -1; ``lease_ok`` is None when the
+    caller's lease is per-ensemble and rides an [E]-broadcast
+    instead).  ``map_g``/``map_w``: ``[K, E]`` int32 — original op
+    (k, e)'s group and lane, for routing ``KvResult[G, E, W]`` back
+    to per-op order (NOOP padding maps to (0, 0); its routed result
+    is meaningless and callers mask it).
     """
 
     kind: np.ndarray
     slot: np.ndarray
     val: np.ndarray
-    lease_ok: np.ndarray
+    lease_ok: Optional[np.ndarray]
     exp_epoch: np.ndarray
     exp_seq: np.ndarray
     map_g: np.ndarray
@@ -55,7 +57,7 @@ def _pow2_at_least(n: int) -> int:
 
 
 def schedule_wide(kind: np.ndarray, slot: np.ndarray, val: np.ndarray,
-                  lease_ok: np.ndarray,
+                  lease_ok: Optional[np.ndarray],
                   exp_epoch: np.ndarray, exp_seq: np.ndarray,
                   max_width: int = 0,
                   max_groups: int = 0) -> Optional[WidePlan]:
@@ -121,7 +123,12 @@ def schedule_wide(kind: np.ndarray, slot: np.ndarray, val: np.ndarray,
     width = int(lane[active].max()) + 1 if any_active else 1
     if max_width and width > max_width:
         # Wider than the caller's memory budget: degenerate to the
-        # sequential layout ([K, E, 1]), which is always legal.
+        # sequential layout ([K, E, 1]), which is always legal — but
+        # it has K groups, so a max_groups bound still applies (the
+        # caller's warmed-program set must hold for EVERY returned
+        # plan, not just the un-capped ones).
+        if max_groups and k_depth > max_groups:
+            return None
         group, lane = kk.copy(), np.zeros_like(kk)
         n_groups, width = k_depth, 1
     n_groups = _pow2_at_least(n_groups)
@@ -135,7 +142,8 @@ def schedule_wide(kind: np.ndarray, slot: np.ndarray, val: np.ndarray,
 
     return WidePlan(
         kind=pack(kind, OP_NOOP), slot=pack(slot, -1), val=pack(val, 0),
-        lease_ok=pack(np.asarray(lease_ok, np.int32), 0).astype(bool),
+        lease_ok=(None if lease_ok is None else
+                  pack(np.asarray(lease_ok, np.int32), 0).astype(bool)),
         exp_epoch=pack(exp_epoch, 0), exp_seq=pack(exp_seq, 0),
         map_g=group, map_w=lane)
 

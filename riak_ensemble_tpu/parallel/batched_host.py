@@ -434,6 +434,9 @@ class BatchedEnsembleService:
         #: match across a replication group (a mismatch diverges seq
         #: assignment; the ack CRC detects it and forces re-sync).
         self._wide = os.environ.get("RETPU_WIDE", "") == "1"
+        #: launches that actually took the wide path (tests assert the
+        #: A/B coverage is real; stats() reports it)
+        self.wide_launches = 0
         #: per-flush latency breakdown records (bounded); see
         #: :meth:`latency_breakdown`.  Collection is always on — the
         #: clock reads are nanoseconds against millisecond launches.
@@ -1707,14 +1710,24 @@ class BatchedEnsembleService:
         when enabled and profitable (G <= 2 — the warmed shapes); None
         keeps the scalar scan.  Pure function of the op planes, so a
         replication-group replica recomputes the identical plan from
-        the shipped planes."""
+        the shipped planes.
+
+        Serialization contract: a wide flush executes its ops in
+        (group, lane) order — per-SLOT order is preserved (the g-th
+        same-slot op runs in round g), but commit seqs across
+        DIFFERENT slots may interleave differently than the scalar
+        scan's k order.  That is a valid serialization with exactly
+        the reference's freedom (key-hashed workers complete distinct
+        keys in unspecified relative order, peer.erl:1220-1225); both
+        orders are deterministic per mode, which is what replication
+        needs."""
         if (not self._wide or k <= 1 or isinstance(kind, jax.Array)
                 or getattr(self.engine, "full_step_wide", None) is None):
             return None
         from riak_ensemble_tpu.ops import schedule as sched_mod
         zeros = np.zeros((k, self.n_ens), np.int32)
         return sched_mod.schedule_wide(
-            kind, slot, val, zeros,  # lease rides [E]-broadcast instead
+            kind, slot, val, None,  # lease rides [E]-broadcast instead
             zeros if exp_e is None else exp_e,
             zeros if exp_s is None else exp_s,
             max_groups=2)
@@ -1761,6 +1774,7 @@ class BatchedEnsembleService:
                 lease_j, up_j, exp_epoch=exp_e_j, exp_seq=exp_s_j)
             res = _wide_to_packed_layout(res, g_b, w_b, self.n_ens)
             k_eff = g_b * w_b
+            self.wide_launches += 1
         else:
             state, won, res = self.engine.full_step(
                 self.state, elect_j, cand_j, kind_j, slot_j, val_j,

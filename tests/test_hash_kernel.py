@@ -144,3 +144,65 @@ def test_update_duplicate_seg_ids_last_write_wins():
         want = hashk.update(want, ids[i:i + 1], new[i:i + 1], width=16)
     for a, b in zip(got, want):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- fold quality: the detection properties the parallel-mix form
+# -- claims (uniformity + avalanche + order sensitivity) -------------
+
+
+def test_fold_avalanche():
+    """A single flipped bit in any child flips ~half the parent bits
+    (the corruption-detection property the round-4 parallel-mix fold
+    must preserve from the chained form)."""
+    rng = np.random.default_rng(0)
+    children = np.asarray(rng.integers(0, 2**32, (16, hashk.LANES)),
+                          dtype=np.uint32)
+    base = np.asarray(hashk.fold(jnp.asarray(children)))
+    fracs = []
+    for trial in range(64):
+        i = rng.integers(0, 16)
+        lane = rng.integers(0, hashk.LANES)
+        bit = rng.integers(0, 32)
+        mut = children.copy()
+        mut[i, lane] ^= np.uint32(1) << np.uint32(bit)
+        out = np.asarray(hashk.fold(jnp.asarray(mut)))
+        assert (out != base).any(), "flip went undetected"
+        diff = np.bitwise_xor(out, base)
+        nbits = sum(int(x).bit_count() for x in diff.ravel())
+        fracs.append(nbits / (32 * hashk.LANES))
+    mean = float(np.mean(fracs))
+    assert 0.40 < mean < 0.60, f"avalanche degraded: {mean:.3f}"
+
+
+def test_fold_order_and_position_sensitivity():
+    """Swapping two distinct children, or moving a value to a
+    different position among zeros, changes the parent (the position
+    salt)."""
+    rng = np.random.default_rng(1)
+    children = np.asarray(rng.integers(0, 2**32, (16, hashk.LANES)),
+                          dtype=np.uint32)
+    base = np.asarray(hashk.fold(jnp.asarray(children)))
+    swapped = children.copy()
+    swapped[[2, 9]] = swapped[[9, 2]]
+    assert (np.asarray(hashk.fold(jnp.asarray(swapped))) != base).any()
+
+    for pos in range(1, 16):
+        a = np.zeros((16, hashk.LANES), np.uint32)
+        b = np.zeros((16, hashk.LANES), np.uint32)
+        a[0] = 12345
+        b[pos] = 12345
+        assert (np.asarray(hashk.fold(jnp.asarray(a)))
+                != np.asarray(hashk.fold(jnp.asarray(b)))).any(), pos
+
+
+def test_fold_collision_smoke():
+    """10k random child blocks -> 10k distinct parents (128-bit lanes
+    make true collisions astronomically unlikely; a structural flaw in
+    the mix would show up immediately)."""
+    rng = np.random.default_rng(2)
+    blocks = np.asarray(
+        rng.integers(0, 2**32, (10_000, 16, hashk.LANES)),
+        dtype=np.uint32)
+    outs = np.asarray(hashk.fold(jnp.asarray(blocks)))
+    view = {tuple(int(v) for v in row) for row in outs}
+    assert len(view) == 10_000

@@ -1,8 +1,10 @@
 """End-to-end wide scheduling (RETPU_WIDE): the batched service over
-full_step_wide must be client-indistinguishable from the scalar scan —
-same commits, same reads, same versions — across keyed batches, CAS,
-deletes, duplicates (which force multi-group plans) and the dynamic
-lifecycle."""
+full_step_wide must be client-indistinguishable from the scalar scan
+for conflict-free flushes — same commits, same reads, same versions —
+and must realize a valid serialization (per-key order preserved,
+per-key vsn monotone) for duplicate chains.  ``wide_launches`` pins
+that the wide path actually ran (a vacuous scalar-vs-scalar A/B
+passes for the wrong reason)."""
 
 import numpy as np
 import pytest
@@ -13,9 +15,10 @@ from riak_ensemble_tpu.ops import engine as eng  # noqa: E402
 from riak_ensemble_tpu.parallel.batched_host import (  # noqa: E402
     BatchedEnsembleService, warmup_kernels)
 from riak_ensemble_tpu.runtime import Runtime  # noqa: E402
+from riak_ensemble_tpu.types import NOTFOUND  # noqa: E402
 
 
-def _mk(monkeypatch, wide: bool, **kw):
+def _mk(wide: bool, **kw):
     rt = Runtime(seed=5)
     svc = BatchedEnsembleService(rt, n_ens=6, n_peers=3, n_slots=16,
                                  tick=None, max_ops_per_tick=8, **kw)
@@ -23,73 +26,102 @@ def _mk(monkeypatch, wide: bool, **kw):
     return rt, svc
 
 
-def _drive(rt, svc, pending):
-    while pending:
+def _drain(rt, svc, futs, rounds=10):
+    for _ in range(rounds):
         svc.flush()
-        done = [p for p in pending if p[1].done]
-        pending = [p for p in pending if not p[1].done]
-        rt.run_for(0.01)
-    return pending
+        rt.run_for(0.005)
+        if all(f.done for f in futs):
+            return
+    assert all(f.done for f in futs), "futures never resolved"
 
 
 def _workload(rt, svc, seed):
-    """A mixed keyed workload; returns the resolved future values in
-    issue order (the client-visible history)."""
+    """Mixed keyed workload with DISTINCT keys per flush (conflict-free
+    — the wide path's bread and butter); put and get flushes drained
+    separately so no flush chains a put with its own get."""
     rng = np.random.default_rng(seed)
     out = []
-    futs = []
-    for step in range(6):
+    for step in range(5):
+        puts = []
         for e in range(svc.n_ens):
-            keys = [f"k{rng.integers(0, 6)}" for _ in range(3)]
-            futs.append(svc.kput_many(e, keys,
-                                      [int(rng.integers(1, 99))
-                                       for _ in keys]))
-            futs.append(svc.kget_many(e, keys))
-            if rng.random() < 0.5:
-                futs.append(svc.kget(e, "k0"))
+            keys = [f"k{i}" for i in rng.choice(6, 3, replace=False)]
+            puts.append(svc.kput_many(
+                e, keys, [int(rng.integers(1, 99)) for _ in keys]))
+        _drain(rt, svc, puts)
+        gets = []
+        for e in range(svc.n_ens):
+            keys = [f"k{i}" for i in rng.choice(6, 3, replace=False)]
+            gets.append(svc.kget_many(e, keys, want_vsn=True))
             if rng.random() < 0.3:
-                futs.append(svc.kdelete(e, keys[0]))
-        for _ in range(6):
-            svc.flush()
-            rt.run_for(0.005)
-    for f in futs:
-        assert f.done, "workload future never resolved"
-        out.append(f.value)
+                gets.append(svc.kdelete(e, keys[0]))
+        _drain(rt, svc, gets)
+        out.extend(f.value for f in puts)
+        out.extend(f.value for f in gets)
     return out
 
 
 @pytest.mark.parametrize("seed", [0, 1])
-def test_wide_service_matches_scalar(monkeypatch, seed):
-    rt_a, svc_a = _mk(monkeypatch, wide=False)
-    rt_b, svc_b = _mk(monkeypatch, wide=True)
+def test_wide_service_matches_scalar(seed):
+    rt_a, svc_a = _mk(wide=False)
+    rt_b, svc_b = _mk(wide=True)
     hist_a = _workload(rt_a, svc_a, seed)
     hist_b = _workload(rt_b, svc_b, seed)
     assert hist_a == hist_b
+    assert svc_a.wide_launches == 0
+    # The A/B is only meaningful if the wide service actually took the
+    # wide path (put+get-same-key flushes would chain past the G<=2
+    # gate and silently compare scalar against scalar).
+    assert svc_b.wide_launches > 0
+
+
+def test_wide_duplicate_chain_is_a_valid_serialization():
+    """kput_many with a duplicate key executes as the (group, lane)
+    order: per-key vsns stay monotone, the LAST same-key put wins, and
+    every op acks — the seq interleaving across different keys may
+    differ from the scalar scan (the reference's key-hashed workers
+    have the same freedom), which is why this asserts semantics, not
+    cross-mode equality."""
+    rt, svc = _mk(wide=True)
+    f = svc.kput_many(0, ["a", "a", "b"], [1, 2, 3])
+    _drain(rt, svc, [f])
+    rs = f.value
+    assert all(r[0] == "ok" for r in rs), rs
+    vsn_a1, vsn_a2 = tuple(rs[0][1]), tuple(rs[1][1])
+    assert vsn_a2 > vsn_a1  # per-key monotone
+    g = svc.kget_many(0, ["a", "b"], want_vsn=True)
+    _drain(rt, svc, [g])
+    (st_a, val_a, got_a), (st_b, val_b, got_b) = g.value
+    assert (st_a, val_a) == ("ok", 2)       # last duplicate won
+    assert tuple(got_a) == vsn_a2
+    assert (st_b, val_b) == ("ok", 3)
 
 
 def test_wide_execute_bulk_matches_scalar():
-    rng = np.random.default_rng(3)
     results = []
     for wide in (False, True):
-        rt, svc = _mk(None, wide)
+        rt, svc = _mk(wide)
         rt.run_for(1.0)
         svc.flush()  # elections
         k, e = 8, svc.n_ens
         rng2 = np.random.default_rng(3)
         kind = rng2.choice([eng.OP_PUT, eng.OP_GET, eng.OP_NOOP],
                            (k, e), p=[0.5, 0.4, 0.1]).astype(np.int32)
-        slot = rng2.integers(0, svc.n_slots, (k, e), dtype=np.int32)
-        slot[3] = slot[2]  # forced duplicate row -> G >= 2 plan
+        # distinct slots per column: every plane schedules G=1 (the
+        # cross-slot seq order then matches the scalar scan exactly)
+        slot = np.stack([rng2.permutation(svc.n_slots)[:k]
+                         for _ in range(e)], axis=1).astype(np.int32)
         val = rng2.integers(1, 1 << 20, (k, e), dtype=np.int32)
         out = svc.execute(kind, slot, val)
         results.append(tuple(np.asarray(x).tolist() for x in out))
+        if wide:
+            assert svc.wide_launches > 0
     assert results[0] == results[1]
 
 
 def test_wide_gate_falls_back_on_deep_duplicates():
     """> 2 occurrence groups must take the scalar path (only G<=2 wide
     programs are warmed)."""
-    rt, svc = _mk(None, True)
+    rt, svc = _mk(True)
     k, e = 6, svc.n_ens
     kind = np.full((k, e), eng.OP_PUT, np.int32)
     slot = np.zeros((k, e), np.int32)  # 6-deep duplicate chain
@@ -99,23 +131,20 @@ def test_wide_gate_falls_back_on_deep_duplicates():
     slot2 = np.tile(np.arange(k, dtype=np.int32)[:, None], (1, e))
     plan = svc._wide_plan(kind, slot2, val, k, None, None)
     assert plan is not None and plan.kind.shape[0] == 1
+    assert plan.lease_ok is None  # service lease rides [E]-broadcast
 
 
 def test_wide_warmup_covers_gated_shapes():
-    rt, svc = _mk(None, True)
+    rt, svc = _mk(True)
     warmup_kernels(svc)  # must not raise; compiles wide programs too
 
 
 def test_wide_dynamic_lifecycle():
-    rt, svc = _mk(None, True, dynamic=True)
+    rt, svc = _mk(True, dynamic=True)
     h = svc.create_ensemble("orders")
     rt.run_for(0.5)
     svc.flush()
     f = svc.kput(svc.ensemble_row("orders"), "a", b"1") \
         if hasattr(svc, "ensemble_row") else svc.kput(h, "a", b"1")
-    for _ in range(8):
-        svc.flush()
-        rt.run_for(0.01)
-        if f.done:
-            break
-    assert f.done and f.value[0] == "ok", f.value
+    _drain(rt, svc, [f])
+    assert f.value[0] == "ok", f.value
