@@ -1931,6 +1931,7 @@ class BatchedEnsembleService:
                  | self._queued_mask).sum()),
             "queued_ops": sum(self._queue_rounds),
             "execute_unlogged": self._dev_exec_unlogged,
+            "wide_launches": self.wide_launches,
         }
 
     def execute(self, kind: np.ndarray, slot: np.ndarray,
