@@ -792,7 +792,7 @@ def main() -> None:
                          "reconfig = BASELINE.md ladder #4 / #5")
     ap.add_argument("--stage",
                     choices=("kernel", "service", "merkle", "reconfig",
-                             "probe", "stepprobe"),
+                             "probe", "stepprobe", "repgroup"),
                     help="internal: run one stage in-process")
     ap.add_argument("--n-ens", type=int, default=10_000)
     ap.add_argument("--n-peers", type=int, default=5)
