@@ -152,3 +152,50 @@ def test_schedule_width_cap_degenerates_to_sequential():
     plan = sched.schedule_wide(kind, slot, val, lease, xe, xs,
                                max_width=2)
     assert plan.kind.shape[2] == 1 and plan.kind.shape[0] >= 6
+
+
+def test_wide_sharded_matches_local():
+    """ShardedEngine.full_step_wide over the virtual 8-device mesh is
+    bit-equal to the local kernel — the wide path's ICI collectives
+    (psum/pmax over the 'peer' axis) preserve the exact semantics."""
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    from riak_ensemble_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.make_mesh(4, 2)
+    se = mesh_mod.ShardedEngine(mesh)
+    e, m, s = 8, 4, 16
+    g, w = 2, 4
+    rng = np.random.default_rng(13)
+
+    st_local, up = _elected_state(rng, e, m, s)
+
+    kind = jnp.asarray(rng.choice(
+        [eng.OP_NOOP, eng.OP_GET, eng.OP_PUT], (g, e, w)), jnp.int32)
+    # distinct valid slots per (group, ensemble) row
+    slot = jnp.asarray(np.stack(
+        [np.stack([rng.permutation(s)[:w] for _ in range(e)])
+         for _ in range(g)]).astype(np.int32))
+    val = jnp.asarray(rng.integers(1, 99, (g, e, w)), jnp.int32)
+    lease = jnp.asarray(rng.random((g, e, w)) < 0.5)
+    # Re-elect half the ensembles in the same fused step so the won
+    # output (P('ens') spec) and the election's peer-axis collectives
+    # are part of the bit-equality check, not dead outputs.
+    elect = jnp.asarray(np.arange(e) % 2 == 0)
+    cand = jnp.ones((e,), jnp.int32)
+
+    st_a, won_a, res_a = eng.full_step_wide(
+        st_local, elect, cand, kind, slot, val, lease, up)
+
+    st_sh = se.shard_state(st_local)
+    st_b, won_b, res_b = se.full_step_wide(
+        st_sh, elect, cand, kind, slot, val, lease, up)
+
+    np.testing.assert_array_equal(np.asarray(won_a), np.asarray(won_b))
+    assert bool(np.asarray(won_a)[::2].all())  # elections really ran
+    for name, a, b in zip(st_a._fields, st_a, st_b):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"state field {name}")
+    for name, a, b in zip(res_a._fields, res_a, res_b):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"result field {name}")
