@@ -54,7 +54,6 @@ import numpy as np
 from riak_ensemble_tpu import funref
 from riak_ensemble_tpu import service_directory as sd
 from riak_ensemble_tpu import state as statelib
-from riak_ensemble_tpu.types import EnsembleInfo
 
 TENANT_MOD = "svc_tenant"
 

@@ -21,7 +21,6 @@ ledger the tests assert the O-bound against.
 from __future__ import annotations
 
 import socket
-import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
