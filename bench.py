@@ -583,6 +583,55 @@ def _run_stepprobe(timeout: float, shapes: dict) -> "dict | None":
     return partial
 
 
+def run_widecmp(n_ens: int, n_peers: int, n_slots: int, k: int,
+                seconds: float) -> dict:
+    """Wide-scheduling A/B: the SAME distinct-slot op plane through a
+    scalar-scan service and a wide (RETPU_WIDE-style) service, one
+    process, same workload both arms.  Distinct slots per ensemble
+    guarantee the wide arm really takes the wide path (asserted via
+    wide_launches) — random slots would chain past the G<=2 gate and
+    silently compare scalar against scalar."""
+    from riak_ensemble_tpu.ops import engine as eng
+    from riak_ensemble_tpu.parallel.batched_host import (
+        BatchedEnsembleService, WallRuntime)
+
+    assert k <= n_slots, \
+        f"distinct-slot plane needs k <= n_slots ({k} > {n_slots})"
+    rng = np.random.default_rng(0)
+    kind = rng.choice([eng.OP_PUT, eng.OP_GET],
+                      (k, n_ens)).astype(np.int32)
+    slot = np.stack([rng.permutation(n_slots)[:k]
+                     for _ in range(n_ens)], axis=1).astype(np.int32)
+    val = rng.integers(1, 1 << 20, (k, n_ens), dtype=np.int32)
+
+    out: dict = {}
+    for wide in (False, True):
+        svc = BatchedEnsembleService(WallRuntime(), n_ens, n_peers,
+                                     n_slots, tick=None,
+                                     max_ops_per_tick=k)
+        svc._wide = wide
+        # Warm the exact programs this arm launches (first call also
+        # runs the elections fold-in).
+        svc.execute(kind, slot, val)
+        svc.execute(kind, slot, val)
+        t_end = time.perf_counter() + seconds
+        iters = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() < t_end or not iters:
+            svc.execute(kind, slot, val)
+            iters += 1
+        elapsed = time.perf_counter() - t0
+        if wide:
+            assert svc.wide_launches > 0, \
+                "wide arm never took the wide path"
+        out["wide_ops_per_sec" if wide else "scalar_ops_per_sec"] = (
+            n_ens * k * iters / elapsed)
+        svc.stop()
+    out["wide_speedup"] = (out["wide_ops_per_sec"]
+                           / out["scalar_ops_per_sec"])
+    return out
+
+
 def run_merkle(seconds: float, smoke: bool) -> dict:
     """BASELINE ladder #4: incremental updates into a 1M-segment
     Merkle tree (the always-up-to-date write-path hashing)."""
@@ -766,6 +815,8 @@ def _stage_entry(args) -> None:
         out = {"kernel_rounds_per_sec": run(seconds=args.seconds, **shapes)}
     elif args.stage == "stepprobe":
         out = run_stepprobe(**shapes)
+    elif args.stage == "widecmp":
+        out = run_widecmp(seconds=args.seconds, **shapes)
     elif args.stage == "repgroup":
         out = run_repgroup(args.seconds, smoke=False)
     elif args.stage == "merkle":
@@ -792,7 +843,8 @@ def main() -> None:
                          "reconfig = BASELINE.md ladder #4 / #5")
     ap.add_argument("--stage",
                     choices=("kernel", "service", "merkle", "reconfig",
-                             "probe", "stepprobe", "repgroup"),
+                             "probe", "stepprobe", "repgroup",
+                             "widecmp"),
                     help="internal: run one stage in-process")
     ap.add_argument("--n-ens", type=int, default=10_000)
     ap.add_argument("--n-peers", type=int, default=5)

@@ -154,28 +154,39 @@ def main() -> int:
             note(f"stage {name} ok: {json.dumps(r)[:200]}")
         persist()
 
-    # Pallas quorum A/B: the same kernel stage with the Pallas reduce
-    # flag — the delta promised since round 1.  The A/B delta is a
-    # ratio, so it must run at the SAME shape as the baseline kernel
-    # number that actually banked: 10k only if the 10k kernel stage
-    # succeeded; otherwise 1k (whose baseline is kernel_1k / the 1k
-    # fallback).  Re-running a shape that already timed out would be
-    # a guaranteed re-timeout.
-    kern10k = results.get("kernel") or {}
-    at_10k = "error" not in kern10k and kern10k.get("shape") is None
-    ab_shapes = shapes if at_10k else small
-    r, err = run_stage(
-        ["--stage", "kernel", "--seconds", "3"] + ab_shapes, big,
-        env=dict(os.environ, RETPU_PALLAS_QUORUM="1"))
-    if r is not None:
-        if not at_10k:
-            r = {"shape": "1k_ens_5_peers", **r}
-        results["kernel_pallas_quorum"] = r
-        note(f"pallas A/B ok: {json.dumps(r)[:200]}")
-    else:
-        note(f"pallas A/B FAILED ({err})")
-        results["kernel_pallas_quorum"] = {"error": err}
-        ok = False
+    def run_ab(name: str, stage: str, baseline_key: str,
+               env=None) -> bool:
+        """One A/B arm: an A/B delta is a ratio, so it must run at the
+        SAME shape as the baseline number that actually banked — 10k
+        only if the baseline stage succeeded at 10k, else the 1k shape
+        (re-running a shape that already timed out is a guaranteed
+        re-timeout)."""
+        base = results.get(baseline_key) or {}
+        at_10k = "error" not in base and base.get("shape") is None
+        ab_shapes = shapes if at_10k else small
+        r, err = run_stage(
+            ["--stage", stage, "--seconds", "3"] + ab_shapes, big,
+            env=env)
+        if r is not None:
+            if not at_10k:
+                r = {"shape": "1k_ens_5_peers", **r}
+            results[name] = r
+            note(f"{name} A/B ok: {json.dumps(r)[:200]}")
+            return True
+        note(f"{name} A/B FAILED ({err})")
+        results[name] = {"error": err}
+        return False
+
+    # Pallas quorum A/B (the delta promised since round 1) and the
+    # wide-scheduling A/B (round 4: CPU-neutral, built for exactly
+    # this platform's launch-overhead profile — widecmp runs the SAME
+    # distinct-slot plane through both arms in one process, since a
+    # random-slot plane would chain past the wide gate and silently
+    # compare scalar against scalar).
+    ok &= run_ab("kernel_pallas_quorum", "kernel", "kernel",
+                 env=dict(os.environ, RETPU_PALLAS_QUORUM="1"))
+    persist()
+    ok &= run_ab("service_widecmp", "widecmp", "service")
     persist()
     note(f"ladder complete ok={ok} -> {OUT}")
     return 0 if ok else 3
