@@ -248,15 +248,18 @@ class _PendingBatch:
     """
 
     kind: int
-    slot: Any          # np.int32 [n] compact
-    handle: Any        # np.int32 [n] (puts; zeros for gets)
+    slot: Any          # List[int] [n] compact (plain lists end to
+    #                    end: numpy slice assignment packs them into
+    #                    the flush planes, and list zips resolve them
+    #                    — no per-entry asarray/tolist round trips)
+    handle: Any        # List[int] [n] (puts; zeros for gets)
     fut: Future
-    pos: Any = None    # np.int32 [n] position in the caller's order
+    pos: Any = None    # List[int] [n] position in the caller's order
     keys: Any = None   # list of key objects (puts: for WAL/recycle)
-    gen: Any = None    # np.int32 [n] slot generations (puts)
+    gen: Any = None    # List[int] [n] slot generations (puts)
     #: CAS expected versions (OP_CAS batches; None otherwise)
-    exp_e: Any = None  # np.int32 [n]
-    exp_s: Any = None  # np.int32 [n]
+    exp_e: Any = None  # List[int] [n]
+    exp_s: Any = None  # List[int] [n]
     accum: Any = None  # shared _BatchAccum across splits
     want_vsn: bool = False
     t_enq: float = 0.0
@@ -670,12 +673,13 @@ class BatchedEnsembleService:
             accum.fill(fut, miss_pos, ["failed"] * len(miss_pos),
                        self._safe_resolve)
         if live_keys:
+            # fields stay PLAIN LISTS end to end: the flush packs them
+            # by numpy slice assignment (which accepts lists) and the
+            # resolve loop zips them — the asarray/tolist round trip
+            # per entry was ~20% of the keyed host ceiling
             self._push(ens, _PendingBatch(
-                eng.OP_PUT, np.asarray(slot_l, np.int32),
-                np.asarray(handle_l, np.int32), fut,
-                np.asarray(pos_l, np.int32), live_keys,
-                np.asarray(gen_l, np.int32), accum=accum,
-                n=len(live_keys)))
+                eng.OP_PUT, slot_l, handle_l, fut, pos_l, live_keys,
+                gen_l, accum=accum, n=len(live_keys)))
         return fut
 
     def kupdate_many(self, ens: int, keys: List[Any],
@@ -695,15 +699,14 @@ class BatchedEnsembleService:
             fut.resolve(["failed"] * n)
             return fut
         accum = _BatchAccum(n)
-        slot = np.zeros((n,), np.int32)
-        handle = np.zeros((n,), np.int32)
-        gen = np.zeros((n,), np.int32)
-        pos = np.zeros((n,), np.int32)
-        exp_e = np.zeros((n,), np.int32)
-        exp_s = np.zeros((n,), np.int32)
+        slot: List[int] = []
+        handle: List[int] = []
+        gen: List[int] = []
+        pos: List[int] = []
+        exp_e: List[int] = []
+        exp_s: List[int] = []
         live_keys: List[Any] = []
         miss_pos: List[int] = []
-        m = 0
         sg = self.slot_gen[ens]
         for i, (key, vsn, value) in enumerate(
                 zip(keys, expected_vsns, values)):
@@ -715,17 +718,20 @@ class BatchedEnsembleService:
             self.values[h] = value
             g = sg.get(s, 0) + 1
             sg[s] = g
-            slot[m], handle[m], gen[m], pos[m] = s, h, g, i
-            exp_e[m], exp_s[m] = int(vsn[0]), int(vsn[1])
+            slot.append(s)
+            handle.append(h)
+            gen.append(g)
+            pos.append(i)
+            exp_e.append(int(vsn[0]))
+            exp_s.append(int(vsn[1]))
             live_keys.append(key)
-            m += 1
         if miss_pos:
             accum.fill(fut, miss_pos, ["failed"] * len(miss_pos),
                        self._safe_resolve)
-        if m:
+        if live_keys:
             self._push(ens, _PendingBatch(
-                eng.OP_CAS, slot[:m], handle[:m], fut, pos[:m],
-                live_keys, gen[:m], exp_e[:m], exp_s[:m], accum, n=m))
+                eng.OP_CAS, slot, handle, fut, pos, live_keys, gen,
+                exp_e, exp_s, accum, n=len(live_keys)))
         return fut
 
     def kdelete_many(self, ens: int, keys: List[Any]) -> Future:
@@ -740,33 +746,33 @@ class BatchedEnsembleService:
             fut.resolve(["failed"] * n)
             return fut
         accum = _BatchAccum(n)
-        slot = np.zeros((n,), np.int32)
-        gen = np.zeros((n,), np.int32)
-        pos = np.zeros((n,), np.int32)
+        slot: List[int] = []
+        gen: List[int] = []
+        pos: List[int] = []
         live_keys: List[Any] = []
         miss_pos: List[int] = []
-        m = 0
+        sg = self.slot_gen[ens]
         for i, key in enumerate(keys):
             s = self._slot_for(ens, key, allocate=False)
             if s is None:
                 miss_pos.append(i)
                 continue
-            slot[m], pos[m] = s, i
-            gen[m] = self.slot_gen[ens].get(s, 0)
+            slot.append(s)
+            pos.append(i)
+            gen.append(sg.get(s, 0))
             live_keys.append(key)
-            m += 1
         if miss_pos:
             accum.fill(fut, miss_pos, [("ok", NOTFOUND)] * len(miss_pos),
                        self._safe_resolve)
-        if m:
+        if live_keys:
+            m = len(live_keys)
             batch = _PendingBatch(
-                eng.OP_PUT, slot[:m], np.zeros((m,), np.int32), fut,
-                pos[:m], live_keys, gen[:m], accum=accum, n=m)
+                eng.OP_PUT, slot, [0] * m, fut, pos, live_keys, gen,
+                accum=accum, n=m)
             self._push(ens, batch)
             # deferred recycle per committed tombstone, keyed off the
             # batch result list (the _recycle_on_ok discipline)
-            keyslots = list(zip(live_keys, slot[:m].tolist(),
-                                gen[:m].tolist(), pos[:m].tolist()))
+            keyslots = list(zip(live_keys, slot, gen, pos))
 
             def recycle(results):
                 if not isinstance(results, list):
@@ -810,9 +816,7 @@ class BatchedEnsembleService:
         if slot_l:
             m = len(slot_l)
             self._push(ens, _PendingBatch(
-                eng.OP_GET, np.asarray(slot_l, np.int32),
-                np.zeros((m,), np.int32), fut,
-                np.asarray(pos_l, np.int32), accum=accum,
+                eng.OP_GET, slot_l, [0] * m, fut, pos_l, accum=accum,
                 want_vsn=want_vsn, n=m))
         return fut
 
@@ -1554,7 +1558,7 @@ class BatchedEnsembleService:
             busy = set()
             for op in self.queues[e]:
                 if isinstance(op, _PendingBatch):
-                    busy.update(op.slot.tolist())
+                    busy.update(op.slot)
                 else:
                     busy.add(op.slot)
             keep = []
@@ -2222,15 +2226,12 @@ class BatchedEnsembleService:
         if op.fut.done:
             return
         if op.kind in (eng.OP_PUT, eng.OP_CAS):
-            slot_l = op.slot.tolist()
-            handle_l = op.handle.tolist()
-            gen_l = op.gen.tolist()
             for i in range(op.n):
-                self._release_handle(handle_l[i])
+                self._release_handle(op.handle[i])
                 if op.keys is not None:
-                    self._queue_recycle(e, (op.keys[i], slot_l[i],
-                                            gen_l[i]))
-        op.accum.fill(op.fut, op.pos.tolist(), ["failed"] * op.n,
+                    self._queue_recycle(e, (op.keys[i], op.slot[i],
+                                            op.gen[i]))
+        op.accum.fill(op.fut, op.pos, ["failed"] * op.n,
                       self._safe_resolve)
 
     def _fail_op(self, e: int, op: _PendingOp) -> None:
@@ -2261,9 +2262,9 @@ class BatchedEnsembleService:
         if op.kind in (eng.OP_PUT, eng.OP_CAS):
             comm_l = committed[j:j + n, e].tolist()
             vs_l = vsn[j:j + n, e].tolist()
-            slot_l = op.slot.tolist()
-            handle_l = op.handle.tolist()
-            gen_l = op.gen.tolist()
+            slot_l = op.slot
+            handle_l = op.handle
+            gen_l = op.gen
             keys = op.keys if op.keys is not None else [None] * n
             slot_handle = self.slot_handle[e]
             # direct append binding for the hot loop; one dirty mark
@@ -2302,7 +2303,7 @@ class BatchedEnsembleService:
                            else ("ok", out))
                 else:
                     append("failed")
-        op.accum.fill(op.fut, op.pos.tolist(), results,
+        op.accum.fill(op.fut, op.pos, results,
                       self._safe_resolve)
 
     def _resolve_flush(self, taken, planes, ack: bool = True,
