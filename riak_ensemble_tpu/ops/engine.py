@@ -355,8 +355,13 @@ def _quorum_met(ack: jax.Array, heard: jax.Array, view_mask: jax.Array,
         # ensemble axis for the kernel, whose contract is [E', Ml].
         from riak_ensemble_tpu.ops.pallas_quorum import quorum_met_epallas
         e, w, ml = ack.shape
-        vm = jnp.broadcast_to(view_mask, (e, w) + view_mask.shape[-2:]) \
-            if view_mask.ndim == 4 else view_mask
+        # Broadcast BOTH a 3-dim [E, V, Ml] and an already-widened
+        # 4-dim [E, W, V, Ml] view_mask to the full lane shape: a
+        # 3-dim mask with W > 1 would otherwise reshape to the wrong
+        # element count and crash any caller that didn't pre-widen.
+        vm = jnp.broadcast_to(
+            view_mask if view_mask.ndim == 4 else view_mask[:, None],
+            (e, w) + view_mask.shape[-2:])
         res = quorum_met_epallas(
             ack.reshape(e * w, ml), (heard & ~ack).reshape(e * w, ml),
             vm.reshape(e * w, *vm.shape[-2:]))
@@ -823,6 +828,16 @@ def kv_step_scan_wide(state: EngineState, kind: jax.Array,
     Equivalent by construction to ``kv_step_scan`` over the same ops
     flattened to ``[G*W, E]`` in (group, lane) order — differentially
     tested in tests/test_engine_wide.py.
+
+    PRECONDITION (caller contract, not checked inside jit): within
+    every ``[g, e]`` row, the slots of valid ops (kind != OP_NOOP)
+    must be DISTINCT.  Duplicate scatter targets with differing values
+    in one round produce nondeterministic state (JAX leaves duplicate-
+    index scatter order unspecified).  The host scheduler
+    (ops/schedule.py) guarantees this by occurrence-index grouping;
+    direct kernel callers (mesh.ShardedEngine included) must do the
+    same, or run :func:`validate_wide_plane` on the concrete planes
+    (enabled in the service via ``RETPU_VALIDATE_WIDE=1``).
     """
     ctx = _kv_context(state, up, axis_name)
     if exp_epoch is None:
@@ -838,6 +853,39 @@ def kv_step_scan_wide(state: EngineState, kind: jax.Array,
     state, res = jax.lax.scan(
         body, state, (kind, slot, val, lease_ok, exp_epoch, exp_seq))
     return _adopt_epochs(state, ctx), res
+
+
+def validate_wide_plane(kind, slot) -> None:
+    """Check the wide-round conflict-free precondition on CONCRETE
+    ``[G, E, W]`` planes: within one ``[g, e]`` row, ops with
+    kind != OP_NOOP and slot >= 0 must target distinct slots.  This is
+    deliberately STRICTER than the kernel's write gate (slot_valid
+    also requires slot < n_slots, engine.py ``_kv_round``): the
+    validator has no n_slots, and it mirrors the scheduler's chaining
+    rule exactly — schedule.py chains any slot >= 0 and gives slot < 0
+    ops forced-unique keys — so a plane the scheduler would emit never
+    trips it.  Raises ValueError with the first offending
+    (group, ensemble, slot).  Host-side only (not traceable); the
+    service runs it under ``RETPU_VALIDATE_WIDE=1``.
+    """
+    kind = np.asarray(kind)
+    slot = np.asarray(slot)
+    g, e, w = kind.shape
+    valid = (kind != OP_NOOP) & (slot >= 0)
+    # sentinel-out non-writing lanes (legal slots are >= 0, so distinct
+    # negative sentinels can never collide with a real slot), then look
+    # for duplicate slots per row
+    s = np.where(valid, slot, -1 - np.arange(w))
+    s_sorted = np.sort(s, axis=-1)
+    dup = (s_sorted[..., 1:] == s_sorted[..., :-1]).any(-1)
+    if dup.any():
+        gi, ei = np.argwhere(dup)[0]
+        row = slot[gi, ei][valid[gi, ei]]
+        vals, counts = np.unique(row, return_counts=True)
+        raise ValueError(
+            f"wide plane violates the conflict-free precondition: "
+            f"group {gi}, ensemble {ei} has duplicate valid slot "
+            f"{int(vals[counts > 1][0])} (kv_step_scan_wide docstring)")
 
 
 # ---------------------------------------------------------------------------
@@ -1161,7 +1209,11 @@ def full_step_wide(state: EngineState, elect: jax.Array, cand: jax.Array,
                    exp_seq: Optional[jax.Array] = None
                    ) -> Tuple[EngineState, jax.Array, KvResult]:
     """``full_step`` with ``[G, E, W]`` conflict-free op planes (see
-    :func:`kv_step_scan_wide`) — the wide-scheduled flagship step."""
+    :func:`kv_step_scan_wide`) — the wide-scheduled flagship step.
+
+    Carries :func:`kv_step_scan_wide`'s precondition: valid slots must
+    be distinct within every ``[g, e]`` row (see its docstring;
+    :func:`validate_wide_plane` checks concrete planes)."""
     state, won = elect_step(state, elect, cand, up, axis_name=axis_name)
     state, res = kv_step_scan_wide(
         state, kind, slot, val, lease_ok, up, axis_name=axis_name,

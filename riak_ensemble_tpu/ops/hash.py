@@ -41,6 +41,15 @@ import numpy as np
 #: 4 x uint32 lanes = 128-bit hashes per bucket.
 LANES = 4
 
+#: Device-tree hash-format version.  Bump whenever :func:`fold` (or the
+#: leaf-hash family) changes output values: checkpoints persist
+#: ``tree_leaf``/``tree_node`` verbatim, and a restore across a format
+#: change must rebuild every tree or `_verify_path` fails on every slot
+#: (see docs/MIGRATION.md).  History: 1 = chained per-child accumulator
+#: (rounds 1-3), 2 = linear-pre-mix parallel fold (round 4),
+#: 3 = salted non-linear parallel fold (round 5).
+HASH_FORMAT = 3
+
 _C1 = np.uint32(0xCC9E2D51)
 _C2 = np.uint32(0x1B873593)
 _F1 = np.uint32(0x85EBCA6B)
@@ -75,11 +84,30 @@ def fold(children: jnp.ndarray) -> jnp.ndarray:
     512-ens CPU rung vs ~0.3 ms for this form).  Corruption/diff
     detection needs uniformity + avalanche, not a sequential
     construction — per-child ``_fmix`` provides both.
+
+    The per-child pre-mix is deliberately NON-linear in (child, pos):
+    the child is xor'd with an avalanched position salt and then
+    multiplied by a per-position odd constant before the ``_fmix``.  A
+    linear pre-mix (``child*C1 + pos*C2``) admits a deterministic
+    compensated-swap collision — replacing children ``(a, b)`` at
+    positions (0, 1) with ``(b+d, a-d)``, ``d = C2·C1⁻¹ mod 2³²``,
+    preserves the pre-mix multiset and thus the sum (hash format 2's
+    structured blind spot; regression: test_hash_kernel.py
+    compensated-swap tests).  With distinct odd multipliers per
+    position, neither additive nor xor shifts compensate a swap.
+    Threat model matches the reference's: this is a public integrity
+    hash for corruption/divergence *detection* (the reference's obj
+    "hash" is the plaintext ``<<0,Epoch:64,Seq:64>>``,
+    peer.erl:1717-1724) — adversarial forgery resistance is out of
+    scope on the device path; the host tree keeps cryptographic md5.
     """
     width = children.shape[-2]
-    pos = (jnp.arange(width, dtype=jnp.uint32) * _C2)[:, None]
+    # trace-time numpy constants: [width, 1] salts + odd multipliers
+    pos = np.arange(width, dtype=np.uint32)
+    salt = _fmix(pos * _C2 + np.uint32(0x9E3779B9))[:, None]
+    mul = (_fmix(pos * _F1 + _C1) | np.uint32(1))[:, None]
     lane = jnp.arange(LANES, dtype=jnp.uint32)
-    h = _fmix(children * _C1 + pos + lane)
+    h = _fmix((children ^ salt) * mul + lane)
     acc = h.sum(axis=-2, dtype=jnp.uint32)
     # two cross-lane stirs: after roll(1)+fmix then roll(2), lane j
     # reads lanes {j, j-1, j-2, j-3} — a change in ANY input lane

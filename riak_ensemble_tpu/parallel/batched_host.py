@@ -468,9 +468,14 @@ class BatchedEnsembleService:
             meta = os.path.join(data_dir, "META")
             if savelib.read(meta) is None:
                 import pickle
+                from riak_ensemble_tpu.ops import hash as hashk
                 savelib.write(meta, pickle.dumps(
                     {"shape": (n_ens, n_peers, n_slots),
-                     "dynamic": dynamic}, protocol=4))
+                     "dynamic": dynamic,
+                     # informational: META-only restores replay the
+                     # WAL through the CURRENT fold, so no rebuild is
+                     # needed — trees are born in the current format
+                     "hash_format": hashk.HASH_FORMAT}, protocol=4))
             self._wal = ServiceWAL.open_gen(
                 data_dir, self._current_ckpt(data_dir), wal_sync)
         self._schedule()
@@ -1237,8 +1242,13 @@ class BatchedEnsembleService:
         n = self._current_ckpt(path) + 1
         d = os.path.join(path, f"ckpt.{n}")
         ckpt.save(os.path.join(d, "engine"), self.state)
+        from riak_ensemble_tpu.ops import hash as hashk
         host = {
             "shape": (self.n_ens, self.n_peers, self.n_slots),
+            # Device-tree hash-format version: tree_leaf/tree_node are
+            # persisted verbatim, so a restore under a different fold
+            # must rebuild every tree (docs/MIGRATION.md).
+            "hash_format": hashk.HASH_FORMAT,
             "key_slot": self.key_slot,
             "free_slots": self.free_slots,
             "slot_gen": self.slot_gen,
@@ -1331,6 +1341,18 @@ class BatchedEnsembleService:
         svc = cls(runtime, n_ens, n_peers, n_slots, **kw)
         svc.state = ckpt.load(os.path.join(d, "engine"),
                               template=svc.state)
+        # Hash-format migration: checkpoints persist tree_leaf/
+        # tree_node verbatim, so an image written under a different
+        # fold would fail _verify_path on EVERY slot (reads of
+        # committed data returning failures cluster-wide).  Rebuild
+        # every replica tree from the restored object store before any
+        # WAL replay touches a subset of slots.  Format history:
+        # riak_ensemble_tpu/ops/hash.py HASH_FORMAT; docs/MIGRATION.md.
+        from riak_ensemble_tpu.ops import hash as hashk
+        if host.get("hash_format", 2) != hashk.HASH_FORMAT:
+            svc.state = svc.engine.rebuild_trees(
+                svc.state,
+                jnp.ones((svc.n_ens, svc.n_peers), bool))
         svc.key_slot = host["key_slot"]
         svc.free_slots = host["free_slots"]
         svc.slot_gen = host["slot_gen"]
@@ -1730,11 +1752,18 @@ class BatchedEnsembleService:
             return None
         from riak_ensemble_tpu.ops import schedule as sched_mod
         zeros = np.zeros((k, self.n_ens), np.int32)
-        return sched_mod.schedule_wide(
+        plan = sched_mod.schedule_wide(
             kind, slot, val, None,  # lease rides [E]-broadcast instead
             zeros if exp_e is None else exp_e,
             zeros if exp_s is None else exp_s,
             max_groups=2)
+        if plan is not None and os.environ.get(
+                "RETPU_VALIDATE_WIDE", "") == "1":
+            # opt-in guard for the kernel's conflict-free precondition
+            # (kv_step_scan_wide docstring): a scheduler bug here would
+            # otherwise surface as silent nondeterministic state
+            eng.validate_wide_plane(plan.kind, plan.slot)
+        return plan
 
     def _launch_inner(self, elect, cand, now, lease_ok, kind, slot,
                       val, k, want_vsn, exp_e, exp_s):

@@ -886,3 +886,59 @@ def test_periodic_scrub_cadence():
     assert svc.repairs >= 1 or svc.corruptions >= 1
     assert settle(runtime, svc.kget(0, "cold")) == ("ok", b"c")
     svc.stop()
+
+
+def test_restore_rebuilds_trees_on_hash_format_change(tmp_path):
+    """Hash-format migration (round-5 ADVICE): a checkpoint written
+    under a different device fold persists tree_leaf/tree_node that
+    mismatch the running code's hashes.  Restore must detect the
+    stamped format and rebuild every replica tree from the object
+    store — otherwise _verify_path fails on every slot and reads of
+    committed data fail cluster-wide (docs/MIGRATION.md)."""
+    import pickle
+
+    import jax.numpy as jnp
+
+    from riak_ensemble_tpu import save as savelib
+    from riak_ensemble_tpu.ops import hash as hashk
+
+    runtime, svc = make_service(n_ens=2, n_peers=3, n_slots=4)
+    for e in range(2):
+        assert settle(runtime, svc.kput(e, "k", b"v%d" % e))[0] == "ok"
+    # Simulate an image written under a different fold: scramble the
+    # device trees in place, then checkpoint them verbatim.
+    svc.state = svc.state._replace(
+        tree_leaf=svc.state.tree_leaf ^ jnp.uint32(0xDEADBEEF),
+        tree_node=svc.state.tree_node ^ jnp.uint32(0x0BADF00D))
+    svc.save(str(tmp_path / "c"))
+    svc.stop()
+
+    d = tmp_path / "c"
+    n = int(savelib.read(str(d / "CURRENT")).decode())
+    host_path = str(d / f"ckpt.{n}" / "host")
+    host = pickle.loads(savelib.read(host_path))
+    assert host["hash_format"] == hashk.HASH_FORMAT
+
+    # Control: format matches -> trees restored verbatim (scrambled),
+    # committed reads do NOT come back ok (the read either fails or
+    # retries past the budget — both prove the stale trees poison it).
+    rt_bad = Runtime(seed=11)
+    svc_bad = BatchedEnsembleService.restore(
+        rt_bad, str(d), tick=0.005, config=fast_test_config())
+    try:
+        r = settle(rt_bad, svc_bad.kget(0, "k"), timeout=1.0)
+        assert r != ("ok", b"v0"), r
+    except TimeoutError:
+        pass
+    svc_bad.stop()
+
+    # Stamp the old format: restore must rebuild and serve.
+    host["hash_format"] = 2
+    savelib.write(host_path, pickle.dumps(host, protocol=4))
+    rt2 = Runtime(seed=12)
+    svc2 = BatchedEnsembleService.restore(
+        rt2, str(d), tick=0.005, config=fast_test_config())
+    for e in range(2):
+        assert settle(rt2, svc2.kget(e, "k")) == ("ok", b"v%d" % e)
+    assert settle(rt2, svc2.kput(0, "k", b"post"))[0] == "ok"
+    assert settle(rt2, svc2.kget(0, "k")) == ("ok", b"post")

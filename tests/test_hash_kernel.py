@@ -206,3 +206,56 @@ def test_fold_collision_smoke():
     outs = np.asarray(hashk.fold(jnp.asarray(blocks)))
     view = {tuple(int(v) for v in row) for row in outs}
     assert len(view) == 10_000
+
+
+def test_fold_compensated_swap_no_collision():
+    """Regression (round-5 ADVICE): format 2's fold pre-mixed children
+    LINEARLY (child*C1 + pos*C2 + lane), so replacing children (a, b)
+    at positions (p, q) with (b+d, a-d), d = (q-p)*C2*C1^-1 mod 2^32,
+    preserved the pre-mix multiset and collided deterministically.
+    Format 3 xors an avalanched position salt and multiplies by a
+    per-position odd constant, so neither additive nor xor shifts can
+    compensate a swap."""
+    # C1^-1 mod 2^32 (C1 is odd, hence invertible)
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    c1_inv = pow(c1, -1, 2**32)
+    rng = np.random.default_rng(7)
+    for trial in range(100):
+        children = np.asarray(
+            rng.integers(0, 2**32, (16, hashk.LANES)), dtype=np.uint32)
+        base = np.asarray(hashk.fold(jnp.asarray(children)))
+        p, q = sorted(rng.choice(16, size=2, replace=False))
+        d = np.uint32((int(q - p) * c2 * c1_inv) % 2**32)
+        # the exact format-2 attack: additive-compensated swap
+        add = children.copy()
+        add[p] = children[q] + d
+        add[q] = children[p] - d
+        assert (np.asarray(hashk.fold(jnp.asarray(add))) != base).any(), \
+            f"additive compensated swap collided (trial {trial})"
+        # the analogous xor-compensated swap (defeats a salt-only fix)
+        for delta in (np.uint32(d), np.uint32(trial + 1)):
+            xr = children.copy()
+            xr[p] = children[q] ^ delta
+            xr[q] = children[p] ^ delta
+            assert (np.asarray(hashk.fold(jnp.asarray(xr)))
+                    != base).any(), \
+                f"xor compensated swap collided (trial {trial})"
+
+
+def test_fold_plain_swap_with_shift_sweep():
+    """Broader structured-collision sweep: swapping two children and
+    shifting both by ANY small constant (add or xor, d in 1..64) never
+    collides — simple arithmetic relationships between siblings must
+    not cancel the position salts."""
+    rng = np.random.default_rng(8)
+    children = np.asarray(
+        rng.integers(0, 2**32, (16, hashk.LANES)), dtype=np.uint32)
+    base = np.asarray(hashk.fold(jnp.asarray(children)))
+    for d in range(1, 65):
+        du = np.uint32(d)
+        add = children.copy()
+        add[0], add[1] = children[1] + du, children[0] - du
+        assert (np.asarray(hashk.fold(jnp.asarray(add))) != base).any()
+        xr = children.copy()
+        xr[0], xr[1] = children[1] ^ du, children[0] ^ du
+        assert (np.asarray(hashk.fold(jnp.asarray(xr))) != base).any()

@@ -134,3 +134,57 @@ def test_engine_flag_gated_pallas_equivalence():
 
     for a, b in zip(_jax.tree.leaves(base), _jax.tree.leaves(flagged)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_quorum_met_wide_pallas_3dim_view_mask():
+    """Regression (round-5 ADVICE): the wide Pallas branch of
+    engine._quorum_met must accept a 3-dim [E, V, Ml] view_mask with
+    W > 1 — broadcasting it per lane — not just a caller-pre-widened
+    4-dim mask."""
+    import jax as _jax
+
+    from riak_ensemble_tpu.ops import engine as eng
+
+    rng = np.random.default_rng(5)
+    e, w, m, v = 9, 3, 5, 2
+    ack = jnp.asarray(rng.random((e, w, m)) < 0.6)
+    heard = jnp.asarray(np.ones((e, w, m), bool))
+    mask = rng.random((e, v, m)) < 0.7
+    mask[:, 0, :] |= ~mask[:, 0, :].any(-1, keepdims=True)
+    mask3 = jnp.asarray(mask)
+    mask4 = jnp.broadcast_to(mask3[:, None], (e, w, v, m))
+
+    try:
+        eng.PALLAS_QUORUM = True
+        _jax.clear_caches()
+        got3 = np.asarray(eng._quorum_met(ack, heard, mask3, None))
+        got4 = np.asarray(eng._quorum_met(ack, heard, mask4, None))
+        eng.PALLAS_QUORUM = False
+        _jax.clear_caches()
+        ref = np.asarray(eng._quorum_met(ack, heard, mask4, None))
+    finally:
+        eng.PALLAS_QUORUM = False
+        _jax.clear_caches()
+    np.testing.assert_array_equal(got3, ref)
+    np.testing.assert_array_equal(got4, ref)
+
+
+def test_validate_wide_plane():
+    """The host-side guard for the wide kernel's conflict-free
+    precondition: distinct valid slots pass; a duplicate valid slot in
+    one [g, e] row raises; duplicates masked by OP_NOOP are fine."""
+    from riak_ensemble_tpu.ops import engine as eng
+
+    g, e, w = 2, 3, 4
+    kind = np.full((g, e, w), eng.OP_PUT, np.int32)
+    slot = np.tile(np.arange(w, dtype=np.int32), (g, e, 1))
+    eng.validate_wide_plane(kind, slot)  # distinct: ok
+
+    bad = slot.copy()
+    bad[1, 2, 3] = bad[1, 2, 0]  # duplicate valid slot
+    with pytest.raises(ValueError, match="ensemble 2"):
+        eng.validate_wide_plane(kind, bad)
+
+    kind2 = kind.copy()
+    kind2[1, 2, 3] = eng.OP_NOOP  # same dup but invalid lane: ok
+    eng.validate_wide_plane(kind2, bad)
