@@ -318,6 +318,13 @@ def run_repgroup(seconds: float, smoke: bool) -> dict:
                 sys.path.insert(0, {repo!r})
                 import jax
                 jax.config.update("jax_platforms", "cpu")
+                # replica warmup compiles the same pow2 ladder as the
+                # leader: share the persistent compile cache or each
+                # child pays minutes of XLA compile on a 1-core box
+                jax.config.update("jax_compilation_cache_dir",
+                                  {repo!r} + "/.jax_cache")
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 1.0)
                 from riak_ensemble_tpu.parallel import repgroup
                 repgroup.main(["--n-ens", "{n_ens}", "--group-size",
                                "3", "--n-slots", "{n_slots}",
@@ -368,14 +375,36 @@ def run_repgroup(seconds: float, smoke: bool) -> dict:
 
         one_round()  # warm (slots, remote compile, sync settled)
         svc.ack_timeout = 10.0
+
+        # Pipelined measured loop (VERDICT r4 weak #5): keep up to 4
+        # rounds in flight so flush N+1's build/ship/local-launch
+        # overlaps flush N's replica acks (the windowed PeerLink +
+        # deferred commit barrier).  Latency is client-observed:
+        # submit -> every future of the round resolved.
+        def submit():
+            futs = []
+            for e in range(n_ens):
+                futs.append(svc.kput_many(e, keys[:k // 2], vals))
+                futs.append(svc.kget_many(e, keys[k // 2:]))
+            return futs
+
         lat = []
         ops = 0
+        inflight = []
         t_end = time.perf_counter() + max(seconds, 1e-3)
         t0 = time.perf_counter()
-        while time.perf_counter() < t_end or not lat:
-            tb = time.perf_counter()
-            ops += one_round()
-            lat.append(time.perf_counter() - tb)
+        while True:
+            now = time.perf_counter()
+            if now < t_end and len(inflight) < 4:
+                inflight.append((now, submit()))
+            svc.flush()
+            while inflight and all(f.done for f in inflight[0][1]):
+                tb, _futs = inflight.pop(0)
+                lat.append(time.perf_counter() - tb)
+                ops += n_ens * k
+            if now >= t_end and (not inflight and lat):
+                break
+            assert now < t_end + 120.0, "repgroup bench wedged"
         elapsed = time.perf_counter() - t0
         g = svc.stats()["group"]
         assert g["quorum_failures"] == 0, g

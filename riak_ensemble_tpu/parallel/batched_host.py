@@ -42,8 +42,7 @@ from riak_ensemble_tpu.types import NOTFOUND
 
 
 
-@functools.partial(jax.jit, static_argnames=("want_vsn",))
-def _pack_results(won, res: eng.KvResult, want_vsn: bool):
+def _pack_results_body(won, res: eng.KvResult, want_vsn: bool):
     """Flatten a launch's results into ONE uint8 vector on device.
 
     The host needs ~7 result arrays per launch; fetching them
@@ -73,6 +72,46 @@ def _pack_results(won, res: eng.KvResult, want_vsn: bool):
     ints_u8 = jax.lax.bitcast_convert_type(
         jnp.concatenate(ints), jnp.uint8).ravel()
     return jnp.concatenate([jnp.packbits(flags), ints_u8])
+
+
+_pack_results = jax.jit(_pack_results_body,
+                        static_argnames=("want_vsn",))
+
+
+@functools.partial(jax.jit, static_argnames=("want_vsn", "sharding"))
+def _pack_results_gathered(won, res: eng.KvResult, want_vsn: bool,
+                           sharding):
+    """Mesh-aware pack: a sharded step's result planes leave the
+    kernel with MIXED shardings ('ens'-sharded [K, E] planes with E
+    minor, peer-sharded corrupt masks, replicated scalars).  Raveling
+    and concatenating those directly leaves GSPMD no expressible
+    output sharding, so it falls back to involuntary full
+    rematerialization (replicate-then-repartition) per operand — the
+    exact ``spmd_partitioner`` warnings MULTICHIP_r04 recorded, and a
+    real ICI/HBM tax on the per-flush d2h critical path at scale.  The
+    packed vector is fetched to the host anyway, so gather explicitly:
+    one ``with_sharding_constraint`` to fully-replicated per input
+    turns the implicit remats into ordinary all-gathers riding ICI,
+    and the pack itself runs replicated (no further resharding).
+    ``sharding`` is the mesh's fully-replicated NamedSharding
+    (static: hashable and compile-time constant).
+    """
+    def con(x):
+        return jax.lax.with_sharding_constraint(x, sharding)
+
+    return _pack_results_body(con(won), jax.tree.map(con, res),
+                              want_vsn)
+
+
+def _select_packer(engine):
+    """The pack program matching the engine's placement: plain jit for
+    single-device engines, the gathered form for mesh-sharded ones."""
+    mesh = getattr(engine, "mesh", None)
+    if mesh is None:
+        return _pack_results
+    from jax.sharding import NamedSharding, PartitionSpec
+    rep = NamedSharding(mesh, PartitionSpec())
+    return functools.partial(_pack_results_gathered, sharding=rep)
 
 
 def _wide_to_packed_layout(res: eng.KvResult, g: int, w: int,
@@ -147,6 +186,7 @@ def warmup_kernels(svc: "BatchedEnsembleService") -> None:
     import jax.numpy as jnp
 
     e, m, s = svc.n_ens, svc.n_peers, svc.n_slots
+    pack = _select_packer(svc.engine)
     st = svc.engine.init_state(e, m, s)
     elect = jnp.zeros((e,), bool)
     cand = jnp.zeros((e,), jnp.int32)
@@ -158,7 +198,7 @@ def warmup_kernels(svc: "BatchedEnsembleService") -> None:
         _, won, res = svc.engine.full_step(
             st, elect, cand, kind, kind, kind, lease, up,
             exp_epoch=kind, exp_seq=kind)
-        np.asarray(_pack_results(won, res, True))
+        np.asarray(pack(won, res, True))
         if k >= svc.max_k:
             break
         k = 1 if k == 0 else k * 2
@@ -175,7 +215,7 @@ def warmup_kernels(svc: "BatchedEnsembleService") -> None:
                 _, won, res = svc.engine.full_step_wide(
                     st, elect, cand, kind, kind, kind, lease, up,
                     exp_epoch=kind, exp_seq=kind)
-                np.asarray(_pack_results(
+                np.asarray(pack(
                     won, _wide_to_packed_layout(res, g, w, e), True))
                 w *= 2
 
@@ -336,6 +376,9 @@ class BatchedEnsembleService:
         self.tick = tick
         self.max_k = max_ops_per_tick
         self.engine = engine if engine is not None else _LocalEngine()
+        #: result packer matched to the engine's placement (mesh
+        #: engines gather explicitly — see _pack_results_gathered)
+        self._pack = _select_packer(self.engine)
         self.state = self.engine.init_state(n_ens, n_peers, n_slots)
         #: host failure detector input (set_peer_up)
         self.up = np.ones((n_ens, n_peers), dtype=bool)
@@ -1821,7 +1864,7 @@ class BatchedEnsembleService:
         # fetch is a full round trip over a tunneled device link, and
         # link bandwidth bounds service throughput — see _pack_results).
         e, m = self.n_ens, self.n_peers
-        flat = np.asarray(_pack_results(won, res, want_vsn))
+        flat = np.asarray(self._pack(won, res, want_vsn))
         t3 = time.perf_counter()
         # Latency breakdown marks (finished by flush(), which adds the
         # queue-wait and resolve components): h2d = input build +
