@@ -971,6 +971,146 @@ class BatchedEnsembleService:
         self._recycle_on_ok(fut, ens, key, slot)
         return fut
 
+    # -- version-preserving bulk install (tenant handoff) ------------------
+
+    def install_objs(self, ens: int,
+                     items: List[Tuple[Any, Tuple[int, int], Any]]
+                     ) -> List[Any]:
+        """Install keyed objects WITH their versions — the
+        version-continuity half of a placement move (the reference's
+        membership changes move consensus while objects keep their
+        {epoch, seq}: a client's CAS token survives member replacement,
+        replace_members_test.erl:26-30, doc/Readme.md:156-167).  A
+        re-ingest through kput would mint fresh versions and void
+        every outstanding CAS token.
+
+        ``items``: ``[(key, (epoch, seq), payload), ...]``.  Applied
+        synchronously: slots/handles allocate host-side, the objects
+        scatter into EVERY replica lane of the row (they are committed
+        data from the previous owner), the row's trees rebuild, and
+        the row's ballot epoch rises to the max installed epoch with
+        the leader cleared — the next election runs at a strictly
+        higher epoch, so post-move writes always version-dominate the
+        installed objects.  Committed to the WAL with the real
+        versions.  Returns per-item ``("ok", (epoch, seq))`` |
+        ``"failed"`` (no slot).
+        """
+        results, applied = self._allocate_install(ens, items)
+        if applied:
+            self._apply_installed(ens, applied,
+                                  self._install_lead(ens))
+        return results
+
+    def _install_lead(self, ens: int) -> int:
+        """The install's leadership decision, made ONCE (on a
+        replication-group leader it ships with the frame — deciding
+        per lane from local host mirrors would diverge the lanes):
+        a leaderless row gets the first view member declared at the
+        installed epoch (no election bump → no stale-read re-version
+        → CAS tokens survive); a live row keeps its leader (-1) —
+        the late-merge path must not stomp a serving leader."""
+        return (int(np.argmax(self.member_np[ens]))
+                if int(self.leader_np[ens]) < 0 else -1)
+
+    def _allocate_install(self, ens: int, items):
+        """Host-side half: slots + handles for the installable items
+        (separated so a replication-group leader can ship the exact
+        allocation to its replicas — independent allocation could
+        diverge free-list orders across lanes)."""
+        results: List[Any] = []
+        applied: List[Tuple] = []
+        for key, (ve, vs), payload in items:
+            slot = self._slot_for(ens, key, allocate=True)
+            if slot is None:
+                results.append("failed")
+                continue
+            handle = self._alloc_handle()
+            self.values[handle] = payload
+            applied.append((key, int(slot), int(handle), int(ve),
+                            int(vs), payload))
+            results.append(("ok", (int(ve), int(vs))))
+        return results, applied
+
+    def _apply_installed(self, ens: int, applied: List[Tuple],
+                         lead: int = -1,
+                         extra_records: Optional[List[Tuple]] = None
+                         ) -> None:
+        """Device + mirror + WAL application of an allocation from
+        :meth:`_allocate_install` (verbatim on replicas).  ``lead``
+        is the leader's :meth:`_install_lead` decision (-1 = keep);
+        ``extra_records`` overrides :meth:`_wal_extra_records` in the
+        install's durability barrier — a replication-group replica
+        passes its REAL (promised, ge, seq, cfg) (its inherited
+        leader-side fields would write regressed group meta)."""
+        jnp = self._jnp
+        ens = int(ens)
+        slots = np.asarray([a[1] for a in applied], np.int32)
+        eps = np.asarray([a[3] for a in applied], np.int32)
+        sqs = np.asarray([a[4] for a in applied], np.int32)
+        hds = np.asarray([a[2] for a in applied], np.int32)
+        st = self.state
+        s_j = jnp.asarray(slots)
+        st = st._replace(
+            obj_epoch=st.obj_epoch.at[ens, :, s_j].set(
+                jnp.asarray(eps)),
+            obj_seq=st.obj_seq.at[ens, :, s_j].set(jnp.asarray(sqs)),
+            obj_val=st.obj_val.at[ens, :, s_j].set(jnp.asarray(hds)))
+        # Version continuity requires NO epoch change on first touch:
+        # a read at a ballot epoch above the objects' epochs triggers
+        # the stale-epoch rewrite (update_key), re-versioning every
+        # object and voiding the tokens this install exists to
+        # preserve.  So: raise the row's ballot epoch to the max
+        # installed epoch and DECLARE leadership at that epoch (the
+        # row is brand-new to this service — no straggler writer at
+        # that epoch can exist here, the old owner's row was destroyed
+        # before the offer), and raise the per-row seq counter past
+        # the installed seqs so same-epoch writes version-dominate.
+        # If the recycled row's epoch already EXCEEDS the installed
+        # max (its previous tenant's straggler fence), the fence wins:
+        # first reads re-version, exactly like the reference after an
+        # election (update_key, peer.erl:1564-1596).
+        row_max = jnp.asarray(int(eps.max()) if len(eps) else 0,
+                              jnp.int32)
+        st = st._replace(
+            epoch=st.epoch.at[ens, :].set(
+                jnp.maximum(st.epoch[ens], row_max)),
+            obj_seq_ctr=st.obj_seq_ctr.at[ens].set(
+                jnp.maximum(st.obj_seq_ctr[ens],
+                            jnp.asarray(int(sqs.max())
+                                        if len(sqs) else 0,
+                                        jnp.int32))))
+        if lead >= 0:
+            st = st._replace(leader=st.leader.at[ens].set(lead))
+        mask = np.zeros((self.n_ens, self.n_peers), bool)
+        mask[ens] = True
+        self.state = self.engine.rebuild_trees(st, jnp.asarray(mask))
+        for key, slot, handle, _ve, _vs, payload in applied:
+            old = self.slot_handle[ens].pop(slot, 0)
+            if old and old != handle:
+                # values-only drop, NEVER the handle pool: the handle
+                # numbers are the allocating leader's; pooling them on
+                # a replica would let a later promotion re-allocate a
+                # number the leader still has live (cross-key payload
+                # corruption).  The handle number leaks; numbers are
+                # 31-bit and installs are rare.
+                self.values.pop(old, None)
+            self.values[handle] = payload
+            self.slot_handle[ens][slot] = handle
+            if handle >= self._next_handle:
+                self._next_handle = handle + 1
+            self.key_slot[ens][key] = slot
+        if lead >= 0:
+            self.leader_np[ens] = lead
+            self.lease_until[ens] = 0.0
+        self._up_dev = None
+        if self._wal is not None:
+            recs = [(("kv", ens, slot),
+                     (key, handle, ve, vs, payload, False))
+                    for key, slot, handle, ve, vs, payload in applied]
+            self._wal.log(recs + (extra_records
+                                  if extra_records is not None
+                                  else self._wal_extra_records()))
+
     def kmodify(self, ens: int, key: Any, mod_fun: Any, default: Any,
                 retries: int = 8) -> Future:
         """Server-side modify — the batched analog of the put FSM's

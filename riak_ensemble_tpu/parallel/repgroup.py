@@ -669,6 +669,41 @@ class ReplicaCore:
         return ("state", self.applied_ge, self.applied_seq,
                 dump_state(self.svc), self.cfg)
 
+    def handle_inst(self, frame: Tuple) -> Tuple:
+        """Replicated version-preserving install (tenant handoff on a
+        repgroup owner): the LEADER's exact allocation
+        (key, slot, handle, epoch, seq, payload) and leadership
+        decision apply verbatim on this lane — independent
+        allocation/leader choice could diverge the lanes.  Same
+        (epoch, seq) stream discipline as data applies; the install's
+        kv records and the advanced group meta land in ONE durability
+        barrier (a crash between them must never advertise a
+        regressed position over installed data)."""
+        _, ge, seq, ens, lead, applied = frame
+        if ge != self.promised or ge < self.applied_ge:
+            return ("nack", "epoch", self.promised, self.applied_ge,
+                    self.applied_seq)
+        if seq == self.applied_seq and ge == self.applied_ge:
+            return ("applied", ge, seq, self.last_crc)
+        if seq != self.applied_seq + 1:
+            return ("nack", "seq", self.promised, self.applied_ge,
+                    self.applied_seq)
+        applied = [tuple(a) for a in applied]
+        crc = zlib.crc32(repr([(a[1], a[2], a[3], a[4])
+                               for a in applied]).encode())
+        self.applied_ge, self.applied_seq = int(ge), int(seq)
+        self.last_crc = crc
+        BatchedEnsembleService._apply_installed(
+            self.svc, int(ens), applied, int(lead),
+            extra_records=[(_GRP_KEY, (self.promised, int(ge),
+                                       int(seq), self.cfg))])
+        if self.svc._wal is not None \
+                and self.svc._wal.count >= self.svc.wal_compact_records:
+            rebuild_derived(self.svc)
+            self.svc.save()
+            save_group_meta(self.svc, self.promised, ge, seq, self.cfg)
+        return ("applied", ge, seq, crc)
+
     # -- incremental (Merkle) catch-up ----------------------------------
 
     def handle_troots(self) -> Tuple:
@@ -882,15 +917,23 @@ class PeerLink:
             frame, ticket = item
             try:
                 self._ensure_connected()
+                # LOCAL capture: a concurrent receiver-side _drop sets
+                # self._sock to None, and an AttributeError escaping
+                # this try would kill the sender thread — a silently
+                # dead link that never sends, fails, or resyncs again
+                sock = self._sock
+                if sock is None:
+                    raise ConnectionError("dropped mid-send")
                 # append BEFORE send: the response cannot precede the
                 # send, so the receiver always finds the ticket queued
                 with self._alock:
                     self._awaiting.append(ticket)
                 if isinstance(frame, _Encoded):
-                    self._sock.sendall(frame.payload)
+                    sock.sendall(frame.payload)
                 else:
-                    send_frame(self._sock, frame)
-            except (OSError, ConnectionError, wire.WireError):
+                    send_frame(sock, frame)
+            except (OSError, ConnectionError, wire.WireError,
+                    AttributeError):
                 # the ticket may or may not have joined _awaiting;
                 # _drop fails everything outstanding either way
                 self._drop(fail_also=ticket)
@@ -1301,6 +1344,55 @@ class ReplicatedService(BatchedEnsembleService):
                 "joint": None if joint is None else list(joint),
                 "transition": self._cfg_txn is not None}
 
+    def _replicate_record(self, frame: Tuple, crc: int) -> set:
+        """Ship ONE synchronous replicated record (lifecycle, config,
+        version-preserving install) and collect its acks: settle the
+        pipeline, consume finished catch-up tickets, queue a snapshot
+        ahead for any stale link (the write path's preamble
+        discipline), post to the synced links, and return the acked
+        address set — the caller judges the quorum and advances its
+        own local state.  Shared by the three admin record kinds so
+        the depose/needs-sync handling cannot drift between them."""
+        enc = _Encoded(frame)
+        snapshot = None
+        for link in self._links:
+            inst_t = link.install_ticket
+            if inst_t is not None and inst_t.event.is_set():
+                r = inst_t.result
+                link.install_ticket = None
+                if r is not None and r[0] == "installed":
+                    link.needs_sync = False
+                    link.tried_tree = False
+                elif r is not None and r[0] == "nack" \
+                        and int(r[2]) > self._ge:
+                    self._note_depose(int(r[2]))
+            if link.needs_sync and link.connected \
+                    and link.install_ticket is None \
+                    and link.sync is None:
+                if snapshot is None:
+                    snapshot = _Encoded(
+                        ("install", self._ge, self._grp_seq,
+                         dump_state(self), self.core.cfg))
+                link.install_ticket = link.post(snapshot)
+                self.group_stats["resyncs"] += 1
+        sends = [(l, l.post(enc)) for l in self._links
+                 if not l.needs_sync]
+        acked = set()
+        deadline = time.monotonic() + self.ack_timeout
+        for link, t in sends:
+            r = PeerLink.wait(t, deadline)
+            if r is not None and r[0] == "applied" \
+                    and int(r[3]) == crc:
+                acked.add((link.host, link.port))
+            elif r is not None and r[0] == "nack" \
+                    and r[1] == "epoch" and int(r[2]) > self._ge:
+                self._note_depose(int(r[2]))
+                link.needs_sync = True
+            else:
+                link.needs_sync = True
+        self.group_stats["applies"] += 1
+        return acked
+
     def _commit_cfg(self, cver: int, hosts, joint) -> bool:
         """Ship one config record through the apply stream and collect
         its acks synchronously (config changes are rare admin ops).
@@ -1312,9 +1404,6 @@ class ReplicatedService(BatchedEnsembleService):
         seq = self._grp_seq + 1
         hosts_t = _norm_addrs(hosts)
         joint_t = _norm_addrs(joint)
-        frame = ("cfg", self._ge, seq, cver, hosts_t, joint_t)
-        sends = [(l, l.post(frame)) for l in self._links
-                 if not l.needs_sync]
         self._grp_seq = seq
         self.core.applied_ge = self._ge
         self.core.applied_seq = seq
@@ -1322,21 +1411,9 @@ class ReplicatedService(BatchedEnsembleService):
         self.core.set_cfg((int(cver), hosts_t, joint_t))
         save_group_meta(self, self.core.promised, self._ge, seq,
                         self.core.cfg)
-        acked = set()
-        deadline = time.monotonic() + self.ack_timeout
-        for link, t in sends:
-            r = PeerLink.wait(t, deadline)
-            if r is not None and r[0] == "applied" \
-                    and int(r[3]) == cver and not link.needs_sync:
-                acked.add((link.host, link.port))
-            elif r is not None and r[0] == "nack" and r[1] == "epoch" \
-                    and int(r[2]) > self._ge:
-                self._note_depose(int(r[2]))
-                link.needs_sync = True
-            else:
-                link.needs_sync = True
+        acked = self._replicate_record(
+            ("cfg", self._ge, seq, cver, hosts_t, joint_t), int(cver))
         ok = self._quorum_from(acked) and not self._deposed
-        self.group_stats["applies"] += 1
         if not ok:
             self.group_stats["quorum_failures"] += 1
         self._emit("grp_cfg", {"cver": cver, "committed": ok,
@@ -1824,29 +1901,6 @@ class ReplicatedService(BatchedEnsembleService):
         seq = self._grp_seq + 1
         view_b = None if view is None else _pack_bool(
             np.asarray(view, bool))
-        frame = ("lcl", self._ge, seq, kind, name, view_b)
-        # syncing links get the (non-blocking) snapshot queued ahead,
-        # exactly like the write path — otherwise an idle group's
-        # lifecycle ops would exclude a stale link forever (review r4)
-        snapshot = None
-        for link in self._links:
-            inst_t = link.install_ticket
-            if inst_t is not None and inst_t.event.is_set():
-                r = inst_t.result
-                link.install_ticket = None
-                if r is not None and r[0] == "installed":
-                    link.needs_sync = False
-            if link.needs_sync and link.connected \
-                    and link.install_ticket is None \
-                    and link.sync is None:
-                if snapshot is None:
-                    snapshot = _Encoded(
-                        ("install", self._ge, self._grp_seq,
-                         dump_state(self), self.core.cfg))
-                link.install_ticket = link.post(snapshot)
-                self.group_stats["resyncs"] += 1
-        sends = [(l, l.post(frame)) for l in self._links
-                 if not l.needs_sync]
         if kind == "create":
             row = super().create_ensemble(name, view)
             ok = None
@@ -1862,26 +1916,51 @@ class ReplicatedService(BatchedEnsembleService):
         if self._wal is not None:
             save_group_meta(self, self.core.promised, self._ge, seq,
                             self.core.cfg)
-        acked = set()
-        deadline = time.monotonic() + self.ack_timeout
-        for link, t in sends:
-            r = PeerLink.wait(t, deadline)
-            if r is not None and r[0] == "applied" \
-                    and int(r[3]) == crc:
-                acked.add((link.host, link.port))
-            elif r is not None and r[0] == "nack" and r[1] == "epoch" \
-                    and int(r[2]) > self._ge:
-                self._note_depose(int(r[2]))
-                link.needs_sync = True
-            else:
-                link.needs_sync = True
-        self.group_stats["applies"] += 1
+        acked = self._replicate_record(
+            ("lcl", self._ge, seq, kind, name, view_b), crc)
         if not self._quorum_from(acked) or self._deposed:
             self.group_stats["quorum_failures"] += 1
             raise RuntimeError(
                 f"lifecycle {kind} {name!r}: no host quorum "
                 f"({1 + len(acked)}/{self.group_size})")
         return row, ok
+
+    def install_objs(self, ens, items):
+        """Version-preserving install with the host-quorum barrier:
+        the leader allocates (slots/handles) and decides leadership,
+        ships the EXACT allocation through the (epoch, seq) stream
+        (handle_inst applies it verbatim — independent allocation
+        could diverge free-list orders across lanes), and raises on
+        lost quorum like the lifecycle ops."""
+        if not self._links and self.group_size == 1:
+            return super().install_objs(ens, items)
+        if not self.is_leader:
+            raise DeposedError("not the group leader")
+        self._drain_pending(block_all=True)
+        results, applied = self._allocate_install(int(ens), items)
+        if not applied:
+            return results
+        lead = self._install_lead(int(ens))
+        crc = zlib.crc32(repr([(a[1], a[2], a[3], a[4])
+                               for a in applied]).encode())
+        seq = self._grp_seq + 1
+        self._grp_seq = seq
+        self.core.applied_ge = self._ge
+        self.core.applied_seq = seq
+        self.core.last_crc = crc
+        self._apply_installed(
+            int(ens), applied, lead,
+            extra_records=[(_GRP_KEY, (self.core.promised, self._ge,
+                                       seq, self.core.cfg))])
+        acked = self._replicate_record(
+            ("inst", self._ge, seq, int(ens), lead,
+             [list(a) for a in applied]), crc)
+        if not self._quorum_from(acked) or self._deposed:
+            self.group_stats["quorum_failures"] += 1
+            raise RuntimeError(
+                f"install_objs ens {ens}: no host quorum "
+                f"({1 + len(acked)}/{self.group_size})")
+        return results
 
     def stats(self) -> Dict[str, Any]:
         s = super().stats()
@@ -1928,7 +2007,8 @@ class ReplicaServer:
                  ack_timeout: float = 2.0,
                  peers: Sequence[Tuple[str, int]] = (),
                  auto_failover: Optional[float] = None,
-                 dynamic: bool = False) -> None:
+                 dynamic: bool = False,
+                 advertise: Optional[Tuple[str, int]] = None) -> None:
         runtime = WallRuntime()
         if data_dir is not None and (
                 os.path.exists(os.path.join(data_dir, "META"))
@@ -1956,9 +2036,15 @@ class ReplicaServer:
         self.repl_port = self._repl_srv.port
         self.client_port = self._client_srv.port
         #: this host's identity in group configs = the address peers
-        #: dial (bind host + bound repl port); used for quorum
-        #: counting and membership checks
-        self.svc.self_addr = (str(host), int(self.repl_port))
+        #: DIAL it by.  Defaults to (bind host, bound repl port) —
+        #: correct when every host binds the address others use; a
+        #: wildcard/NAT'd bind must pass ``advertise`` (CLI:
+        #: --advertise HOST:PORT) or membership comparisons would
+        #: treat this node as a non-member of its own group
+        self.svc.self_addr = (
+            (str(advertise[0]), int(advertise[1]))
+            if advertise is not None
+            else (str(host), int(self.repl_port)))
         #: member flag: a host a collapse removed must not campaign
         #: (the Raft removed-server disruption rule); manual promote
         #: still works
@@ -2036,7 +2122,7 @@ class ReplicaServer:
     def _handle_repl(self, frame: Tuple) -> Tuple:
         op = frame[0]
         if op in ("hello", "apply", "install", "lcl", "cfg",
-                  "tpatch"):
+                  "tpatch", "inst"):
             # leader-originated traffic: the failover monitor's
             # liveness signal
             self._last_leader_contact = time.monotonic()
@@ -2085,6 +2171,14 @@ class ReplicaServer:
                     int(frame[1]) > self.core.promised:
                 self._step_down()
             return self.core.handle_cfg(frame)
+        if op == "inst":
+            if self._campaign:
+                return ("nack", "busy", self.core.promised,
+                        self.core.applied_ge, self.core.applied_seq)
+            if self.svc.is_leader and \
+                    int(frame[1]) > self.core.promised:
+                self._step_down()
+            return self.core.handle_inst(frame)
         if op == "install":
             if self._campaign:
                 return ("nack", "busy", self.core.promised,
@@ -2629,6 +2723,11 @@ def main(argv=None) -> int:
     ap.add_argument("--dynamic", action="store_true",
                     help="dynamic tenant lifecycle (replicated "
                          "create/destroy over the group)")
+    ap.add_argument("--advertise", default=None, metavar="HOST:PORT",
+                    help="this host's identity in group-config member "
+                         "lists (defaults to bind host + repl port; "
+                         "required when binding wildcard/NAT'd "
+                         "addresses)")
     ap.add_argument("--auto-failover", type=float, default=None,
                     metavar="SECONDS",
                     help="self-promote when no leader traffic for "
@@ -2642,13 +2741,17 @@ def main(argv=None) -> int:
     for spec in args.peer:
         h, p = spec.rsplit(":", 1)
         peers.append((h, int(p)))
+    adv = None
+    if args.advertise:
+        h, p = args.advertise.rsplit(":", 1)
+        adv = (h, int(p))
     srv = ReplicaServer(
         args.n_ens, args.group_size, args.n_slots,
         repl_port=args.repl_port, client_port=args.client_port,
         host=args.host, data_dir=args.data_dir,
         config=fast_test_config() if args.fast else None,
         peers=peers, auto_failover=args.auto_failover,
-        dynamic=args.dynamic)
+        dynamic=args.dynamic, advertise=adv)
     print(f"repgroup replica repl={srv.repl_port} "
           f"client={srv.client_port}", flush=True)
     try:

@@ -32,10 +32,12 @@ on one process.  This module finishes the story:
   row IN THE SAME TICK (no flush in between: late writes fail fast —
   clients retry against the directory rather than writing into a
   dropped copy), then offers the export to the new owner, which
-  imports before serving.  Payload values survive the move; object
-  versions restart on the new owner (a move is a logical re-ingest —
-  CAS tokens must be re-read, which the reference's clients already
-  tolerate across peer restarts).
+  imports before serving.  Objects move WITH their {epoch, seq}
+  versions (a version-preserving install, not a re-ingest), so a
+  client's CAS token survives the placement move — the reference's
+  membership-change semantics (replace_members_test.erl:26-30,
+  doc/Readme.md:156-167); the importing row's ballot epoch rises past
+  the installed maximum, so post-move writes version-dominate.
 
 v1 boundaries (documented, not hidden): a tenant is placed on ONE
 svcnode (the repgroup is the cross-host availability story; compose
@@ -307,8 +309,8 @@ class ServiceReconciler:
         # strictly older, so export entries win
         stale = self._inbox.pop(name, None)
         if stale:
-            have = {k for k, _ in data}
-            data += [(k, v) for k, v in stale if k not in have]
+            have = {e[0] for e in data}
+            data += [e for e in stale if e[0] not in have]
         svc.destroy_ensemble(name)
         self._want_since.pop(name, None)
         self._import_attempts.pop(name, None)
@@ -322,16 +324,28 @@ class ServiceReconciler:
         # floor as the reference when a node dies holding unhanded
         # data (durability story: compose owners from repgroups)
 
-    def _export(self, ens: int) -> List[Tuple[Any, Any]]:
-        """Snapshot a tenant's keyed data from the host mirrors —
-        synchronous (no flush), which is what makes export+destroy
-        atomic within one tick."""
+    def _export(self, ens: int) -> List[Tuple[Any, Any, Tuple]]:
+        """Snapshot a tenant's keyed data — WITH versions — from the
+        host mirrors + one device gather; synchronous (no flush),
+        which is what makes export+destroy atomic within one tick.
+        Entries are (key, payload, (epoch, seq)); versions read from
+        the leader's lane (or lane 0 with no leader), the committed
+        copy the reference's trees would sync metadata for."""
         svc = self.svc
+        items = [(key, slot) for key, slot in svc.key_slot[ens].items()
+                 if svc.slot_handle[ens].get(slot, 0)]
+        if not items:
+            return []
+        lane = int(svc.leader_np[ens])
+        if lane < 0:
+            lane = 0
+        slots = np.asarray([s for _k, s in items], np.int32)
+        eps = np.asarray(svc.state.obj_epoch[ens, lane])[slots]
+        sqs = np.asarray(svc.state.obj_seq[ens, lane])[slots]
         out = []
-        for key, slot in svc.key_slot[ens].items():
-            h = svc.slot_handle[ens].get(slot, 0)
-            if h:
-                out.append((key, svc.values[h]))
+        for (key, slot), ve, vs in zip(items, eps, sqs):
+            h = svc.slot_handle[ens][slot]
+            out.append((key, svc.values[h], (int(ve), int(vs))))
         return out
 
     def _bad_view(self, name: Any, view) -> bool:
@@ -368,25 +382,40 @@ class ServiceReconciler:
         self.svc._emit("svc_tenant_adopt",
                        {"name": name, "imported": len(data or ())})
 
-    def _import(self, name: Any, data: List[Tuple[Any, Any]],
+    def _import(self, name: Any, data: List[Tuple],
                 create_only: bool = False) -> None:
-        """Start an import batch for an adopted tenant.  With
-        ``create_only`` (late handoffs merging into a live tenant)
-        each key lands via a (0,0)-CAS — create-if-missing — so local
-        writes made since the empty adoption stay newest."""
+        """Start an import for an adopted tenant via the
+        version-preserving install (CAS continuity across the move).
+        With ``create_only`` (late handoffs merging into a live
+        tenant) only keys with NO committed local copy install —
+        local writes made since the empty adoption stay newest, and
+        keep their local versions.  Legacy 2-tuple entries (no
+        version) install at (1, 1): still CAS-able, visibly
+        pre-move."""
         svc = self.svc
         row = svc.resolve_ensemble(name)
         if row is None:
             self._inbox.setdefault(name, []).extend(data)
             return
-        keys = [k for k, _ in data]
-        vals = [v for _, v in data]
-        self._import_data[name] = (data, create_only)
         if create_only:
-            fut = svc.kupdate_many(row, keys, [(0, 0)] * len(keys),
-                                   vals)
-        else:
-            fut = svc.kput_many(row, keys, vals)
+            sh = svc.slot_handle[row]
+            ks = svc.key_slot[row]
+            data = [e for e in data
+                    if not (ks.get(e[0]) is not None
+                            and sh.get(ks[e[0]], 0))]
+            if not data:
+                return
+        items = [(e[0], (e[2] if len(e) > 2 else (1, 1)), e[1])
+                 for e in data]
+        self._import_data[name] = (data, create_only)
+        from riak_ensemble_tpu.runtime import Future
+        fut = Future()
+        try:
+            fut.resolve(svc.install_objs(row, items))
+        except Exception:
+            # lost quorum mid-install (repgroup owners): the whole
+            # batch retries through the bounded path
+            fut.resolve(["failed"] * len(items))
         self._importing[name] = fut
 
     def _check_import(self, name: Any, fut) -> None:
@@ -404,19 +433,19 @@ class ServiceReconciler:
             results = list(results) + ["failed"] * (len(data)
                                                     - len(results))
         row = svc.resolve_ensemble(name)
-        lost: List[Tuple[Any, Any]] = []
-        for (key, val), res in zip(data, results):
+        lost: List[Tuple] = []
+        for entry, res in zip(data, results):
+            key = entry[0]
             if isinstance(res, tuple) and res[0] == "ok":
                 continue
-            # create_only 'failed' can mean the key already exists
-            # locally (expected: local write wins) — only keys with
-            # no committed local copy actually need the retry
+            # a 'failed' key that nonetheless holds a committed local
+            # copy (raced local write — local wins) needs no retry
             if row is not None:
                 slot = svc.key_slot[row].get(key)
                 if slot is not None and \
                         svc.slot_handle[row].get(slot, 0):
                     continue
-            lost.append((key, val))
+            lost.append(entry)
         if not lost:
             self._import_attempts.pop(name, None)
             return
