@@ -1434,8 +1434,13 @@ class ReplicatedService(BatchedEnsembleService):
             return
         cver, hosts, joint = self.core.cfg
         if joint is None:
-            # collapse already landed (e.g. resumed transition raced)
-            self._cfg_txn = None
+            # The collapse record is already adopted locally
+            # (_commit_cfg adopts BEFORE counting — its quorum may
+            # have been transiently missed, or a resumed transition
+            # raced): the finalization must still run, or a leader
+            # that transitioned itself out would keep serving and
+            # removed links would never prune (review r5).
+            self._finish_collapse()
             return
         if not txn["joint_committed"]:
             txn["joint_committed"] = self._commit_cfg(cver, hosts,
@@ -1449,22 +1454,30 @@ class ReplicatedService(BatchedEnsembleService):
         if not self._maj(joint, synced):
             return
         if self._commit_cfg(cver + 1, joint, None):
-            new = list(self.core.cfg[1])
-            self._cfg_txn = None
-            self.group_size = len(new)
-            for link in list(self._links):
-                if (link.host, link.port) not in new:
-                    link.close()
-                    self._links.remove(link)
-            self._emit("grp_cfg_collapsed",
-                       {"cver": self.core.cfg[0], "hosts": new})
-            if self.self_addr is not None \
-                    and self.self_addr not in new:
-                # transitioned out: stop serving (the reference peer
-                # shuts down when not a member of the final view)
-                self._is_leader = False
-                self._deposed = True
-                self._emit("grp_step_down", {"reason": "not-member"})
+            self._finish_collapse()
+
+    def _finish_collapse(self) -> None:
+        """Post-collapse finalization (idempotent): quorum size from
+        the committed list, links to removed hosts pruned, and — the
+        reference peer's shutdown-if-not-member (transition,
+        peer.erl:756-774) — a leader that transitioned itself out
+        steps down."""
+        new = list(self.core.cfg[1])
+        self._cfg_txn = None
+        self.group_size = len(new)
+        for link in list(self._links):
+            if (link.host, link.port) not in new:
+                link.close()
+                self._links.remove(link)
+        self._emit("grp_cfg_collapsed",
+                   {"cver": self.core.cfg[0], "hosts": new})
+        if self.self_addr is not None \
+                and self.self_addr not in new:
+            # transitioned out: stop serving (the reference peer
+            # shuts down when not a member of the final view)
+            self._is_leader = False
+            self._deposed = True
+            self._emit("grp_step_down", {"reason": "not-member"})
 
     # -- the replicated launch ----------------------------------------------
 

@@ -355,3 +355,71 @@ def test_update_members_forms(tmp_path):
     solo.update_members([("solo.test", 1)])
     assert solo.membership_status()["transition"] is False
     solo.stop()
+
+
+def test_leader_transitions_itself_out(tmp_path):
+    """The reference peer shuts down when it is not a member of the
+    final view (transition, peer.erl:756-774): a leader may run a
+    transition that REMOVES ITSELF.  The collapse commits under the
+    joint rule, the ex-leader steps down (deposed), and a remaining
+    member promotes and serves every acked write."""
+    import asyncio
+
+    from riak_ensemble_tpu import svcnode
+
+    procs, dirs = {}, {}
+    try:
+        for name in ("r1", "r2"):
+            dirs[name] = str(tmp_path / name)
+            procs[name] = _spawn_replica(dirs[name])
+        svc = _make_leader(tmp_path,
+                           [procs["r1"][1], procs["r2"][1]])
+        acked = {}
+        futs = []
+        for i in range(8):
+            e, key, val = i % N_ENS, f"k{i}", b"v%d" % i
+            futs.append(svc.kput(e, key, val))
+            acked[(e, key)] = val
+        _settle(svc, futs)
+        assert all(f.value[0] == "ok" for f in futs)
+
+        # transition the leader OUT: new set = the two replicas only
+        new = [("127.0.0.1", procs["r1"][1]),
+               ("127.0.0.1", procs["r2"][1])]
+        svc.update_members(new)
+        try:
+            _drive_until(svc, lambda: svc._deposed,
+                         what="ex-member leader step-down")
+        except repgroup.DeposedError:
+            pass  # the step-down landed between cond checks
+        assert svc._deposed, "ex-member leader never stepped down"
+        st = svc.membership_status()
+        assert st["joint"] is None and \
+            set(map(tuple, st["hosts"])) == set(new), st
+
+        # a remaining member promotes under the 2-host config and
+        # serves every acked write
+        r1_repl, r1_client = procs["r1"][1], procs["r1"][2]
+        with socket.create_connection(
+                ("127.0.0.1", r1_repl), timeout=120.0) as s:
+            s.settimeout(120.0)
+            repgroup.send_frame(
+                s, ("promote", [("127.0.0.1", procs["r2"][1])]))
+            resp = repgroup.recv_frame(s)
+        assert resp[0] == "ok", resp
+
+        async def read_all():
+            c = svcnode.ServiceClient("127.0.0.1", r1_client)
+            await c.connect()
+            for (e, key), val in acked.items():
+                r = await c.kget(e, key, timeout=120.0)
+                assert r == ("ok", val), (key, r)
+            r = await c.kput(0, "post", b"new", timeout=120.0)
+            assert r[0] == "ok", r
+            await c.close()
+        asyncio.run(read_all())
+        svc.stop()
+    finally:
+        for p, _, _ in procs.values():
+            if p.poll() is None:
+                p.kill()
