@@ -1180,14 +1180,13 @@ def reconfig_step(state: EngineState, propose: jax.Array,
 # Fused full step (election + K ops) — the "training step" analog
 
 
-@functools.partial(jax.jit, static_argnames=("axis_name",))
-def full_step(state: EngineState, elect: jax.Array, cand: jax.Array,
-              kind: jax.Array, slot: jax.Array, val: jax.Array,
-              lease_ok: jax.Array, up: jax.Array,
-              axis_name: Optional[str] = None,
-              exp_epoch: Optional[jax.Array] = None,
-              exp_seq: Optional[jax.Array] = None
-              ) -> Tuple[EngineState, jax.Array, KvResult]:
+def _full_step_body(state: EngineState, elect: jax.Array, cand: jax.Array,
+                    kind: jax.Array, slot: jax.Array, val: jax.Array,
+                    lease_ok: jax.Array, up: jax.Array,
+                    axis_name: Optional[str] = None,
+                    exp_epoch: Optional[jax.Array] = None,
+                    exp_seq: Optional[jax.Array] = None
+                    ) -> Tuple[EngineState, jax.Array, KvResult]:
     """Election round (where needed) followed by K K/V rounds, fused.
 
     This is the flagship jitted step: the host decides *which*
@@ -1201,13 +1200,29 @@ def full_step(state: EngineState, elect: jax.Array, cand: jax.Array,
     return state, won, res
 
 
-def full_step_wide(state: EngineState, elect: jax.Array, cand: jax.Array,
-                   kind: jax.Array, slot: jax.Array, val: jax.Array,
-                   lease_ok: jax.Array, up: jax.Array,
-                   axis_name: Optional[str] = None,
-                   exp_epoch: Optional[jax.Array] = None,
-                   exp_seq: Optional[jax.Array] = None
-                   ) -> Tuple[EngineState, jax.Array, KvResult]:
+full_step = jax.jit(_full_step_body, static_argnames=("axis_name",))
+
+#: ``full_step`` with the state argument DONATED (``donate_argnums``):
+#: back-to-back launches alias the output state buffers onto the
+#: input's instead of allocating + copying the E×M(×S) planes each
+#: launch.  The caller's input ``EngineState`` is CONSUMED — any
+#: retained reference (rollback snapshots included) is invalid after
+#: the call on backends that honor donation; backends that don't
+#: (older CPU runtimes) fall back to a copy with a one-time warning.
+#: Used by the service's pipelined launch path (RETPU_DONATE).
+full_step_donate = jax.jit(_full_step_body,
+                           static_argnames=("axis_name",),
+                           donate_argnums=(0,))
+
+
+def _full_step_wide_body(state: EngineState, elect: jax.Array,
+                         cand: jax.Array, kind: jax.Array,
+                         slot: jax.Array, val: jax.Array,
+                         lease_ok: jax.Array, up: jax.Array,
+                         axis_name: Optional[str] = None,
+                         exp_epoch: Optional[jax.Array] = None,
+                         exp_seq: Optional[jax.Array] = None
+                         ) -> Tuple[EngineState, jax.Array, KvResult]:
     """``full_step`` with ``[G, E, W]`` conflict-free op planes (see
     :func:`kv_step_scan_wide`) — the wide-scheduled flagship step.
 
@@ -1219,3 +1234,13 @@ def full_step_wide(state: EngineState, elect: jax.Array, cand: jax.Array,
         state, kind, slot, val, lease_ok, up, axis_name=axis_name,
         exp_epoch=exp_epoch, exp_seq=exp_seq)
     return state, won, res
+
+
+full_step_wide = jax.jit(_full_step_wide_body,
+                         static_argnames=("axis_name",))
+
+#: donated-state variant of :func:`full_step_wide` (see
+#: :data:`full_step_donate` for the aliasing contract).
+full_step_wide_donate = jax.jit(_full_step_wide_body,
+                                static_argnames=("axis_name",),
+                                donate_argnums=(0,))

@@ -21,6 +21,15 @@ host *service* that multiplexes thousands of engine-backed ensembles —
 
 Results come back to client futures after each flush (one d2h per
 flush, amortized over every op in the batch).
+
+Launches are TWO-PHASE (pipelined async service execution): an
+enqueue half dispatches the fused step + packed-result transfer
+without any host read, and a resolve half — up to ``pipeline_depth``
+launches later — unpacks, applies the host mirrors, WAL-logs and
+fans out the futures, so a round's d2h transfer and host bookkeeping
+overlap the next round's device step.  Ordering, the WAL-before-ack
+barrier, and corruption→exchange semantics are preserved; see
+docs/ARCHITECTURE.md §7 "Two-phase launch pipeline".
 """
 
 from __future__ import annotations
@@ -187,6 +196,12 @@ def warmup_kernels(svc: "BatchedEnsembleService") -> None:
 
     e, m, s = svc.n_ens, svc.n_peers, svc.n_slots
     pack = _select_packer(svc.engine)
+    # Warm the programs the launch path actually dispatches — with
+    # donation on, the donated executables (donation changes the
+    # compiled program's aliasing, so the plain warm wouldn't cover
+    # it).  The throwaway state is THREADED through the calls: a
+    # donated call consumes its input state.
+    step, step_wide = svc._step_fns()
     st = svc.engine.init_state(e, m, s)
     elect = jnp.zeros((e,), bool)
     cand = jnp.zeros((e,), jnp.int32)
@@ -195,14 +210,14 @@ def warmup_kernels(svc: "BatchedEnsembleService") -> None:
     while True:
         kind = jnp.zeros((k, e), jnp.int32)
         lease = jnp.zeros((k, e), bool)
-        _, won, res = svc.engine.full_step(
+        st, won, res = step(
             st, elect, cand, kind, kind, kind, lease, up,
             exp_epoch=kind, exp_seq=kind)
         np.asarray(pack(won, res, True))
         if k >= svc.max_k:
             break
         k = 1 if k == 0 else k * 2
-    if svc._wide and getattr(svc.engine, "full_step_wide", None):
+    if svc._wide and step_wide is not None:
         # The wide gate admits plans with G in {1, 2} and pow2 W up to
         # _pow2_at_least(flush depth) — a non-pow2 max_k still
         # schedules into the NEXT pow2 width, so warm through it.
@@ -212,7 +227,7 @@ def warmup_kernels(svc: "BatchedEnsembleService") -> None:
             while w <= w_max:
                 kind = jnp.zeros((g, e, w), jnp.int32)
                 lease = jnp.zeros((g, e, w), bool)
-                _, won, res = svc.engine.full_step_wide(
+                st, won, res = step_wide(
                     st, elect, cand, kind, kind, kind, lease, up,
                     exp_epoch=kind, exp_seq=kind)
                 np.asarray(pack(
@@ -229,7 +244,9 @@ class _LocalEngine:
 
     init_state = staticmethod(eng.init_state)
     full_step = staticmethod(eng.full_step)
+    full_step_donate = staticmethod(eng.full_step_donate)
     full_step_wide = staticmethod(eng.full_step_wide)
+    full_step_wide_donate = staticmethod(eng.full_step_wide_donate)
     rebuild_trees = staticmethod(eng.rebuild_trees)
     exchange_step = staticmethod(eng.exchange_step)
     reconfig_step = staticmethod(eng.reconfig_step)
@@ -349,6 +366,44 @@ class _BatchAccum:
             resolver(fut, res)
 
 
+@dataclass(slots=True)
+class _InFlightLaunch:
+    """One dispatched-but-unresolved device launch in the service's
+    bounded launch pipeline: the enqueue half's outputs (the packed
+    result array whose d2h transfer is already running, the latency
+    marks so far, the rollback snapshots) plus whatever the resolve
+    half needs to finish the round (election vector for the leader
+    mirror, wide-plan routing, the flush's taken queue entries or an
+    ``execute_async`` future)."""
+
+    flat: Any               # device uint8 packed result (in flight)
+    rec: Dict[str, float]   # latency marks (enqueue half)
+    k: int                  # caller's round count
+    k_eff: int              # rounds in the packed layout (wide: G*W)
+    want_vsn: bool
+    plan: Any               # WidePlan (wide launches) or None
+    w_b: int                # wide plane width (plan only)
+    kind_np: Any            # host kind plane for wide routing masks
+    elect: Any              # [E] bool — this launch's election vector
+    cand: Any               # [E] int32 — its candidates
+    now: float              # runtime.now at enqueue (lease renewal)
+    state_snapshot: Any     # pre-launch EngineState (rollback)
+    leader_snapshot: Any
+    lease_snapshot: Any
+    donated: bool           # state buffers donated (no rollback)
+    #: flush path: the (ensemble, taken ops) pairs this launch serves
+    taken: Any = None
+    #: execute_async path: the client future + WAL planes + op count
+    exec_fut: Any = None
+    exec_wal: Any = None
+    exec_ops: int = 0
+    t_enq: float = 0.0
+    #: replication-group extension (repgroup.ReplicatedService): the
+    #: shipped frame's group seq + per-link apply tickets
+    grp_seq: int = 0
+    grp_sends: Any = None
+
+
 class BatchedEnsembleService:
     """N engine-backed ensembles behind a put/get API.
 
@@ -367,7 +422,8 @@ class BatchedEnsembleService:
                  wal_sync: str = "fsync",
                  wal_compact_records: int = 1 << 18,
                  dynamic: bool = False,
-                 scrub_every_flushes: Optional[int] = None) -> None:
+                 scrub_every_flushes: Optional[int] = None,
+                 pipeline_depth: int = 1) -> None:
         import jax.numpy as jnp
 
         self.runtime = runtime
@@ -465,8 +521,12 @@ class BatchedEnsembleService:
         #: leader-status watchers per ensemble (watch_leader)
         self._leader_watchers: Dict[int, List[Any]] = {}
         #: periodic anti-entropy cadence: run :meth:`scrub` every N
-        #: flushes (None = on demand only) — the AAE-timer analog
+        #: flushes (None = on demand only) — the AAE-timer analog.
+        #: Watermark, not modulo: a pipelined drain can settle two
+        #: launches in one flush (flushes += 2), which would jump a
+        #: modulo test past its multiple and silently skip the sweep.
         self.scrub_every_flushes = scrub_every_flushes
+        self._scrubbed_at_flush = 0
         self._timer: Optional[Timer] = None
         self._kick_pending = False  # burst flush queued (see _maybe_kick)
         self._jnp = jnp
@@ -488,7 +548,22 @@ class BatchedEnsembleService:
         #: clock reads are nanoseconds against millisecond launches.
         from collections import deque
         self.lat_records = deque(maxlen=1024)
-        self._lat_last: Dict[str, float] = {}
+        #: bounded launch pipeline (the two-phase async service
+        #: execution): up to ``pipeline_depth`` launches may be
+        #: dispatched-but-unresolved, so batch N's packed d2h transfer
+        #: and host resolve overlap batch N+1's device step.  Depth 1
+        #: keeps the historical fully-synchronous flush.
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._inflight_launches: "deque[_InFlightLaunch]" = deque()
+        #: jit buffer donation for the fused step's state argument:
+        #: back-to-back launches then reuse the E×M(×S) plane buffers
+        #: instead of copying them each launch.  RETPU_DONATE=1/0
+        #: forces it; default ON off-CPU (CPU keeps the copy so the
+        #: launch-failure rollback snapshots stay valid — a donated
+        #: launch that fails poisons the state, see _rollback_launch).
+        _don = os.environ.get("RETPU_DONATE", "")
+        self._donate = (_don == "1" if _don
+                        else jax.default_backend() != "cpu")
         #: continuous durability (task: never ack a write that isn't on
         #: disk — basic_backend.erl:120-125): when ``data_dir`` is set,
         #: committed client writes append to a WAL generation paired
@@ -540,6 +615,9 @@ class BatchedEnsembleService:
         peer-sup limits).  ``view`` defaults to all peers.
         """
         assert self.dynamic, "construct with dynamic=True"
+        # lifecycle mutates device rows + host mirrors: settle any
+        # in-flight launches first (no-op in steady state)
+        self._drain_launches()
         if name in self._ens_names or not self._free_rows:
             return None
         row = self._free_rows.pop()
@@ -571,6 +649,9 @@ class BatchedEnsembleService:
         device row is wiped eagerly, and the row returns to the free
         pool.  Returns False for unknown names."""
         assert self.dynamic, "construct with dynamic=True"
+        # settle first: an in-flight launch's WAL/settle reads this
+        # row's payload handles, which the reset below releases
+        self._drain_launches()
         row = self._ens_names.pop(name, None)
         if row is None:
             return False
@@ -995,6 +1076,7 @@ class BatchedEnsembleService:
         versions.  Returns per-item ``("ok", (epoch, seq))`` |
         ``"failed"`` (no slot).
         """
+        self._drain_launches()  # installs splice device state directly
         results, applied = self._allocate_install(ens, items)
         if applied:
             self._apply_installed(ens, applied,
@@ -1284,6 +1366,10 @@ class BatchedEnsembleService:
         out (peer.erl:763-771).
         """
         jnp = self._jnp
+        # reconfig reads the leader/membership mirrors and fetches
+        # device results synchronously: settle in-flight launches
+        # first (no-op in steady state)
+        self._drain_launches()
         sel = np.asarray(sel, bool)
         if self.dynamic:
             sel = sel & self._live  # free rows have no membership
@@ -1419,6 +1505,10 @@ class BatchedEnsembleService:
         try:
             while self._active:
                 self.flush()
+            # settle the launch pipeline too: an unresolved launch's
+            # host bookkeeping (slot_handle, recycles) must land
+            # before the mirrors persist
+            self._drain_launches()
         finally:
             self._in_save = False
         os.makedirs(path, exist_ok=True)
@@ -1855,11 +1945,12 @@ class BatchedEnsembleService:
                 elect: Optional[np.ndarray] = None,
                 cand: Optional[np.ndarray] = None,
                 lease_ok: Optional[np.ndarray] = None):
-        """One ``full_step`` launch + host bookkeeping shared by
-        :meth:`flush` (future-based) and :meth:`execute` (bulk):
-        elections folded in, lease check/renewal, corruption-driven
-        exchange.  Returns np result arrays (vsn None unless asked —
-        it is the largest transfer and bulk callers rarely need it).
+        """One SYNCHRONOUS ``full_step`` launch + host bookkeeping —
+        the two pipeline halves (:meth:`_launch_enqueue` /
+        :meth:`_launch_resolve`) composed back to back, shared by
+        :meth:`execute` (bulk) and the replica apply path.  Returns np
+        result arrays (vsn None unless asked — it is the largest
+        transfer and bulk callers rarely need it).
 
         ``entries`` is the flush's taken queue entries as
         (ensemble, ops) pairs over the ACTIVE ensembles (None for
@@ -1871,48 +1962,263 @@ class BatchedEnsembleService:
         same vectors this launch consumes — recomputing lease_ok from
         a later ``runtime.now`` could differ.
         """
+        fl = self._launch_enqueue(kind, slot, val, k, want_vsn, exp_e,
+                                  exp_s, entries, elect, cand, lease_ok)
+        return self._launch_resolve(fl)
+
+    def _step_fns(self) -> Tuple[Any, Any]:
+        """The (full_step, full_step_wide) programs the launch path
+        dispatches: the donated-state variants when donation is on and
+        the engine provides them (mesh engines may not)."""
+        e = self.engine
+        wide = getattr(e, "full_step_wide", None)
+        if self._donate:
+            return (getattr(e, "full_step_donate", None) or e.full_step,
+                    getattr(e, "full_step_wide_donate", None) or wide)
+        return e.full_step, wide
+
+    def _launch_enqueue(self, kind: np.ndarray, slot: np.ndarray,
+                        val: np.ndarray, k: int, want_vsn: bool,
+                        exp_e: Optional[np.ndarray] = None,
+                        exp_s: Optional[np.ndarray] = None,
+                        entries: Optional[List[Tuple[int,
+                                                     List[Any]]]] = None,
+                        elect: Optional[np.ndarray] = None,
+                        cand: Optional[np.ndarray] = None,
+                        lease_ok: Optional[np.ndarray] = None
+                        ) -> _InFlightLaunch:
+        """ENQUEUE half of a launch: build + upload the inputs,
+        dispatch the fused step, the result pack, and the packed d2h
+        transfer — all asynchronous — and return the in-flight record.
+        No host read of device data happens here, so while batch N's
+        packed vector is in flight the host is free to enqueue batch
+        N+1 against the new (not yet materialized) ``EngineState`` —
+        the overlap :meth:`flush` exploits at ``pipeline_depth`` > 1.
+        """
+        del entries  # base launch doesn't need them (subclass hook)
+        jnp = self._jnp
         if elect is None:
             elect, cand = self._election_inputs()
         now = self.runtime.now
         if lease_ok is None:
             lease_ok = self.lease_until > now
 
-        # Under async dispatch a device failure surfaces at the d2h
-        # fetch BELOW, after self.state has been replaced with the
-        # failed computation's poisoned arrays; without rolling back,
-        # every later launch would consume the poison and fail
-        # forever.  The host mirrors roll back with it: the inner body
-        # applies leader/lease updates before its LAST device fetch
-        # (the corruption-exchange one), and a mirror claiming a
-        # leader the restored device state doesn't have would suppress
-        # re-election forever.  (JAX arrays are immutable, so the
-        # state snapshot stays valid; lease_until is mutated in place,
-        # so it needs a copy.)
+        t0 = time.perf_counter()
+        plan = self._wide_plan(kind, slot, val, k, exp_e, exp_s)
+        # h2d slimming (the tunnel link is the throughput ceiling in
+        # both directions): the lease plane uploads as [E] and
+        # broadcasts to the op-plane shape device-side; the up mask
+        # uploads only when the failure detector actually changed it.
+        # EVERY input upload belongs to the h2d mark — an asarray
+        # inlined into the step call would bill its (synchronous)
+        # transfer to 'dispatch' and make the async-enqueue number
+        # read milliseconds of jitter it doesn't have (VERDICT r3 #4).
+        if plan is not None:
+            g_b, _, w_b = plan.kind.shape
+            lease_j = jnp.broadcast_to(
+                jnp.asarray(lease_ok)[None, :, None],
+                (g_b, self.n_ens, w_b))
+            kind_j, slot_j, val_j = (jnp.asarray(plan.kind),
+                                     jnp.asarray(plan.slot),
+                                     jnp.asarray(plan.val))
+            exp_e_j = jnp.asarray(plan.exp_epoch)
+            exp_s_j = jnp.asarray(plan.exp_seq)
+        else:
+            g_b = w_b = 0
+            lease_j = (jnp.broadcast_to(jnp.asarray(lease_ok),
+                                        (k, self.n_ens))
+                       if k else jnp.zeros((0, self.n_ens), bool))
+            kind_j, slot_j, val_j = (jnp.asarray(kind),
+                                     jnp.asarray(slot),
+                                     jnp.asarray(val))
+            exp_e_j = None if exp_e is None else jnp.asarray(exp_e)
+            exp_s_j = None if exp_s is None else jnp.asarray(exp_s)
+        elect_j, cand_j = jnp.asarray(elect), jnp.asarray(cand)
+        up_j = self._up_device()
+        t1 = time.perf_counter()
+
+        # Rollback snapshots: under async dispatch a device failure
+        # surfaces at the d2h fetch in the RESOLVE half, after
+        # self.state was replaced with the failed computation's
+        # poisoned arrays; without rolling back, every later launch
+        # would consume the poison and fail forever.  (JAX arrays are
+        # immutable, so the state snapshot stays valid — unless the
+        # step DONATED them; lease_until is mutated in place, so it
+        # needs a copy.)
         state_snapshot = self.state
         leader_snapshot = self.leader_np
         lease_snapshot = self.lease_until.copy()
+        step, step_wide = self._step_fns()
+        attr = ("full_step_wide_donate" if plan is not None
+                else "full_step_donate")
+        donated = (self._donate
+                   and getattr(self.engine, attr, None) is not None)
         try:
-            out = self._launch_inner(elect, cand, now, lease_ok, kind,
-                                     slot, val, k, want_vsn, exp_e,
-                                     exp_s)
+            if plan is not None:
+                state, won, res = step_wide(
+                    self.state, elect_j, cand_j, kind_j, slot_j, val_j,
+                    lease_j, up_j, exp_epoch=exp_e_j, exp_seq=exp_s_j)
+                res = _wide_to_packed_layout(res, g_b, w_b, self.n_ens)
+                k_eff = g_b * w_b
+                self.wide_launches += 1
+            else:
+                state, won, res = step(
+                    self.state, elect_j, cand_j, kind_j, slot_j, val_j,
+                    lease_j, up_j, exp_epoch=exp_e_j, exp_seq=exp_s_j)
+                k_eff = k
+            self.state = state
+            flat = self._pack(won, res, want_vsn)
+            # Kick the packed vector's d2h transfer off NOW — the
+            # resolve half (possibly a full flush later) only blocks
+            # on its completion, so the transfer rides under the next
+            # batch's device step instead of serializing after it.
+            start = getattr(flat, "copy_to_host_async", None)
+            if start is not None:
+                start()
         except BaseException:
-            self.state = state_snapshot
-            self.leader_np = leader_snapshot
-            self.lease_until = lease_snapshot
+            self._rollback_launch(state_snapshot, leader_snapshot,
+                                  lease_snapshot, donated)
+            raise
+        t2 = time.perf_counter()
+        return _InFlightLaunch(
+            flat=flat, rec={"h2d": t1 - t0, "dispatch": t2 - t1},
+            k=k, k_eff=k_eff, want_vsn=want_vsn, plan=plan, w_b=w_b,
+            kind_np=None if plan is None else np.asarray(kind),
+            elect=elect, cand=cand, now=now,
+            state_snapshot=state_snapshot,
+            leader_snapshot=leader_snapshot,
+            lease_snapshot=lease_snapshot, donated=donated)
+
+    def _fetch_packed(self, fl: _InFlightLaunch) -> np.ndarray:
+        """Block until the launch's packed result is on the host (the
+        ONE device→host transfer per launch).  Isolated as a seam so
+        tests can inject d2h latency deterministically."""
+        return np.asarray(fl.flat)
+
+    def _rollback_launch(self, state_snapshot, leader_snapshot,
+                         lease_snapshot, donated: bool) -> None:
+        """Restore the pre-launch device state + host mirrors after a
+        failed launch.  The host mirrors roll back with the state: a
+        mirror claiming a leader the restored device state doesn't
+        have would suppress re-election forever.  A DONATED launch has
+        no rollback — the snapshot's buffers were consumed by the
+        failed program — so the state stays poisoned (restart or
+        restore() recovers); surfaced as a trace event."""
+        arr = state_snapshot.epoch
+        deleted = getattr(arr, "is_deleted", None)
+        if donated and deleted is not None and deleted():
+            self._emit("svc_state_poisoned",
+                       {"reason": "donated launch failed; no rollback "
+                                  "snapshot survives buffer donation"})
+            return
+        self.state = state_snapshot
+        self.leader_np = leader_snapshot
+        self.lease_until = lease_snapshot
+
+    def _launch_resolve(self, fl: _InFlightLaunch,
+                        wait_key: str = "device_d2h"):
+        """RESOLVE half of a launch: block on the packed transfer,
+        unpack, apply the host mirrors (leader/lease), run the
+        corruption→exchange sweep, and finish the launch's latency
+        record.  Under the pipelined flush this runs one round LATE —
+        after batch N+1's enqueue — so the block recorded under
+        ``wait_key`` (``inflight_wait`` there) is only the part of the
+        device round + transfer the host failed to overlap.
+
+        Corruption deferral rides the same structure: the ``corrupt``
+        planes are the only host inspection of a round's integrity
+        gate, and they are read HERE — one round late in the pipelined
+        flush — with the exchange dispatched onto the CURRENT device
+        state chain (which already includes batch N+1's step).  The
+        exchange therefore lands before batch N+1's results are
+        resolved: a flagged ensemble is repaired before its next
+        result is acked, the semantics the in-round sweep provided.
+        """
+        rec = fl.rec
+        t2 = time.perf_counter()
+        try:
+            flat = self._fetch_packed(fl)
+            rec[wait_key] = time.perf_counter() - t2
+            t3 = time.perf_counter()
+            e, m = self.n_ens, self.n_peers
+            (won_np, quorum_ok, corrupt_np, committed, get_ok, found,
+             value, vsn) = unpack_results(flat, e, m, fl.k_eff,
+                                          fl.want_vsn)
+            corrupt = corrupt_np if fl.k else None
+            if fl.plan is not None:
+                # Route the [G*W, E] results back to the caller's
+                # [K, E] op order; padding/NOOP rows read garbage
+                # lanes, so they are masked to the scalar path's NOOP
+                # results (all-false, zero value/vsn).
+                w_b = fl.w_b
+                ee_idx = np.arange(e, dtype=np.int32)[None, :]
+                fli = fl.plan.map_g * w_b + fl.plan.map_w
+                act = fl.kind_np != eng.OP_NOOP
+                committed = committed[fli, ee_idx] & act
+                get_ok = get_ok[fli, ee_idx] & act
+                found = found[fli, ee_idx] & act
+                value = np.where(act, value[fli, ee_idx], 0)
+                if vsn is not None:
+                    vsn = np.where(act[..., None], vsn[fli, ee_idx], 0)
+
+            # Host mirror: a won election installed our candidate.
+            self.leader_np = np.where(won_np, fl.cand, self.leader_np)
+
+            # Lease renewal: a won election, or any round in which the
+            # leader confirmed its epoch with a quorum — the
+            # leader_tick renewal (peer.erl:1092-1095), which covers
+            # read-only leaders (reads ride the epoch-check round),
+            # not just committers.
+            renew = won_np | quorum_ok
+            self.lease_until[renew] = fl.now + self.config.lease()
+
+            # Device-detected integrity failures -> anti-entropy
+            # exchange for the affected ensembles (the tree_corrupted
+            # -> repair -> exchange flow, peer.erl:1276-1277 +
+            # riak_ensemble_exchange): divergent slots re-adopt the
+            # newest hash-valid copy and the replicas' trees are
+            # rebuilt; unreplaceable (all-copies-bad) slots stay
+            # flagged rather than being blessed.
+            if corrupt is not None and corrupt.any():
+                jnp = self._jnp
+                tx = time.perf_counter()
+                self.corruptions += int(corrupt.sum())
+                run = corrupt.any(1)
+                self.state, diverged, synced = self.engine.exchange_step(
+                    self.state, jnp.asarray(run), self._up_device())
+                self.repairs += int(
+                    np.asarray(diverged)[np.asarray(synced)].sum())
+                self._emit("svc_exchange", {"ensembles": int(run.sum())})
+                rec["exchange"] = time.perf_counter() - tx
+            self.flushes += 1
+            rec["unpack"] = (time.perf_counter() - t3
+                             - rec.get("exchange", 0.0))
+        except BaseException:
+            self._rollback_launch(fl.state_snapshot, fl.leader_snapshot,
+                                  fl.lease_snapshot, fl.donated)
             raise
         # Leader changes (won elections) notify watchers only on a
         # SUCCESSFUL launch — the except path above rolled the mirror
         # back, and a watcher told of a rolled-back leader would act
         # on state the device never kept.
-        self._notify_leader_changes(leader_snapshot)
-        # Launch-side latency record; flush() augments the same dict
-        # with queue_wait/wal/resolve (bulk execute() callers get the
-        # launch components alone).
-        rec = self._lat_last
-        rec["k"] = k
-        rec["total"] = sum(v for c, v in rec.items() if c != "k")
+        self._notify_leader_changes(fl.leader_snapshot)
+        self._emit("svc_launch", {
+            "k": fl.k, "elections": int(fl.elect.sum()),
+            "won": int(won_np.sum()),
+            "corrupt_replicas": (int(corrupt.sum())
+                                 if corrupt is not None else 0),
+        })
+        # Launch-side latency record; the flush settle augments the
+        # same dict with queue_wait/wal/resolve (bulk execute()
+        # callers get the launch components alone).  'enqueue' is a
+        # DERIVED mark (h2d + dispatch — the whole enqueue half) kept
+        # out of the total sum.
+        rec["k"] = fl.k
+        rec["enqueue"] = rec.get("h2d", 0.0) + rec.get("dispatch", 0.0)
+        rec["total"] = sum(v for c, v in rec.items()
+                           if c not in ("k", "total", "enqueue"))
         self.lat_records.append(rec)
-        return out
+        return committed, get_ok, found, value, vsn
 
     def _wide_plan(self, kind, slot, val, k, exp_e, exp_s):
         """Schedule host [K, E] planes into conflict-free wide rounds
@@ -1948,126 +2254,6 @@ class BatchedEnsembleService:
             eng.validate_wide_plane(plan.kind, plan.slot)
         return plan
 
-    def _launch_inner(self, elect, cand, now, lease_ok, kind, slot,
-                      val, k, want_vsn, exp_e, exp_s):
-        jnp = self._jnp
-        t0 = time.perf_counter()
-
-        plan = self._wide_plan(kind, slot, val, k, exp_e, exp_s)
-        # h2d slimming (the tunnel link is the throughput ceiling in
-        # both directions): the lease plane uploads as [E] and
-        # broadcasts to the op-plane shape device-side; the up mask
-        # uploads only when the failure detector actually changed it.
-        # EVERY input upload belongs to the h2d mark — an asarray
-        # inlined into the step call would bill its (synchronous)
-        # transfer to 'dispatch' and make the async-enqueue number
-        # read milliseconds of jitter it doesn't have (VERDICT r3 #4).
-        if plan is not None:
-            g_b, _, w_b = plan.kind.shape
-            lease_j = jnp.broadcast_to(
-                jnp.asarray(lease_ok)[None, :, None],
-                (g_b, self.n_ens, w_b))
-            kind_j, slot_j, val_j = (jnp.asarray(plan.kind),
-                                     jnp.asarray(plan.slot),
-                                     jnp.asarray(plan.val))
-            exp_e_j = jnp.asarray(plan.exp_epoch)
-            exp_s_j = jnp.asarray(plan.exp_seq)
-        else:
-            lease_j = (jnp.broadcast_to(jnp.asarray(lease_ok),
-                                        (k, self.n_ens))
-                       if k else jnp.zeros((0, self.n_ens), bool))
-            kind_j, slot_j, val_j = (jnp.asarray(kind),
-                                     jnp.asarray(slot),
-                                     jnp.asarray(val))
-            exp_e_j = None if exp_e is None else jnp.asarray(exp_e)
-            exp_s_j = None if exp_s is None else jnp.asarray(exp_s)
-        elect_j, cand_j = jnp.asarray(elect), jnp.asarray(cand)
-        up_j = self._up_device()
-        t1 = time.perf_counter()
-        if plan is not None:
-            state, won, res = self.engine.full_step_wide(
-                self.state, elect_j, cand_j, kind_j, slot_j, val_j,
-                lease_j, up_j, exp_epoch=exp_e_j, exp_seq=exp_s_j)
-            res = _wide_to_packed_layout(res, g_b, w_b, self.n_ens)
-            k_eff = g_b * w_b
-            self.wide_launches += 1
-        else:
-            state, won, res = self.engine.full_step(
-                self.state, elect_j, cand_j, kind_j, slot_j, val_j,
-                lease_j, up_j, exp_epoch=exp_e_j, exp_seq=exp_s_j)
-            k_eff = k
-        self.state = state
-        t2 = time.perf_counter()
-
-        # ONE device->host transfer per launch: bit-packed bool planes
-        # + bitcast int planes in a single uint8 vector (each separate
-        # fetch is a full round trip over a tunneled device link, and
-        # link bandwidth bounds service throughput — see _pack_results).
-        e, m = self.n_ens, self.n_peers
-        flat = np.asarray(self._pack(won, res, want_vsn))
-        t3 = time.perf_counter()
-        # Latency breakdown marks (finished by flush(), which adds the
-        # queue-wait and resolve components): h2d = input build +
-        # transfer; dispatch = async enqueue of the fused step;
-        # device_d2h = device math + packed-result fetch (async
-        # dispatch means the block lands here); unpack filled below.
-        self._lat_last = {"h2d": t1 - t0, "dispatch": t2 - t1,
-                          "device_d2h": t3 - t2}
-        (won_np, quorum_ok, corrupt_np, committed, get_ok, found,
-         value, vsn) = unpack_results(flat, e, m, k_eff, want_vsn)
-        corrupt = corrupt_np if k else None
-        if plan is not None:
-            # Route the [G*W, E] results back to the caller's [K, E]
-            # op order; padding/NOOP rows read garbage lanes, so they
-            # are masked to the scalar path's NOOP results (all-false,
-            # zero value/vsn).
-            ee_idx = np.arange(e, dtype=np.int32)[None, :]
-            fl = plan.map_g * w_b + plan.map_w
-            act = np.asarray(kind) != eng.OP_NOOP
-            committed = committed[fl, ee_idx] & act
-            get_ok = get_ok[fl, ee_idx] & act
-            found = found[fl, ee_idx] & act
-            value = np.where(act, value[fl, ee_idx], 0)
-            if vsn is not None:
-                vsn = np.where(act[..., None], vsn[fl, ee_idx], 0)
-
-        # Host mirror: a won election installed our candidate.
-        self.leader_np = np.where(won_np, cand, self.leader_np)
-
-        # Lease renewal: a won election, or any round in which the
-        # leader confirmed its epoch with a quorum — the leader_tick
-        # renewal (peer.erl:1092-1095), which covers read-only leaders
-        # (reads ride the epoch-check round), not just committers.
-        renew = won_np | quorum_ok
-        self.lease_until[renew] = now + self.config.lease()
-
-        # Device-detected integrity failures -> anti-entropy exchange
-        # for the affected ensembles (the tree_corrupted -> repair ->
-        # exchange flow, peer.erl:1276-1277 + riak_ensemble_exchange):
-        # divergent slots re-adopt the newest hash-valid copy and the
-        # replicas' trees are rebuilt; unreplaceable (all-copies-bad)
-        # slots stay flagged rather than being blessed.
-        if corrupt is not None and corrupt.any():
-            tx = time.perf_counter()
-            self.corruptions += int(corrupt.sum())
-            run = corrupt.any(1)
-            self.state, diverged, synced = self.engine.exchange_step(
-                self.state, jnp.asarray(run), self._up_device())
-            self.repairs += int(
-                np.asarray(diverged)[np.asarray(synced)].sum())
-            self._emit("svc_exchange", {"ensembles": int(run.sum())})
-            self._lat_last["exchange"] = time.perf_counter() - tx
-        self.flushes += 1
-        self._lat_last["unpack"] = (time.perf_counter() - t3
-                                    - self._lat_last.get("exchange", 0.0))
-        self._emit("svc_launch", {
-            "k": k, "elections": int(elect.sum()),
-            "won": int(won_np.sum()),
-            "corrupt_replicas": (int(corrupt.sum())
-                                 if corrupt is not None else 0),
-        })
-        return committed, get_ok, found, value, vsn
-
     def _emit(self, kind: str, payload: Any) -> None:
         """Feed the runtime's tracing hook (utils.trace.Tracer) when
         one is installed; free otherwise."""
@@ -2086,6 +2272,10 @@ class BatchedEnsembleService:
         invisible to the data path until a scrub or access — the
         operator cadence knob the reference gets from AAE timers."""
         jnp = self._jnp
+        # settle in-flight launches: the sweep's damage/heal counters
+        # must not race a pending round's own repair bookkeeping
+        self._drain_launches()
+        self._scrubbed_at_flush = self.flushes
         node_bad, leaf_bad = self.engine.verify_trees(self.state)
         bad = np.asarray(node_bad) | np.asarray(leaf_bad)    # [E, M]
         found = int(bad.sum())
@@ -2115,11 +2305,16 @@ class BatchedEnsembleService:
         """Per-component launch-latency percentiles (ms) over the
         recent flushes: where a commit's latency actually goes —
         queue_wait (enqueue → launch), h2d (input build + upload),
-        dispatch (async enqueue), device_d2h (device math + packed
-        result fetch), unpack, exchange (corruption-triggered),
-        wal (durability barrier), resolve (future fan-out).  This is
-        what makes the BASELINE p99 target analyzable before and
-        after a platform change (VERDICT r2)."""
+        dispatch (async enqueue of the step/pack/transfer), then
+        EITHER device_d2h (depth-1: device math + packed result
+        fetch, serial) OR inflight_wait (pipelined: the part of the
+        device round + transfer the overlap failed to hide — this
+        shrinking below device_d2h is the pipeline working), unpack,
+        exchange (corruption-triggered), wal (durability barrier),
+        resolve (future fan-out).  'enqueue' is a derived mark
+        (h2d + dispatch — the whole enqueue half) excluded from the
+        'total' sum.  This is what makes the BASELINE p99 target
+        analyzable before and after a platform change (VERDICT r2)."""
         recs = list(self.lat_records)
         out: Dict[str, Dict[str, float]] = {}
         if not recs:
@@ -2148,6 +2343,8 @@ class BatchedEnsembleService:
             "queued_ops": sum(self._queue_rounds),
             "execute_unlogged": self._dev_exec_unlogged,
             "wide_launches": self.wide_launches,
+            "pipeline_depth": self.pipeline_depth,
+            "launches_in_flight": len(self._inflight_launches),
         }
 
     def execute(self, kind: np.ndarray, slot: np.ndarray,
@@ -2184,17 +2381,12 @@ class BatchedEnsembleService:
         RPO is bounded by the checkpoint cadence instead (documented
         in ARCHITECTURE).
         """
+        # A synchronous execute settles the launch pipeline first, so
+        # results land in submission order behind any execute_async /
+        # pipelined-flush work already in flight.
+        self._drain_launches()
         if isinstance(kind, jax.Array):
-            if self._wal is not None and not self._dev_exec_unlogged:
-                # The durability contract weakens on this path (no WAL
-                # record; RPO = checkpoint cadence) purely because of
-                # the argument TYPE — make that observable once per
-                # service instead of silent (ADVICE r3): a trace event
-                # plus a stats() counter.
-                self._dev_exec_unlogged = True
-                self._emit("svc_execute_unlogged", {
-                    "reason": "device-resident op planes skip the WAL;"
-                              " RPO is the checkpoint cadence"})
+            self._note_dev_exec_unlogged()
             k = int(kind.shape[0])
             committed, get_ok, found, value, _ = self._launch(
                 kind, slot, val, k, want_vsn=False,
@@ -2216,26 +2408,127 @@ class BatchedEnsembleService:
             exp_s=None if exp_seq is None
             else np.asarray(exp_seq, np.int32))
         if self._wal is not None:
-            wmask = (((kind == eng.OP_PUT) | (kind == eng.OP_CAS))
-                     & committed)
-            js, es = np.nonzero(wmask)
-            recs = [(("kv", int(e), int(slot[j, e])),
-                     (None, int(val[j, e]), int(vsn[j, e, 0]),
-                      int(vsn[j, e, 1]), None, True))
-                    for j, e in zip(js.tolist(), es.tolist())]
-            if recs:
-                self._wal.log(recs + self._wal_extra_records())
+            self._log_execute_wal(kind, slot, val, committed, vsn)
         self.ops_served += int((np.asarray(kind) != eng.OP_NOOP).sum())
         return committed, get_ok, found, value
 
+    def _note_dev_exec_unlogged(self) -> None:
+        if self._wal is not None and not self._dev_exec_unlogged:
+            # The durability contract weakens on this path (no WAL
+            # record; RPO = checkpoint cadence) purely because of
+            # the argument TYPE — make that observable once per
+            # service instead of silent (ADVICE r3): a trace event
+            # plus a stats() counter.
+            self._dev_exec_unlogged = True
+            self._emit("svc_execute_unlogged", {
+                "reason": "device-resident op planes skip the WAL;"
+                          " RPO is the checkpoint cadence"})
+
+    def _log_execute_wal(self, kind, slot, val, committed, vsn) -> None:
+        """WAL records for a bulk execute's committed inline writes
+        (shared by the sync path and the execute_async settle)."""
+        wmask = (((kind == eng.OP_PUT) | (kind == eng.OP_CAS))
+                 & committed)
+        js, es = np.nonzero(wmask)
+        recs = [(("kv", int(e), int(slot[j, e])),
+                 (None, int(val[j, e]), int(vsn[j, e, 0]),
+                  int(vsn[j, e, 1]), None, True))
+                for j, e in zip(js.tolist(), es.tolist())]
+        if recs:
+            self._wal.log(recs + self._wal_extra_records())
+
+    def execute_async(self, kind: np.ndarray, slot: np.ndarray,
+                      val: np.ndarray,
+                      exp_epoch: Optional[np.ndarray] = None,
+                      exp_seq: Optional[np.ndarray] = None) -> Future:
+        """Pipelined bulk array API: dispatch a ``[K, E]`` batch and
+        return a :class:`Future` resolving to ``(committed, get_ok,
+        found, value)`` (or ``'failed'`` on a failed launch / WAL
+        error).  The enqueue half returns immediately; the resolve
+        half (unpack, mirrors, WAL, corruption sweep) runs when a
+        later call — or an idle :meth:`flush` — settles the launch,
+        so up to ``pipeline_depth`` batches overlap: batch N's d2h
+        transfer + host resolve ride under batch N+1's device step.
+        Results resolve strictly in submission order.  Same
+        device-resident vs host-array contract (payload encoding,
+        WAL/RPO) as :meth:`execute`.
+        """
+        fut = Future()
+        if isinstance(kind, jax.Array):
+            self._note_dev_exec_unlogged()
+            k = int(kind.shape[0])
+            exec_wal = None
+            want_vsn = False
+            n_ops = k * self.n_ens
+            exp_e = exp_epoch
+            exp_s = exp_seq
+        else:
+            kind = np.asarray(kind, np.int32)
+            val = np.asarray(val, np.int32)
+            if ((kind == eng.OP_PUT) & (val < 0)).any():
+                raise ValueError(
+                    "negative put payloads are not encodable "
+                    "(int32 handles; 0 = tombstone/delete)")
+            k = int(kind.shape[0])
+            slot = np.asarray(slot, np.int32)
+            want_vsn = self._wal is not None
+            exec_wal = (kind, slot, val) if want_vsn else None
+            n_ops = int((kind != eng.OP_NOOP).sum())
+            exp_e = (None if exp_epoch is None
+                     else np.asarray(exp_epoch, np.int32))
+            exp_s = (None if exp_seq is None
+                     else np.asarray(exp_seq, np.int32))
+        # Same election-mirror discipline as the pipelined flush: an
+        # in-flight launch may be about to install a leader; electing
+        # again would re-version its objects.
+        elect, cand = self._election_inputs()
+        if elect.any() and self._inflight_launches:
+            self._drain_launches()
+            elect, cand = self._election_inputs()
+        try:
+            fl = self._launch_enqueue(kind, slot, val, k,
+                                      want_vsn=want_vsn, exp_e=exp_e,
+                                      exp_s=exp_s, elect=elect,
+                                      cand=cand)
+        except BaseException:
+            self._safe_resolve(fut, "failed")
+            raise
+        fl.exec_fut = fut
+        fl.exec_wal = exec_wal
+        fl.exec_ops = n_ops
+        self._inflight_launches.append(fl)
+        self._drain_launches(keep=self.pipeline_depth - 1)
+        return fut
+
     def flush(self) -> int:
-        """One device launch for everything queued; returns ops served."""
+        """One device launch for everything queued; returns ops served
+        (by launches SETTLED during this call).
+
+        With ``pipeline_depth`` > 1 the launch is only ENQUEUED here:
+        its packed result rides the d2h link and its host resolve
+        (unpack → WAL → future fan-out) runs while a LATER flush's
+        device step is already dispatched — up to ``pipeline_depth``
+        launches deep.  The WAL-before-ack barrier and submission
+        order are preserved (settles are strictly FIFO); only the ack
+        point moves later in wall time.  A flush that empties the
+        queues settles everything before returning, so flush-until-
+        done callers observe resolved futures exactly as at depth 1.
+        """
         active = self._active
         k = min(self.max_k,
                 max((self._queue_rounds[e] for e in active),
                     default=0))
-        if k == 0 and not self._election_inputs()[0].any():
-            return 0
+        served = 0
+        if k == 0:
+            # Idle flush: settle the launch pipeline first (callers
+            # that flush until done must observe resolved futures),
+            # then see whether an election-only launch is needed.
+            served += self._drain_launches()
+            if not self._election_inputs()[0].any():
+                # tail settles count toward maintenance too (their
+                # WAL records / flush count advanced just the same)
+                self._flush_maintenance()
+                return served
         # Bucket the batch depth to the next power of two (capped at
         # max_k): XLA compiles one program per distinct [K, E] shape,
         # so under skewed load a raw longest-queue K would trigger a
@@ -2302,31 +2595,127 @@ class BatchedEnsembleService:
                     j += 1
 
         self._active = still_active
+        # Elections plan from the HOST MIRRORS, which in-flight
+        # launches may still be about to update (a won election lands
+        # at resolve) — settle first, or the same ensemble re-elects
+        # and the epoch bump re-versions its objects on first read
+        # (spurious CAS failures).  Elections are rare; the
+        # steady-state pipelined path never takes this drain.
+        elect, cand = self._election_inputs()
+        if elect.any() and self._inflight_launches:
+            served += self._drain_launches()
+            elect, cand = self._election_inputs()
         try:
-            planes = self._launch(kind, slot, val, k, want_vsn=True,
-                                  exp_e=exp_e, exp_s=exp_s,
-                                  entries=taken)
+            fl = self._launch_enqueue(kind, slot, val, k,
+                                      want_vsn=True, exp_e=exp_e,
+                                      exp_s=exp_s, entries=taken,
+                                      elect=elect, cand=cand)
         except BaseException:
             # A failed device launch (XLA error, OOM, dead backend)
             # must not orphan the taken ops: clients would block on
             # their futures forever.  Fail them all — the reference's
             # request_failed path (worker crash -> step_down,
             # peer.erl:1274-1275) — then let the error propagate to
-            # whoever drives flush().  _launch already rolled the
-            # device state back, so the next flush starts clean.  The
-            # catch covers ONLY the launch: an exception from a
-            # client's future-waiter inside the resolve loop must not
-            # fail ops that committed on device.
+            # whoever drives flush().  The enqueue already rolled the
+            # device state back, so the next flush starts clean.
             for e, ops in taken:
                 for op in ops:
                     self._fail_entry(e, op)
             raise
+        fl.taken = taken
+        self._inflight_launches.append(fl)
+        # Settle: everything when the queues drained (nothing queued
+        # to overlap with), else down to depth-1 still in flight —
+        # the window the NEXT flush's enqueue overlaps.
+        keep = self.pipeline_depth - 1 if self._active else 0
+        served += self._drain_launches(keep=keep)
+        self._flush_maintenance()
+        return served
+
+    def _flush_maintenance(self) -> None:
+        """Post-settle upkeep shared by the normal and idle flush
+        paths: WAL compaction past the record bound, and the periodic
+        scrub against its flush-count watermark."""
+        if (self._wal is not None and not self._in_save
+                and self._wal.count >= self.wal_compact_records):
+            # WAL grew past the compaction bound: fold it into a fresh
+            # checkpoint (save() rotates the generation).
+            self.save()
+        if (self.scrub_every_flushes
+                and self.flushes - self._scrubbed_at_flush
+                >= self.scrub_every_flushes):
+            self.scrub()
+
+    # -- launch pipeline (two-phase async service execution) ---------------
+
+    def _drain_launches(self, keep: int = 0) -> int:
+        """Settle in-flight launches oldest-first until at most
+        ``keep`` remain; returns ops served.
+
+        A DEVICE-side settle failure abandons every LATER in-flight
+        launch too: the failed launch rolled the device state back to
+        ITS pre-launch snapshot, and the later launches consumed the
+        poisoned chain — their ops fail without touching state (their
+        snapshots postdate the poison).  A WAL-append failure is
+        different: the launch's device commits are REAL (its clients
+        got 'failed' — the allowed unacked-commit outcome — but the
+        device/host bookkeeping stands), so later launches keep
+        settling normally (abandoning them would release handles and
+        recycle slots the device still populates); the first disk
+        error re-raises to the flush driver after the drain."""
+        served = 0
+        wal_err: Optional[BaseException] = None
+        while len(self._inflight_launches) > keep:
+            fl = self._inflight_launches.popleft()
+            try:
+                n, err = self._settle_launch(fl)
+                served += n
+                if err is not None and wal_err is None:
+                    wal_err = err
+            except BaseException:
+                while self._inflight_launches:
+                    self._abandon_launch(self._inflight_launches.popleft())
+                raise
+        if wal_err is not None:
+            raise wal_err
+        return served
+
+    def _abandon_launch(self, fl: _InFlightLaunch) -> None:
+        """Fail a poisoned in-flight launch's clients (launch N < this
+        one failed and rolled the device state back under it)."""
+        if fl.exec_fut is not None:
+            self._safe_resolve(fl.exec_fut, "failed")
+        if fl.taken:
+            for e, ops in fl.taken:
+                for op in ops:
+                    self._fail_entry(e, op)
+
+    def _settle_launch(self, fl: _InFlightLaunch
+                       ) -> Tuple[int, Optional[BaseException]]:
+        """Resolve one in-flight launch end to end: block on its
+        packed result, then WAL-log and fan out its futures (the
+        durability barrier stands — WAL before any ack).  Returns
+        (ops served, wal error or None) — a WAL failure is reported,
+        not raised, so the drain can keep settling later launches
+        whose device commits are independent of this one's disk
+        error (see :meth:`_drain_launches`)."""
+        rec = fl.rec
+        wait_key = ("inflight_wait" if self.pipeline_depth > 1
+                    else "device_d2h")
+        try:
+            planes = self._launch_resolve(fl, wait_key=wait_key)
+        except BaseException:
+            self._abandon_launch(fl)
+            raise
+        if fl.exec_fut is not None:
+            return self._settle_execute(fl, planes)
+        taken = fl.taken or []
         # Durability barrier: committed writes reach the WAL (synced
         # per wal_sync) BEFORE any future resolves — the never-ack-
         # unpersisted-writes contract (basic_backend.erl:120-125).  If
         # the WAL write itself fails, the commits stand on device (the
-        # bookkeeping below proceeds) but their clients get 'failed' —
-        # an unacked commit is an allowed linearizable outcome; a lost
+        # bookkeeping proceeds) but their clients get 'failed' — an
+        # unacked commit is an allowed linearizable outcome; a lost
         # acked one is not — and the disk error propagates to the
         # flush driver.
         wal_err: Optional[BaseException] = None
@@ -2340,12 +2729,10 @@ class BatchedEnsembleService:
         served = self._resolve_flush(taken, planes,
                                      ack=wal_err is None)
         t_end = time.perf_counter()
-        # Finish the breakdown _launch recorded: oldest-op queue wait,
-        # WAL append+sync, per-future resolve.  Per-component
+        # Finish the breakdown the launch recorded: oldest-op queue
+        # wait, WAL append+sync, per-future resolve.  Per-component
         # percentiles over these records are what makes a p99 target
         # analyzable (VERDICT r2 weak #2).
-        rec = self._lat_last
-        self._lat_last = {}
         oldest = min((op.t_enq for _e, ops in taken for op in ops
                       if op.t_enq), default=t_wal)
         rec["queue_wait"] = max(0.0, t_wal - oldest
@@ -2353,18 +2740,30 @@ class BatchedEnsembleService:
         rec["wal"] = t_res - t_wal
         rec["resolve"] = t_end - t_res
         rec["total"] = sum(v for c, v in rec.items()
-                           if c not in ("k", "total"))
-        if wal_err is not None:
-            raise wal_err
-        if (self._wal is not None and not self._in_save
-                and self._wal.count >= self.wal_compact_records):
-            # WAL grew past the compaction bound: fold it into a fresh
-            # checkpoint (save() rotates the generation).
-            self.save()
-        if (self.scrub_every_flushes
-                and self.flushes % self.scrub_every_flushes == 0):
-            self.scrub()
-        return served
+                           if c not in ("k", "total", "enqueue"))
+        return served, wal_err
+
+    def _settle_execute(self, fl: _InFlightLaunch, planes
+                        ) -> Tuple[int, Optional[BaseException]]:
+        """Resolve one ``execute_async`` launch: WAL-log committed
+        writes (host-array path; the resolution IS the ack), then
+        resolve the client future with the result planes.  Same
+        (served, wal error) reporting contract as
+        :meth:`_settle_launch` — an unpersisted commit may never be
+        acked (the future resolves 'failed'), but later launches'
+        settles proceed."""
+        committed, get_ok, found, value, vsn = planes
+        if fl.exec_wal is not None and self._wal is not None:
+            kind, slot, val = fl.exec_wal
+            try:
+                self._log_execute_wal(kind, slot, val, committed, vsn)
+            except Exception as exc:
+                self._safe_resolve(fl.exec_fut, "failed")
+                return 0, exc
+        self.ops_served += fl.exec_ops
+        self._safe_resolve(fl.exec_fut,
+                           (committed, get_ok, found, value))
+        return fl.exec_ops, None
 
     def _wal_extra_records(self) -> List[Tuple[Any, Any]]:
         """Records a subclass wants persisted in the SAME durability

@@ -312,18 +312,29 @@ def dump_meta(svc: BatchedEnsembleService) -> Tuple:
     return (tuple(vecs), host)
 
 
+def meta_dynamic(meta: Tuple) -> bool:
+    """The lifecycle-mode flag carried by a :func:`dump_meta` tuple —
+    checkable WITHOUT applying anything (handle_tpatch validates it
+    before the first mutation)."""
+    return bool(meta[1][1])
+
+
 def install_meta(svc: BatchedEnsembleService, meta: Tuple) -> None:
     import jax.numpy as jnp
 
     vecs, host = meta
+    (leader_b, dynamic, live_b, free_rows, ens_names, member_b,
+     next_handle) = host
+    if bool(dynamic) != svc.dynamic:
+        # validate BEFORE any mutation (ADVICE r5): assigning the
+        # leader's control-plane vectors and THEN failing would leave
+        # this lane holding them over its own object planes at its
+        # old (ge, seq) — mixed state a campaign could serve from
+        raise ValueError("lifecycle-mode mismatch in tree patch")
     new = {name: jnp.asarray(
         np.frombuffer(raw, np.dtype(dt)).reshape(shape))
         for name, dt, shape, raw in vecs}
     svc.state = svc.state._replace(**new)
-    (leader_b, dynamic, live_b, free_rows, ens_names, member_b,
-     next_handle) = host
-    if bool(dynamic) != svc.dynamic:
-        raise ValueError("lifecycle-mode mismatch in tree patch")
     svc.leader_np = _unpack_i32(leader_b, (svc.n_ens,))
     svc.member_np = _unpack_bool(
         member_b, svc.n_ens * svc.n_peers).reshape(svc.n_ens,
@@ -519,14 +530,15 @@ class ReplicaCore:
         exp_e = _unpack_i32(exp_e_b, (k, e_n))
         exp_s = _unpack_i32(exp_s_b, (k, e_n))
         cand = np.zeros((e_n,), np.int32)
-        # unbound base call: a ReplicatedService in the replica role
-        # must apply through the PLAIN launch (its own override would
-        # try to re-replicate / demand leadership)
+        # unbound base calls: a ReplicatedService in the replica role
+        # must apply through the PLAIN launch halves (its own
+        # overrides would try to re-replicate / demand leadership)
+        fl = BatchedEnsembleService._launch_enqueue(
+            svc, kind, slot, val, k, want_vsn=want_vsn,
+            exp_e=exp_e, exp_s=exp_s, elect=elect, cand=cand,
+            lease_ok=lease_ok)
         committed, _get_ok, _found, _value, vsn = \
-            BatchedEnsembleService._launch(
-                svc, kind, slot, val, k, want_vsn=want_vsn,
-                exp_e=exp_e, exp_s=exp_s, elect=elect, cand=cand,
-                lease_ok=lease_ok)
+            BatchedEnsembleService._launch_resolve(svc, fl)
         crc = result_crc(committed, vsn)
 
         # Durability barrier: this host's WAL carries every committed
@@ -736,7 +748,9 @@ class ReplicaCore:
                 (int(expect[0]), int(expect[1])):
             return ("nack", "seq", self.promised, self.applied_ge,
                     self.applied_seq)
-        install_meta(svc, meta)
+        if meta_dynamic(meta) != svc.dynamic:
+            # reject before the FIRST mutation — see install_meta
+            raise ValueError("lifecycle-mode mismatch in tree patch")
         if patches:
             e_j = jnp.asarray(np.asarray([p[0] for p in patches],
                                          np.int32))
@@ -759,6 +773,13 @@ class ReplicaCore:
             for e, s, _ep, _sq, _vl, key, handle, payload in patches:
                 self._mirror_patch(int(e), int(s), key, int(handle),
                                    payload)
+        # control-plane vectors land LAST (ADVICE r5): an exception
+        # anywhere above leaves this lane's (ge, seq) markers — and
+        # its ballot/view vectors — untouched, so the replica is
+        # still a consistently-frozen nacker and the leader's
+        # full-install fallback heals it, instead of a lane holding
+        # the leader's control plane over its own object planes.
+        install_meta(svc, meta)
         rebuild_derived(svc)
         self.promised = max(self.promised, int(ge))
         self.applied_ge, self.applied_seq = int(ge), int(seq)
@@ -807,11 +828,15 @@ class _Encoded:
 
 
 class _Ticket:
-    __slots__ = ("event", "result")
+    __slots__ = ("event", "result", "posted")
 
     def __init__(self) -> None:
         self.event = threading.Event()
         self.result: Any = None
+        #: post time — lets the receiver's idle-timeout handling tell
+        #: a genuinely-overdue response (posted >= IO_TIMEOUT ago)
+        #: from a request that arrived DURING the blocked recv
+        self.posted = time.monotonic()
 
 
 class _PendingFlush:
@@ -938,13 +963,59 @@ class PeerLink:
                 # _drop fails everything outstanding either way
                 self._drop(fail_also=ticket)
 
+    #: sentinel: the receive timed out before ANY byte arrived
+    _IDLE = object()
+
+    @classmethod
+    def _recv_frame_or_idle(cls, sock: socket.socket):
+        """``recv_frame`` that distinguishes an IDLE timeout (no byte
+        of the next frame has arrived — benign on a quiet link) from a
+        mid-frame timeout (bytes consumed, stream now desynced — a
+        real failure).  Returns ``_IDLE`` for the former."""
+        try:
+            first = sock.recv(1)
+        except socket.timeout:
+            return cls._IDLE
+        if not first:
+            raise ConnectionError("peer closed")
+        (length,) = _HDR.unpack(first + _recv_exact(sock,
+                                                    _HDR.size - 1))
+        if length > _MAX_FRAME:
+            raise wire.WireError(f"frame too large: {length}")
+        return wire.decode(_recv_exact(sock, length))
+
     def _recv_loop(self, sock: socket.socket, gen: int) -> None:
         while True:
             try:
-                resp = recv_frame(sock)
+                resp = self._recv_frame_or_idle(sock)
             except (OSError, ConnectionError, wire.WireError):
                 if gen == self._gen:
                     self._drop()
+                return
+            if resp is self._IDLE:
+                # Idle-socket timeout (IO_TIMEOUT with nothing in
+                # flight): on a quiet link — a stepped-down ex-leader,
+                # a leader with no client load and no heartbeat — this
+                # fires every 120 s, and treating it as a link failure
+                # forced a full re-sync reconnect each time (ADVICE
+                # r5).  Benign when nothing is OVERDUE: no outstanding
+                # response, or the oldest outstanding request was
+                # posted DURING this blocked recv (its response hasn't
+                # had IO_TIMEOUT to arrive yet — dropping would fail a
+                # fresh request against a healthy peer).  A response
+                # outstanding for a full IO_TIMEOUT (or a mid-frame
+                # timeout) still drops the link — that peer really is
+                # wedged; worst-case wedge detection is therefore
+                # 2×IO_TIMEOUT.
+                with self._alock:
+                    oldest = (self._awaiting[0].posted
+                              if self._awaiting else None)
+                if gen != self._gen:
+                    return
+                if oldest is None or \
+                        time.monotonic() - oldest < self.IO_TIMEOUT:
+                    continue
+                self._drop()
                 return
             with self._alock:
                 # a stale receiver (its connection already dropped and
@@ -1045,7 +1116,7 @@ class ReplicatedService(BatchedEnsembleService):
                  peers: Sequence[Tuple[str, int]] = (),
                  ack_timeout: float = 2.0,
                  install_timeout: float = 60.0,
-                 pipeline_depth: int = 4,
+                 repl_window: int = 4,
                  self_addr: Optional[Tuple[str, int]] = None,
                  **kw) -> None:
         # the (runtime, n_ens, n_peers, n_slots) positional prefix
@@ -1084,11 +1155,12 @@ class ReplicatedService(BatchedEnsembleService):
         self._last_quorum_ok = True
         self._links: List[PeerLink] = [
             PeerLink(h, p, lambda: self._ge) for h, p in peers]
-        #: replication pipeline: shipped-but-unsettled flushes, oldest
-        #: first; at most pipeline_depth deep before the ship path
-        #: blocks on the head entry (per-flush quorum barrier stands —
-        #: futures resolve only at settlement)
-        self.pipeline_depth = max(1, int(pipeline_depth))
+        #: replication window: shipped-but-unsettled flushes, oldest
+        #: first; at most repl_window deep before the ship path blocks
+        #: on the head entry (per-flush quorum barrier stands —
+        #: futures resolve only at settlement).  Distinct from the
+        #: base service's pipeline_depth (the DEVICE launch pipeline).
+        self.repl_window = max(1, int(repl_window))
         self._pending_flushes: "deque[_PendingFlush]" = deque()
         self._unclaimed: Optional[_PendingFlush] = None
         #: replication observability
@@ -1112,6 +1184,7 @@ class ReplicatedService(BatchedEnsembleService):
         group epoch.  Returns True on success; False when no majority
         granted (insufficient reachable replicas — the group cannot
         safely elect, exactly the minority-partition case)."""
+        self._drain_launches()  # settle the device launch pipeline
         self._drain_pending(block_all=True)  # settle any prior reign
         deadline = time.monotonic() + timeout
         ge = max(self._ge, self.core.promised) + 1
@@ -1312,6 +1385,7 @@ class ReplicatedService(BatchedEnsembleService):
         current = self._member_addrs()
         if set(new) == set(current):
             return
+        self._drain_launches()
         self._drain_pending(block_all=True)
         cver = self.core.cfg[0]
         if self.core.cfg[1] is None:
@@ -1400,6 +1474,7 @@ class ReplicatedService(BatchedEnsembleService):
         latest-config-in-the-log rule: the leader counts the commit
         under the config being written (for a joint record that is
         maj(old) AND maj(new); for the collapse record maj(new))."""
+        self._drain_launches()
         self._drain_pending(block_all=True)
         seq = self._grp_seq + 1
         hosts_t = _norm_addrs(hosts)
@@ -1481,13 +1556,20 @@ class ReplicatedService(BatchedEnsembleService):
 
     # -- the replicated launch ----------------------------------------------
 
-    def _launch(self, kind, slot, val, k, want_vsn,
-                exp_e=None, exp_s=None, entries=None, elect=None,
-                cand=None, lease_ok=None):
+    def _launch_enqueue(self, kind, slot, val, k, want_vsn,
+                        exp_e=None, exp_s=None, entries=None,
+                        elect=None, cand=None, lease_ok=None):
+        """Replicated ENQUEUE half: ship the apply frame to every
+        replica link (their remote launches overlap ours), then
+        dispatch the local launch through the base enqueue half.  The
+        group seq / ticket bookkeeping rides on the in-flight record;
+        the resolve half turns it into a pipelined commit barrier —
+        so a service-level ``pipeline_depth`` > 1 overlaps device
+        rounds with host resolve on a replication-group leader too."""
         if not self._links and self.group_size == 1:
-            return super()._launch(kind, slot, val, k, want_vsn,
-                                   exp_e, exp_s, entries, elect, cand,
-                                   lease_ok)
+            return super()._launch_enqueue(kind, slot, val, k, want_vsn,
+                                           exp_e, exp_s, entries, elect,
+                                           cand, lease_ok)
         if not self.is_leader:
             raise DeposedError(
                 "not the group leader (takeover() not run, or this "
@@ -1569,9 +1651,9 @@ class ReplicatedService(BatchedEnsembleService):
             sends.append((link, link.post(frame)))
 
         try:
-            out = super()._launch(kind, slot, val, k, want_vsn,
-                                  exp_e, exp_s, None, elect, cand,
-                                  lease_ok)
+            fl = super()._launch_enqueue(kind, slot, val, k, want_vsn,
+                                         exp_e, exp_s, None, elect,
+                                         cand, lease_ok)
         except BaseException:
             # local launch failed AFTER the batch was shipped: any
             # replica that applied seq N is now ahead of us — roll
@@ -1580,23 +1662,44 @@ class ReplicatedService(BatchedEnsembleService):
             for link in self._links:
                 link.needs_sync = True
             raise
+        # the seq advances at ENQUEUE (later pipelined launches must
+        # ship strictly increasing seqs); the core's applied position
+        # advances only at resolve, in settle order
         self._grp_seq = seq
+        fl.grp_seq = seq
+        fl.grp_sends = sends
+        return fl
+
+    def _launch_resolve(self, fl, wait_key="device_d2h"):
+        """Replicated RESOLVE half: finish the local launch, then
+        stash the flush's replication tickets as a pending entry for
+        the PIPELINED commit barrier (VERDICT r4 weak #5): the acks
+        are NOT awaited here.  The flush's client futures resolve only
+        once its host-quorum outcome is known (_settle_entry — the
+        per-flush barrier stands), but the NEXT flush's build, ship
+        and local launch overlap this one's ack wait, so replication
+        throughput is bounded by the replica apply pipeline, not by
+        RTT + apply per flush.  _resolve_flush claims this entry and
+        attaches the futures/planes; heartbeat()-style direct
+        launches leave taken=None (nothing to resolve)."""
+        sends = getattr(fl, "grp_sends", None)
+        if sends is None:
+            # single-lane mode / replica role: the plain resolve
+            return super()._launch_resolve(fl, wait_key)
+        try:
+            out = super()._launch_resolve(fl, wait_key)
+        except BaseException:
+            # replicas already applied a seq our rolled-back local
+            # state never kept — re-sync before they count again
+            for link in self._links:
+                link.needs_sync = True
+            raise
         committed, _g, _f, _v, vsn = out
         crc = result_crc(committed, vsn)
         self.core.applied_ge = self._ge
-        self.core.applied_seq = seq
+        self.core.applied_seq = fl.grp_seq
         self.core.last_crc = crc
-
-        # PIPELINED commit barrier (VERDICT r4 weak #5): the acks are
-        # NOT awaited here.  The flush's client futures resolve only
-        # once its host-quorum outcome is known (_settle_entry — the
-        # per-flush barrier stands), but the NEXT flush's build, ship
-        # and local launch overlap this one's ack wait, so replication
-        # throughput is bounded by the replica apply pipeline, not by
-        # RTT + apply per flush.  _resolve_flush claims this entry and
-        # attaches the futures/planes; heartbeat()-style direct
-        # launches leave taken=None (nothing to resolve).
-        entry = _PendingFlush(seq, crc, sends,
+        entry = _PendingFlush(fl.grp_seq, crc, sends,
                               time.monotonic() + self.ack_timeout)
         self._pending_flushes.append(entry)
         self._unclaimed = entry
@@ -1608,6 +1711,19 @@ class ReplicatedService(BatchedEnsembleService):
         # adopt an older replica state over its own acked writes).
         # Data-less launches (heartbeats, pure reads) skip it: adopting
         # a state that differs only by empty batches loses nothing.
+        return out
+
+    def _settle_execute(self, fl, planes):
+        """Bulk execute_async resolves directly to its caller (no
+        host-quorum gate, matching the sync ``execute`` contract on a
+        replicated leader); the pending entry this launch stashed
+        settles with nothing to claim — but it must still settle, or
+        a pure execute_async workload would grow _pending_flushes
+        unboundedly and defer the ack-side bookkeeping (needs_sync,
+        depose detection) indefinitely."""
+        self._unclaimed = None
+        out = super()._settle_execute(fl, planes)
+        self._drain_pending(down_to=self.repl_window)
         return out
 
     # -- incremental (Merkle) catch-up: leader side -------------------------
@@ -1741,7 +1857,7 @@ class ReplicatedService(BatchedEnsembleService):
         linearizability under partition).  The entry the immediately
         preceding ``_launch`` stashed claims the futures/planes; the
         drain settles entries strictly in flush order, blocking only
-        when the pipeline is deeper than ``pipeline_depth``."""
+        when the pipeline is deeper than ``repl_window``."""
         entry = self._unclaimed
         if entry is None:
             # single-lane mode / replica role: the plain barrier
@@ -1750,7 +1866,7 @@ class ReplicatedService(BatchedEnsembleService):
         self._unclaimed = None
         entry.taken, entry.planes = taken, planes
         entry.ack, entry.ack_reads = ack, ack_reads
-        self._drain_pending(down_to=self.pipeline_depth)
+        self._drain_pending(down_to=self.repl_window)
         return 0
 
     def _drain_pending(self, block_all: bool = False,
@@ -1850,6 +1966,7 @@ class ReplicatedService(BatchedEnsembleService):
                 while self._active:
                     super().flush()
                     self._drain_pending(block_all=True)
+                self._drain_launches()
                 self._drain_pending(block_all=True)
             finally:
                 self._in_save = False
@@ -1862,6 +1979,9 @@ class ReplicatedService(BatchedEnsembleService):
         this for free from real flushes; idle ones need the beat or a
         restarted replica would stay stale until the next client op.
         Returns the host-quorum outcome (the pipeline fully settled)."""
+        # a direct sync launch must not overtake unsettled pipelined
+        # launches (settles are strictly FIFO in seq order)
+        self._drain_launches()
         z = np.zeros((0, self.n_ens), np.int32)
         elect, cand = self._election_inputs()
         lease_ok = self.lease_until > self.runtime.now
@@ -1908,8 +2028,10 @@ class ReplicatedService(BatchedEnsembleService):
             return None, super().destroy_ensemble(name)
         if not self.is_leader:
             raise DeposedError("not the group leader")
-        # lifecycle is synchronous: settle the pipeline so the sync
-        # flags it reads (and the acks it counts) are current
+        # lifecycle is synchronous: settle BOTH pipelines (device
+        # launches, then replication acks) so the sync flags it reads
+        # (and the acks it counts) are current
+        self._drain_launches()
         self._drain_pending(block_all=True)
         seq = self._grp_seq + 1
         view_b = None if view is None else _pack_bool(
@@ -1949,6 +2071,7 @@ class ReplicatedService(BatchedEnsembleService):
             return super().install_objs(ens, items)
         if not self.is_leader:
             raise DeposedError("not the group leader")
+        self._drain_launches()
         self._drain_pending(block_all=True)
         results, applied = self._allocate_install(int(ens), items)
         if not applied:
@@ -1984,13 +2107,14 @@ class ReplicatedService(BatchedEnsembleService):
             "size": self.group_size,
             "peers_connected": sum(l.connected for l in self._links),
             "peers_synced": sum(not l.needs_sync for l in self._links),
-            "pipeline_depth": self.pipeline_depth,
+            "repl_window": self.repl_window,
             "pipeline_pending": len(self._pending_flushes),
             **self.group_stats,
         }
         return s
 
     def stop(self) -> None:
+        self._drain_launches()
         self._drain_pending(block_all=True)
         super().stop()
         for link in self._links:

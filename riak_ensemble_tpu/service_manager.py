@@ -328,20 +328,38 @@ class ServiceReconciler:
         """Snapshot a tenant's keyed data — WITH versions — from the
         host mirrors + one device gather; synchronous (no flush),
         which is what makes export+destroy atomic within one tick.
-        Entries are (key, payload, (epoch, seq)); versions read from
-        the leader's lane (or lane 0 with no leader), the committed
-        copy the reference's trees would sync metadata for."""
+        Entries are (key, payload, (epoch, seq)).
+
+        Versions are the per-slot MAX (epoch, seq) across the UP
+        member lanes (ADVICE r5): on a leaderless row, lane 0 can
+        lag a quorum-committed write (e.g. it was down when the write
+        committed), and exporting its stale version would pair the
+        newest payload with an old (epoch, seq) — CAS tokens minted
+        from the true version would then fail after the install, the
+        exact continuity the handoff exists to preserve.  Any
+        quorum-committed version is held by at least one up lane of
+        the committing quorum, so the masked lexicographic max is the
+        committed version (a live leader's lane can never exceed it).
+        """
         svc = self.svc
         items = [(key, slot) for key, slot in svc.key_slot[ens].items()
                  if svc.slot_handle[ens].get(slot, 0)]
         if not items:
             return []
-        lane = int(svc.leader_np[ens])
-        if lane < 0:
-            lane = 0
         slots = np.asarray([s for _k, s in items], np.int32)
-        eps = np.asarray(svc.state.obj_epoch[ens, lane])[slots]
-        sqs = np.asarray(svc.state.obj_seq[ens, lane])[slots]
+        lanes = svc.up[ens] & svc.member_np[ens]        # [M]
+        if not lanes.any():
+            lanes = svc.member_np[ens].copy()
+        if not lanes.any():
+            lanes[0] = True
+        eps_l = np.asarray(svc.state.obj_epoch[ens])[:, slots]  # [M, n]
+        sqs_l = np.asarray(svc.state.obj_seq[ens])[:, slots]
+        mask = lanes[:, None]
+        # lexicographic max: epoch first, then seq among max-epoch
+        # lanes; a slot with no copy on any masked lane exports (0, 0)
+        eps = np.maximum(np.where(mask, eps_l, -1).max(0), 0)   # [n]
+        sqs = np.maximum(np.where(mask & (eps_l == eps[None, :]),
+                                  sqs_l, -1).max(0), 0)
         out = []
         for (key, slot), ve, vs in zip(items, eps, sqs):
             h = svc.slot_handle[ens][slot]

@@ -1,0 +1,198 @@
+"""Unit tests for the leader↔replica link and catch-up codecs
+(ADVICE r5 regressions): idle-socket timeouts must not tear quiet
+links down, and a tree-patch's control-plane meta must validate
+before — and apply after — everything else.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from riak_ensemble_tpu import wire  # noqa: E402
+from riak_ensemble_tpu.config import fast_test_config  # noqa: E402
+from riak_ensemble_tpu.parallel import repgroup  # noqa: E402
+from riak_ensemble_tpu.parallel.batched_host import (  # noqa: E402
+    BatchedEnsembleService, WallRuntime,
+)
+
+
+def _frame_bytes(value) -> bytes:
+    payload = wire.encode(value)
+    return struct.Struct(">I").pack(len(payload)) + payload
+
+
+class _FakeSock:
+    """Scripted socket: each entry is bytes to serve, a 'timeout'
+    sentinel, or an exception instance to raise."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.buf = b""
+
+    def recv(self, n):
+        if not self.buf:
+            if not self.script:
+                raise ConnectionError("script exhausted")
+            item = self.script.pop(0)
+            if item == "timeout":
+                raise socket.timeout("timed out")
+            if isinstance(item, Exception):
+                raise item
+            if isinstance(item, tuple) and item[0] == "wait":
+                # block until the test's gate opens, then serve
+                _tag, event, data = item
+                event.wait(5.0)
+                item = data
+            self.buf = item
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+
+def _make_link():
+    # never connects (no server) — we drive _recv_loop directly; the
+    # sender thread just idles on its queue
+    link = repgroup.PeerLink("127.0.0.1", 1, lambda: 1)
+    link.connected = True
+    return link
+
+
+def test_idle_timeout_with_empty_awaiting_keeps_link():
+    """ADVICE r5: a 120 s idle-socket timeout on a link with NOTHING
+    outstanding is benign — dropping it forced a full re-sync
+    reconnect per idle period on quiet links (stepped-down
+    ex-leaders, idle leaders)."""
+    link = _make_link()
+    link.needs_sync = False
+    gen = link._gen
+    t = repgroup._Ticket()
+    gate = threading.Event()
+    sock = _FakeSock([
+        "timeout",                               # idle: must NOT drop
+        # the response arrives only after the test queued its ticket
+        ("wait", gate, _frame_bytes(("applied", 1, 1, 0))),
+        ConnectionError("closed"),               # end the loop
+    ])
+
+    th = threading.Thread(target=link._recv_loop, args=(sock, gen),
+                          daemon=True)
+    th.start()
+    # wait until the loop survived the idle timeout AND re-entered
+    # recv (it popped the gated entry — only the terminal error
+    # remains scripted), then queue the ticket and let the response
+    # through
+    deadline = time.monotonic() + 5.0
+    while len(sock.script) > 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    with link._alock:
+        link._awaiting.append(t)
+    gate.set()
+    assert t.event.wait(5.0), "response never paired"
+    assert t.result == ("applied", 1, 1, 0)
+    th.join(5.0)
+    # the idle timeout neither dropped nor desynced the link: the
+    # final ConnectionError is what tore it down
+    assert link.needs_sync  # set by the terminal drop only
+    link.close()
+
+
+def test_idle_timeout_with_overdue_request_drops():
+    """A timeout while a response has been outstanding for a full
+    IO_TIMEOUT means the peer is wedged — that still drops the link
+    (and fails the ticket)."""
+    link = _make_link()
+    gen = link._gen
+    t = repgroup._Ticket()
+    t.posted = time.monotonic() - link.IO_TIMEOUT - 1.0  # overdue
+    with link._alock:
+        link._awaiting.append(t)
+    sock = _FakeSock(["timeout"])
+    link._recv_loop(sock, gen)
+    assert t.event.is_set() and t.result is None
+    assert not link.connected and link.needs_sync
+    link.close()
+
+
+def test_idle_timeout_with_fresh_request_keeps_link():
+    """A request posted DURING the blocked recv (the closing instant
+    of the idle window) is not overdue: the timeout keeps listening
+    instead of failing a fresh request against a healthy peer."""
+    link = _make_link()
+    link.needs_sync = False
+    gen = link._gen
+    t = repgroup._Ticket()  # posted just now — not overdue
+    with link._alock:
+        link._awaiting.append(t)
+    sock = _FakeSock([
+        "timeout",
+        _frame_bytes(("applied", 2, 2, 0)),  # the response arrives
+        ConnectionError("closed"),
+    ])
+    link._recv_loop(sock, gen)
+    assert t.event.is_set() and t.result == ("applied", 2, 2, 0)
+    link.close()
+
+
+def test_mid_frame_timeout_drops_even_when_idle():
+    """A timeout AFTER bytes of a frame arrived desyncs the stream —
+    always a drop, idle or not."""
+    link = _make_link()
+    gen = link._gen
+    half_frame = _frame_bytes(("applied", 1, 1, 0))[:3]
+    sock = _FakeSock([half_frame, "timeout"])
+    link._recv_loop(sock, gen)
+    assert not link.connected
+    link.close()
+
+
+def _mk_svc(dynamic=False):
+    return BatchedEnsembleService(WallRuntime(), 4, 1, 8, tick=None,
+                                  config=fast_test_config(),
+                                  dynamic=dynamic)
+
+
+def test_install_meta_validates_mode_before_mutating():
+    """ADVICE r5: a lifecycle-mode mismatch must fail BEFORE the
+    leader's control-plane vectors land — a half-applied meta leaves
+    the replica campaigning with mixed state."""
+    src = _mk_svc(dynamic=True)
+    dst = _mk_svc(dynamic=False)
+    # make the source's control plane visibly different
+    src.create_ensemble("t0")
+    meta = repgroup.dump_meta(src)
+    assert repgroup.meta_dynamic(meta) is True
+    before = dst.state
+    with pytest.raises(ValueError, match="lifecycle-mode mismatch"):
+        repgroup.install_meta(dst, meta)
+    # NOTHING was applied: same state object, untouched mirrors
+    assert dst.state is before
+    assert not dst.dynamic
+    src.stop()
+    dst.stop()
+
+
+def test_handle_tpatch_rejects_mode_mismatch_before_patches():
+    """The tpatch handler rejects a mismatched meta before applying
+    object patches — the frozen replica stays consistently frozen
+    (still nacking at its old position) for the full-install
+    fallback."""
+    leader = _mk_svc(dynamic=True)
+    leader.create_ensemble("t0")
+    replica = _mk_svc(dynamic=False)
+    core = repgroup.ReplicaCore(replica)
+    state_before = replica.state
+    patches = [(0, 0, 7, 7, 42, "k", 5, b"x")]
+    frame = ("tpatch", 1, 1, (0, 0), repgroup.dump_meta(leader),
+             patches)
+    with pytest.raises(ValueError, match="lifecycle-mode mismatch"):
+        core.handle_tpatch(frame)
+    # the object patch did NOT land either
+    assert replica.state is state_before
+    assert (core.applied_ge, core.applied_seq) == (0, 0)
+    leader.stop()
+    replica.stop()

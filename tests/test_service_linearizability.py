@@ -120,12 +120,27 @@ def _submit_batch(rng, svc, models, vals, vsns, seed):
 
 @pytest.mark.parametrize("seed", conftest.soak_seeds([701, 702, 703, 704, 705, 706]))
 def test_service_linearizable_under_nemesis(seed):
+    _nemesis_sweep(seed, pipeline_depth=1)
+
+
+@pytest.mark.parametrize("seed", conftest.soak_seeds([711, 712, 713]))
+def test_service_linearizable_under_nemesis_pipelined(seed):
+    """The SAME nemesis sweep through the depth-2 launch pipeline
+    (max_ops_per_tick=4 so rounds split across overlapped flushes):
+    the async path must stay linearizable — results in submission
+    order, WAL-free acks still quorum-gated, elections folded
+    correctly after the pre-elect drain."""
+    _nemesis_sweep(seed, pipeline_depth=2, max_k=4)
+
+
+def _nemesis_sweep(seed, pipeline_depth, max_k=8):
     rng = np.random.default_rng(seed)
     runtime = Runtime(seed=seed)
     config = fast_test_config()
     svc = BatchedEnsembleService(runtime, N_ENS, N_PEERS, n_slots=8,
-                                 tick=None, max_ops_per_tick=8,
-                                 config=config)
+                                 tick=None, max_ops_per_tick=max_k,
+                                 config=config,
+                                 pipeline_depth=pipeline_depth)
     models = {(e, k): KeyModel(f"{e}/key{k}")
               for e in range(N_ENS) for k in range(N_KEYS)}
     vals = itertools.count(1)

@@ -303,3 +303,61 @@ def test_versions_survive_tenant_handoff():
     rec1.stop()
     svc0.stop()
     svc1.stop()
+
+
+def test_leaderless_export_pairs_payload_with_committed_version():
+    """ADVICE r5 regression: _export on a LEADERLESS row must not read
+    versions from lane 0 — lane 0 can lag a quorum-committed write
+    (it was down when the write committed), and pairing the newest
+    payload with its stale (epoch, seq) voids every CAS token minted
+    from the true version after the install.  The export must carry
+    the per-slot max (epoch, seq) across up lanes."""
+    from riak_ensemble_tpu.runtime import Runtime
+
+    runtime = Runtime(seed=42)
+    svc = BatchedEnsembleService(runtime, 4, N_PEERS, N_SLOTS,
+                                 tick=0.005,
+                                 config=fast_test_config(),
+                                 dynamic=True)
+    ens = svc.create_ensemble("t0")
+    assert ens is not None
+
+    def settle(fut, t=30.0):
+        return runtime.await_future(fut, t)
+
+    # v1 commits on every lane
+    assert settle(svc.kput(ens, "k", b"v1"))[0] == "ok"
+    # lane 0 goes down; v2 commits on the surviving quorum only —
+    # lane 0's device copy now holds v1's stale (epoch, seq)
+    svc.set_peer_up(ens, 0, False)
+    r = settle(svc.kput(ens, "k", b"v2"))
+    assert r[0] == "ok", r
+    token = settle(svc.kget_vsn(ens, "k"))
+    assert token[0] == "ok" and token[1] == b"v2"
+    vsn = token[2]
+
+    # the export-time window: no leader (e.g. mid-failover)
+    svc.leader_np[ens] = -1
+    rec = sm.ServiceReconciler(runtime, None, svc, "svc@x",
+                               lambda _n: None, poll=None)
+    data = rec._export(ens)
+    by_key = {e[0]: e for e in data}
+    assert by_key["k"][1] == b"v2"
+    # THE regression: the exported version is the committed one, not
+    # lane 0's stale copy
+    assert tuple(by_key["k"][2]) == tuple(vsn), (by_key["k"], vsn)
+
+    # and the CAS token survives the export → install move
+    svc2 = BatchedEnsembleService(runtime, 4, N_PEERS, N_SLOTS,
+                                  tick=0.005,
+                                  config=fast_test_config(),
+                                  dynamic=True)
+    row = svc2.create_ensemble("t0")
+    res = svc2.install_objs(row, [(key, ver, payload)
+                                  for key, payload, ver in data])
+    assert all(r[0] == "ok" for r in res)
+    r = settle(svc2.kupdate(row, "k", vsn, b"v3"))
+    assert r[0] == "ok", r
+    assert settle(svc2.kget(row, "k")) == ("ok", b"v3")
+    svc.stop()
+    svc2.stop()
