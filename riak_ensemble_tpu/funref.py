@@ -12,16 +12,49 @@ Live callables still pass through :func:`resolve` untouched, so
 in-process tests (and the root leader's local gossip kmodify) can keep
 using real closures; they simply are not wire-encodable, same as any
 other local-only message.
+
+**The device mod-fun table.**  A registered name may additionally be
+*device-expressible*: a small fixed family of int32 modify functions
+(add/sub/max/min/set/band/bor/bxor with one bound int32 operand, plus
+put-if-absent) that the batched engine can run INSIDE a consensus
+round as an ``OP_RMW`` op — the fun code rides the op's ``exp_epoch``
+plane and the operand its ``val`` plane (:mod:`..ops.engine`).  The
+service's kmodify fast-paths funrefs that resolve to table entries:
+the read, the fun and the commit fuse into ONE device round under the
+round's seq discipline, so device RMWs can never CAS-conflict (the
+same reason the reference's kmodify runs its mod-fun inside the
+leader's FSM, ``riak_ensemble_peer.erl:303-317``).  Every table entry
+is ALSO registered as an ordinary host mod-fun with bit-identical
+int32 (wraparound) semantics, so the host-fallback path — and the
+device/host equivalence tests — compute the same values.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Dict, Tuple
+import numbers
+from typing import Any, Callable, Dict, Optional, Tuple
 
 _REGISTRY: Dict[str, Callable] = {}
 
 TAG = "fn"
+
+#: device mod-fun table codes — the ``exp_epoch`` plane of an
+#: ``OP_RMW`` row carries one of these (ops/engine.py imports them
+#: from here: this module is the registry's canonical home and is
+#: dependency-free, so the actor plane can import it without jax).
+RMW_ADD = 0     # cur + operand            (absent/tombstone cur = 0)
+RMW_SUB = 1     # cur - operand
+RMW_MAX = 2     # max(cur, operand)
+RMW_MIN = 3     # min(cur, operand)
+RMW_SET = 4     # operand (unconditional overwrite)
+RMW_BAND = 5    # cur & operand
+RMW_BOR = 6    # cur | operand
+RMW_BXOR = 7    # cur ^ operand
+RMW_PIA = 8     # put-if-absent: operand iff nothing committed
+
+#: name -> fun code for device-expressible registered funs
+_DEVICE: Dict[str, int] = {}
 
 
 def register(name: str) -> Callable[[Callable], Callable]:
@@ -50,3 +83,119 @@ def resolve(spec: Any) -> Callable:
         fn = _REGISTRY[spec[1]]
         return functools.partial(fn, *spec[2]) if spec[2] else fn
     raise ValueError(f"unresolvable function spec: {spec!r}")
+
+
+# -- device mod-fun table -----------------------------------------------------
+
+
+def register_device(name: str, code: int
+                    ) -> Callable[[Callable], Callable]:
+    """Decorator: register ``fn`` as BOTH an ordinary host mod-fun
+    (addressable as ``name``) and a device-table entry with fun code
+    ``code``.  ``fn`` is the HOST MIRROR — called as
+    ``fn(operand, vsn, cur)`` with an int ``cur`` — and must match
+    the engine's int32 semantics exactly (the equivalence sweep in
+    tests/test_rmw.py pins this)."""
+    def deco(fn: Callable) -> Callable:
+        register(name)(fn)
+        _DEVICE[name] = code
+        return fn
+    return deco
+
+
+def is_int32(x: Any) -> bool:
+    """An int32-expressible integer operand/default: any Integral
+    EXCEPT bool (``ref("rmw:add", True)`` is a caller bug, not an
+    operand of 1) — numpy integer scalars qualify, so operands pulled
+    from ndarrays don't silently demote to the host retry path."""
+    return (isinstance(x, numbers.Integral)
+            and not isinstance(x, bool)
+            and -(1 << 31) <= int(x) < (1 << 31))
+
+
+def device_entry(spec: Any) -> Optional[Tuple[int, int]]:
+    """``(fun_code, operand)`` when ``spec`` is a funref whose name is
+    in the device table and whose bound args are exactly one int32
+    operand; None otherwise (the caller keeps the host retry path)."""
+    if (isinstance(spec, tuple) and len(spec) == 3 and spec[0] == TAG
+            and spec[1] in _DEVICE):
+        bound = spec[2]
+        if len(bound) == 1 and is_int32(bound[0]):
+            return _DEVICE[spec[1]], int(bound[0])
+    return None
+
+
+def device_code(spec: Any) -> Optional[int]:
+    """The table code of a funref's NAME alone, whatever its bound
+    operand looks like — callers that must route by SEMANTICS (the
+    service's put-if-absent delegation) need this even when the
+    operand is not int32-expressible, or a non-int operand would
+    silently fall into the generic fn path and lose the routing."""
+    if isinstance(spec, tuple) and len(spec) == 3 and spec[0] == TAG:
+        return _DEVICE.get(spec[1])
+    return None
+
+
+def i32(x: int) -> int:
+    """int32 wraparound — the host mirror of device arithmetic."""
+    return ((int(x) + (1 << 31)) & 0xFFFFFFFF) - (1 << 31)
+
+
+def _cur_int(cur: Any) -> int:
+    """The host mirror's view of the current value: device RMW reads
+    an absent key (or a tombstone) as 0; the service's host path hands
+    the fun ``default`` (0) in that case, so ints pass through and
+    anything else is a caller error surfacing as a contained
+    exception."""
+    return int(cur)
+
+
+@register_device("rmw:add", RMW_ADD)
+def _rmw_add(operand, vsn, cur):
+    return i32(_cur_int(cur) + operand)
+
+
+@register_device("rmw:sub", RMW_SUB)
+def _rmw_sub(operand, vsn, cur):
+    return i32(_cur_int(cur) - operand)
+
+
+@register_device("rmw:max", RMW_MAX)
+def _rmw_max(operand, vsn, cur):
+    return max(_cur_int(cur), operand)
+
+
+@register_device("rmw:min", RMW_MIN)
+def _rmw_min(operand, vsn, cur):
+    return min(_cur_int(cur), operand)
+
+
+@register_device("rmw:set", RMW_SET)
+def _rmw_set(operand, vsn, cur):
+    return operand
+
+
+@register_device("rmw:band", RMW_BAND)
+def _rmw_band(operand, vsn, cur):
+    return _cur_int(cur) & operand
+
+
+@register_device("rmw:bor", RMW_BOR)
+def _rmw_bor(operand, vsn, cur):
+    return _cur_int(cur) | operand
+
+
+@register_device("rmw:bxor", RMW_BXOR)
+def _rmw_bxor(operand, vsn, cur):
+    return _cur_int(cur) ^ operand
+
+
+@register_device("rmw:put_if_absent", RMW_PIA)
+def _rmw_pia(operand, vsn, cur):
+    # value 0 is the engine's tombstone/absent encoding, which is what
+    # the host path's default-of-0 hands us for an absent key.  NOTE:
+    # the service never routes put-if-absent through this mirror on a
+    # host-payload key (a live payload of int 0 would read as absent)
+    # — it takes the exact-contract kput_once (0,0)-CAS instead; this
+    # fn exists for the registry and direct int-domain callers.
+    return operand if _cur_int(cur) == 0 else "failed"

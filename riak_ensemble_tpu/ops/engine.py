@@ -70,6 +70,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from riak_ensemble_tpu import funref
 from riak_ensemble_tpu.ops import hash as hashk
 from riak_ensemble_tpu.ops import quorum as quorum_lib
 from riak_ensemble_tpu.ops.quorum import (
@@ -91,6 +92,31 @@ OP_PUT = 2
 #: create-if-missing — so OP_CAS carries both do_kupdate
 #: (peer.erl:259-270) and do_kput_once (:278-284) semantics.
 OP_CAS = 3
+#: device read-modify-write — the batched analog of running kmodify's
+#: mod-fun INSIDE the leader's FSM (do_kmodify, peer.erl:303-317): the
+#: round reads the slot's latest hash-valid value, applies a
+#: registered table fun (fun code in the ``exp_epoch`` plane —
+#: funref.RMW_*; int32 operand in ``val``) and commits the result
+#: under the SAME round's seq discipline.  The read and the write are
+#: atomic within the round (no other lane touches the slot), so a
+#: device RMW can never CAS-conflict — one round replaces the host's
+#: read → fn → CAS retry cycle.  An absent key (or a tombstone) reads
+#: as value 0 for the arithmetic funs; a fun result of 0 commits the
+#: tombstone (the engine-wide 0-is-notfound payload encoding).
+OP_RMW = 4
+
+# The mod-fun table codes (canonical home: funref.py — the registry
+# the service resolves kmodify funrefs against; re-exported here so
+# kernel callers need only the engine module).
+RMW_ADD = funref.RMW_ADD
+RMW_SUB = funref.RMW_SUB
+RMW_MAX = funref.RMW_MAX
+RMW_MIN = funref.RMW_MIN
+RMW_SET = funref.RMW_SET
+RMW_BAND = funref.RMW_BAND
+RMW_BOR = funref.RMW_BOR
+RMW_BXOR = funref.RMW_BXOR
+RMW_PIA = funref.RMW_PIA
 
 #: Merkle trie fan-out (the reference's width-16 trie, synctree.erl:88).
 TREE_WIDTH = 16
@@ -539,7 +565,8 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
     is_put = kind == OP_PUT
     is_get = kind == OP_GET
     is_cas = kind == OP_CAS
-    active = is_put | is_get | is_cas
+    is_rmw = kind == OP_RMW
+    active = is_put | is_get | is_cas | is_rmw
     slot_valid = (slot >= 0) & (slot < s)                    # [E, W]
     slot_c = jnp.clip(slot, 0, s - 1)
 
@@ -631,9 +658,35 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
                  | (exp_absent & obj_found & (rd_val == 0))
                  | (exp_absent & ~obj_found & nf_quorum))
     cas_commit = is_cas & epoch_ok & slot_valid & vsn_match
-    commit = put_commit | cas_commit | rewrite | nf_write    # [E, W]
+
+    # Device RMW (OP_RMW): fn(cur, operand) committed in THIS round —
+    # the fused kmodify.  ``cur`` is the round's own latest-object
+    # read (tombstones and verified absence read as 0, the engine's
+    # notfound value), so concurrent RMWs of one slot serialize
+    # through round order with no conflict window.  Absence must be
+    # VERIFIED (the same nf_quorum guard as the (0,0)-CAS create):
+    # treating not-found-because-every-holder-is-corrupt as 0 would
+    # overwrite committed data the integrity gate excluded.
+    fn = exp_epoch                                           # [E, W]
+    cur = jnp.where(obj_found, rd_val, 0)
+    new_rmw = jnp.select(
+        [fn == RMW_ADD, fn == RMW_SUB, fn == RMW_MAX, fn == RMW_MIN,
+         fn == RMW_SET, fn == RMW_BAND, fn == RMW_BOR,
+         fn == RMW_BXOR],
+        [cur + val, cur - val, jnp.maximum(cur, val),
+         jnp.minimum(cur, val), val, cur & val, cur | val, cur ^ val],
+        default=val)                  # RMW_PIA commits the operand
+    rmw_absent = ((obj_found & (rd_val == 0))
+                  | (~obj_found & nf_quorum))
+    rmw_known = obj_found | nf_quorum
+    rmw_commit = (is_rmw & epoch_ok & slot_valid
+                  & jnp.where(fn == RMW_PIA, rmw_absent, rmw_known))
+
+    commit = (put_commit | cas_commit | rewrite | nf_write
+              | rmw_commit)                                  # [E, W]
     wval = jnp.where(is_put | is_cas, val,
-                     jnp.where(rewrite, rd_val, 0))
+                     jnp.where(is_rmw, new_rmw,
+                               jnp.where(rewrite, rd_val, 0)))
 
     # Commit seqs advance in lane order (obj_sequence, peer.erl:1776-
     # 1791): lane w's seq is ctr + (commits among lanes <= w), exactly
@@ -692,7 +745,11 @@ def _kv_round(state: EngineState, ctx: _KvCtx, kind: jax.Array,
         committed=commit,
         get_ok=get_ok,
         found=found & get_ok,
-        value=jnp.where(get_ok & found, rd_val, 0),
+        # reads report the winning value; a committed RMW reports the
+        # value it COMPUTED (the host mirror/WAL needs it without a
+        # follow-up read)
+        value=jnp.where(rmw_commit, new_rmw,
+                        jnp.where(get_ok & found, rd_val, 0)),
         obj_vsn=jnp.stack([out_epoch, out_seq], -1),
         quorum_ok=jnp.broadcast_to(ctx.epoch_ok[:, None], commit.shape),
         tree_corrupt=tree_corrupt,
@@ -712,11 +769,13 @@ def kv_step(state: EngineState, kind: jax.Array, slot: jax.Array,
             ) -> Tuple[EngineState, KvResult]:
     """One K/V protocol round per ensemble, batched over E.
 
-    kind [E] int32 (OP_NOOP/OP_GET/OP_PUT/OP_CAS); slot [E] int32;
-    val [E] int32 (payload for puts/CAS); exp_epoch/exp_seq [E] int32
-    (the CAS expected version; ignored for other kinds, default 0);
-    lease_ok [E] bool (host lease check,
-    check_lease peer.erl:1493-1516); up [E, Ml] bool.
+    kind [E] int32 (OP_NOOP/OP_GET/OP_PUT/OP_CAS/OP_RMW); slot [E]
+    int32; val [E] int32 (payload for puts/CAS; the int32 operand for
+    RMW); exp_epoch/exp_seq [E] int32 (the CAS expected version — for
+    OP_RMW rows exp_epoch instead carries the mod-fun table code
+    (RMW_*); ignored for other kinds, default 0); lease_ok [E] bool
+    (host lease check, check_lease peer.erl:1493-1516); up [E, Ml]
+    bool.
 
     Semantics per ensemble:
     - PUT: one quorum round.  Proposal (lead_epoch, ctr+1); member
@@ -736,6 +795,15 @@ def kv_step(state: EngineState, kind: jax.Array, slot: jax.Array,
       lagging/corrupt replicas (maybe_repair, :1518-1536); a notfound
       with unreachable members commits a tombstone (all_or_quorum,
       :1568-1584) — all batched across ensembles.
+    - RMW: the fused kmodify (do_kmodify, peer.erl:303-317).  One
+      quorum round reads the slot's latest hash-valid value, applies
+      the registered table fun (exp_epoch = fun code, val = operand)
+      and commits the result at (lead_epoch, next seq) — read and
+      write atomic within the round, so device RMWs never
+      CAS-conflict.  Arithmetic funs read absence/tombstones as 0;
+      RMW_PIA (put-if-absent) commits only over verified absence or
+      a tombstone; a fun result of 0 writes the tombstone.  The
+      committed value is reported in ``KvResult.value``.
     """
     ctx = _kv_context(state, up, axis_name)
     state, res = _kv_round(

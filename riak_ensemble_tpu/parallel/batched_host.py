@@ -30,12 +30,23 @@ fans out the futures, so a round's d2h transfer and host bookkeeping
 overlap the next round's device step.  Ordering, the WAL-before-ack
 barrier, and corruption→exchange semantics are preserved; see
 docs/ARCHITECTURE.md §7 "Two-phase launch pipeline".
+
+Read-modify-writes have a DEVICE FAST PATH: a ``kmodify`` whose
+mod-fun resolves against the funref device table (rmw:add & co) runs
+as one fused ``OP_RMW`` engine round — read, fun and commit under the
+round's seq discipline, conflict-free by construction — instead of
+the host's read→fn→CAS retry cycle; such keys hold device-native
+int32 values (``_inline_slots``).  Arbitrary mod-funs keep the host
+path, with the CAS half chained into the flush that resolved its read
+and jittered backoff between conflicted retries.  See
+docs/ARCHITECTURE.md §3 "Device-side RMW and the mod-fun table".
 """
 
 from __future__ import annotations
 
 import functools
 import os
+import random
 import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
@@ -280,7 +291,9 @@ class _PendingOp:
     #: slot write generation at enqueue (puts only) — lets the failed
     #: path tell whether it was the slot's last queued write
     gen: int = 0
-    #: CAS expected version (OP_CAS only)
+    #: CAS expected version (OP_CAS); for OP_RMW, (fun code, 0) — the
+    #: exp_epoch plane carries the mod-fun table code and ``handle``
+    #: the int32 operand
     exp: Tuple[int, int] = (0, 0)
     #: resolve gets as ("ok", value, vsn) instead of ("ok", value)
     want_vsn: bool = False
@@ -301,7 +314,9 @@ class _PendingBatch:
     caller's key order.  Packing into the flush's [K, E] planes is an
     array slice (no per-op Python), and resolution is positional
     assembly into the shared accumulator.  ``kind`` is uniform per
-    batch (all-put or all-get).
+    batch (all-put, all-get, all-CAS or all-RMW; an RMW batch's
+    ``handle`` column carries int32 operands and ``exp_e`` the fun
+    code).
     """
 
     kind: int
@@ -473,6 +488,23 @@ class BatchedEnsembleService:
         #: never read a recycled slot another key re-used)
         self._recycle_pending: List[List[Tuple[Any, int, int]]] = [
             [] for _ in range(n_ens)]
+        #: per-ensemble slots holding DEVICE-NATIVE int32 values (the
+        #: kmodify device fast path — OP_RMW commits) rather than
+        #: payload-store handles: reads of these slots return the raw
+        #: int32, and a committed RMW records the sentinel handle -1
+        #: in ``slot_handle`` (blocks recycling like a live handle;
+        #: released as a no-op).  A committed put/CAS flips the slot
+        #: back to handle storage.
+        self._inline_slots: List[set] = [set() for _ in range(n_ens)]
+        #: slots with QUEUED (not yet resolved) host-payload writes:
+        #: slot -> count.  The RMW fast-path eligibility must see
+        #: these — slot_handle only reflects COMMITTED writes, and a
+        #: device RMW racing a same-flush kput would do int32
+        #: arithmetic on the put's payload HANDLE (silent corruption).
+        #: Advisory queue state (reset with the queues, never
+        #: persisted); drift only parks a slot on the safe host path.
+        self._queued_handle_writes: List[Dict[int, int]] = [
+            dict() for _ in range(n_ens)]
         #: payload store: handle -> value (device carries handles).
         #: Handles are int32 on device and 0 is the tombstone sentinel,
         #: so released handles are recycled — a monotonically growing
@@ -543,6 +575,32 @@ class BatchedEnsembleService:
         #: launches that actually took the wide path (tests assert the
         #: A/B coverage is real; stats() reports it)
         self.wide_launches = 0
+        #: RMW observability: host-path kmodify CAS attempts that
+        #: failed and were retried (write races, plus transient
+        #: quorum failures — indistinguishable client-side), and ops
+        #: the device mod-fun table served in one round
+        self.rmw_conflicts = 0
+        self.rmw_device_fastpath = 0
+        #: svc_kmodify_error rate limit (a hot mod-fun bug at flush
+        #: rate would otherwise emit a traceback per op per retry)
+        self._kmodify_err_at = -1e9
+        self._kmodify_err_dropped = 0
+        #: backed-off kmodify retries: (due_flush_call, ensemble,
+        #: client future, thunk), run at the top of the flush whose
+        #: ordinal reaches them — backoff is measured in FLUSH CALLS
+        #: (the service's round clock; a wall-clock sleep would stall
+        #: caller-driven flush loops).  Tagged with ensemble + future
+        #: so destroy_ensemble can fail them: a thunk surviving a
+        #: row recycle would commit the dead tenant's kmodify value
+        #: into the new tenant.
+        self._retry_at: List[Tuple[int, int, Future, Any]] = []
+        self._flush_calls = 0
+        self._rng = random.Random(0x524D57)
+        #: same-flush chaining: set when a resolve enqueues follow-up
+        #: ops (a kmodify read's CAS half), consumed by flush() to run
+        #: one bounded extra launch cycle inside the same flush call
+        self._chain_kick = False
+        self._chain_depth = 0
         #: per-flush latency breakdown records (bounded); see
         #: :meth:`latency_breakdown`.  Collection is always on — the
         #: clock reads are nanoseconds against millisecond launches.
@@ -661,6 +719,7 @@ class BatchedEnsembleService:
         self.queues[row] = []
         self._queue_rounds[row] = 0
         self._active.discard(row)
+        self._purge_retries(row)
         mask = np.zeros((self.n_ens,), bool)
         mask[row] = True
         jnp = self._jnp
@@ -699,6 +758,8 @@ class BatchedEnsembleService:
         self.free_slots[row] = list(range(self.n_slots))
         self.slot_gen[row] = {}
         self.slot_handle[row] = {}
+        self._inline_slots[row] = set()
+        self._queued_handle_writes[row] = {}
         self._recycle_pending[row] = []
         # a recycled row starts with no watchers (the reference cleans
         # up watchers with their watched peer)
@@ -731,6 +792,7 @@ class BatchedEnsembleService:
         self.values[handle] = value
         gen = self.slot_gen[ens].get(slot, 0) + 1
         self.slot_gen[ens][slot] = gen
+        self._note_handle_write(ens, slot)
         self._push(ens, _PendingOp(eng.OP_PUT, slot, handle, fut,
                                    key, gen))
         return fut
@@ -771,6 +833,7 @@ class BatchedEnsembleService:
         ks = self.key_slot[ens]
         fs = self.free_slots[ens]
         sg = self.slot_gen[ens]
+        qh = self._queued_handle_writes[ens]
         vals_store = self.values
         free_h = self._free_handles
         next_h = self._next_handle
@@ -790,6 +853,7 @@ class BatchedEnsembleService:
             vals_store[h] = value
             g = sg.get(s, 0) + 1
             sg[s] = g
+            qh[s] = qh.get(s, 0) + 1
             slot_l.append(s)
             handle_l.append(h)
             gen_l.append(g)
@@ -847,6 +911,7 @@ class BatchedEnsembleService:
             self.values[h] = value
             g = sg.get(s, 0) + 1
             sg[s] = g
+            self._note_handle_write(ens, s)
             slot.append(s)
             handle.append(h)
             gen.append(g)
@@ -1001,6 +1066,7 @@ class BatchedEnsembleService:
         self.values[handle] = value
         gen = self.slot_gen[ens].get(slot, 0) + 1
         self.slot_gen[ens][slot] = gen
+        self._note_handle_write(ens, slot)
         self._push(ens, _PendingOp(
             eng.OP_CAS, slot, handle, fut, key, gen,
             exp=(int(expected_vsn[0]), int(expected_vsn[1]))))
@@ -1132,11 +1198,17 @@ class BatchedEnsembleService:
         hds = np.asarray([a[2] for a in applied], np.int32)
         st = self.state
         s_j = jnp.asarray(slots)
+        # index (int, :, array) puts the advanced axes FIRST — the
+        # update target is [n_items, M], so the per-item vectors need
+        # an explicit lane axis (a bare [n_items] only broadcast for
+        # the single-item installs the early tests happened to do)
         st = st._replace(
             obj_epoch=st.obj_epoch.at[ens, :, s_j].set(
-                jnp.asarray(eps)),
-            obj_seq=st.obj_seq.at[ens, :, s_j].set(jnp.asarray(sqs)),
-            obj_val=st.obj_val.at[ens, :, s_j].set(jnp.asarray(hds)))
+                jnp.asarray(eps)[:, None]),
+            obj_seq=st.obj_seq.at[ens, :, s_j].set(
+                jnp.asarray(sqs)[:, None]),
+            obj_val=st.obj_val.at[ens, :, s_j].set(
+                jnp.asarray(hds)[:, None]))
         # Version continuity requires NO epoch change on first touch:
         # a read at a ballot epoch above the objects' epochs triggers
         # the stale-epoch rewrite (update_key), re-versioning every
@@ -1167,6 +1239,7 @@ class BatchedEnsembleService:
         mask[ens] = True
         self.state = self.engine.rebuild_trees(st, jnp.asarray(mask))
         for key, slot, handle, _ve, _vs, payload in applied:
+            self._inline_slots[ens].discard(slot)
             old = self.slot_handle[ens].pop(slot, 0)
             if old and old != handle:
                 # values-only drop, NEVER the handle pool: the handle
@@ -1215,10 +1288,24 @@ class BatchedEnsembleService:
         "failed" (or raising) aborts without writing.  Resolves
         ('ok', new_vsn) | 'failed'.
 
-        The chain rides the normal flush cadence: each attempt's read
-        and CAS are ordinary queued ops, so concurrent kmodifys of
-        one key serialize through device-round order and the losers
-        retry — N concurrent increments converge to exactly +N.
+        The DEVICE FAST PATH: a ``mod_fun`` funref that resolves to a
+        mod-fun table entry (:func:`funref.device_entry` — rmw:add,
+        rmw:max, ..., one bound int32 operand) on a key holding a
+        device-native value (fresh, or previously written by the fast
+        path) runs as ONE ``OP_RMW`` engine round instead: the read,
+        the fun and the commit fuse under the round's seq discipline,
+        so the op costs one flush and can never CAS-conflict.
+        Requires ``default == 0`` (the engine reads absence as 0);
+        anything else keeps the host path below.
+
+        The host path's chain rides the flush cadence: each attempt's
+        read and CAS are ordinary queued ops, so concurrent kmodifys
+        of one key serialize through device-round order and the
+        losers retry — N concurrent increments converge to exactly
+        +N.  The CAS half of an attempt is CHAINED into the flush
+        that resolved its read (flush() runs a bounded extra launch
+        cycle when a resolve enqueued follow-ups), and conflicted
+        retries back off by a jittered number of flushes.
         """
         from riak_ensemble_tpu import funref
 
@@ -1231,8 +1318,68 @@ class BatchedEnsembleService:
         if self._dead(ens):
             fut.resolve("failed")
             return fut
+        dev = funref.device_entry(mod_fun)
+        if dev is not None and funref.is_int32(default) \
+                and int(default) == 0:
+            slot = self._slot_for(ens, key, allocate=True)
+            if slot is None:
+                fut.resolve("failed")
+                return fut
+            if self._rmw_eligible(ens, slot):
+                # A device RMW cannot CAS-conflict, so a failed round
+                # is a transient (quorum blip / unverifiable absence)
+                # — honor ``retries`` for those, exactly like the
+                # host path's on_cas does.  Each attempt re-resolves
+                # the slot: a racing put may have flipped the key to
+                # host storage (then 'failed' is the honest outcome —
+                # retrying device arithmetic there would corrupt it).
+                def dev_attempt(tries_left: int) -> None:
+                    s = self._slot_for(ens, key, allocate=True)
+                    if s is None or not self._rmw_eligible(ens, s):
+                        self._safe_resolve(fut, "failed")
+                        return
+                    inner = Future()
+                    self._push_rmw(ens, key, s, dev, inner)
 
-        def attempt(tries_left: int) -> None:
+                    def on_res(r: Any) -> None:
+                        if fut.done:
+                            return
+                        if (isinstance(r, tuple) and r[0] == "ok") \
+                                or tries_left <= 1 \
+                                or self._dead(ens):
+                            self._safe_resolve(fut, r)
+                            return
+                        if (dev[0] == funref.RMW_PIA
+                                and self.slot_handle[ens].get(s, 0)
+                                == -1):
+                            # deterministic refuse: the slot provably
+                            # holds a live device value — retrying a
+                            # put-if-absent can't change the outcome
+                            self._safe_resolve(fut, r)
+                            return
+                        self._retry_later(
+                            ens, fut, 0,
+                            lambda: dev_attempt(tries_left - 1))
+                    inner.add_waiter(on_res)
+
+                dev_attempt(max(1, retries))
+                return fut
+            # key holds a host payload: the host retry path below
+            # (its read returns the stored value, not an int32 lane)
+        if funref.device_code(mod_fun) == funref.RMW_PIA \
+                and len(mod_fun[2]) == 1:
+            # put-if-absent over a host-payload key cannot go through
+            # the fn (a live payload of int 0 would read as 'absent'
+            # and be clobbered); the (0,0)-CAS IS the exact
+            # do_kput_once semantics — a live payload of ANY value
+            # refuses, absence/tombstone commits.  Routed by NAME,
+            # not device_entry: a non-int32 operand (put-if-absent of
+            # an arbitrary payload) must take this path too.
+            self.kput_once(ens, key, mod_fun[2][0]).add_waiter(
+                lambda r: self._safe_resolve(fut, r))
+            return fut
+
+        def attempt(tries_left: int, conflicts: int) -> None:
             g = self.kget_vsn(ens, key)
 
             def on_read(res: Any) -> None:
@@ -1245,30 +1392,236 @@ class BatchedEnsembleService:
                 try:
                     new = fn(vsn, default if cur is NOTFOUND else cur)
                 except Exception:
-                    import traceback
-                    self._emit("svc_kmodify_error",
-                               {"error": traceback.format_exc(limit=8)})
+                    self._emit_kmodify_error()
                     self._safe_resolve(fut, "failed")
                     return
                 if isinstance(new, str) and new == "failed":
                     self._safe_resolve(fut, "failed")
                     return
-                c = self.kupdate(ens, key, vsn, new)
+                if (dev is not None and funref.is_int32(new)
+                        and int(new) == 0
+                        and self._slot_for(ens, key, allocate=False)
+                        is not None):
+                    # a TABLE fun computing 0 means the tombstone on
+                    # the device path — mirror it here whenever the
+                    # key HAS a slot (vsn (0,0) included: an
+                    # absent-read over an existing slot still CASes
+                    # the tombstone); a kupdate would store a live
+                    # int-0 payload and the key would read back found
+                    # where the device path reads notfound.  A truly
+                    # slotless key (only reachable with a non-zero
+                    # default, outside the device-equivalence domain)
+                    # keeps the generic path.
+                    c = self.ksafe_delete(ens, key, vsn)
+                else:
+                    c = self.kupdate(ens, key, vsn, new)
+                # the CAS was enqueued by a resolve: let the flush
+                # that is settling this read serve it too
+                self._chain_kick = True
 
                 def on_cas(r: Any) -> None:
                     if fut.done:
                         return
                     if isinstance(r, tuple) and r[0] == "ok":
                         self._safe_resolve(fut, r)
-                    elif tries_left > 1:
-                        attempt(tries_left - 1)
+                    elif tries_left > 1 and not self._dead(ens):
+                        # counts retried CAS losses: true write races
+                        # plus transient quorum failures (the client
+                        # can't tell them apart from 'failed'; a
+                        # destroyed row stops retrying entirely)
+                        self.rmw_conflicts += 1
+                        self._retry_later(
+                            ens, fut, conflicts,
+                            lambda: attempt(tries_left - 1,
+                                            conflicts + 1))
                     else:
                         self._safe_resolve(fut, "failed")
                 c.add_waiter(on_cas)
             g.add_waiter(on_read)
 
-        attempt(max(1, retries))
+        attempt(max(1, retries), 0)
         return fut
+
+    def kmodify_many(self, ens: int, keys: List[Any], mod_fun: Any,
+                     default: Any = 0, retries: int = 8) -> Future:
+        """Vectorized server-side modify: apply ONE ``mod_fun`` to N
+        keys behind one future, resolving to per-key ('ok', new_vsn) |
+        'failed' in key order.  A device-table funref takes one
+        ``OP_RMW`` round per key — the whole batch is a single
+        struct-of-arrays queue entry costing one flush, conflict-free
+        by construction.  Non-table funs (or keys holding host
+        payloads) fall back to per-key :meth:`kmodify` chains sharing
+        the batch accumulator."""
+        from riak_ensemble_tpu import funref
+
+        fut = Future()
+        n = len(keys)
+        if self._dead(ens) or n == 0:
+            fut.resolve(["failed"] * n)
+            return fut
+        accum = _BatchAccum(n)
+        dev = funref.device_entry(mod_fun)
+        device_ok = (dev is not None and funref.is_int32(default)
+                     and int(default) == 0)
+
+        def host_one(i: int, key: Any) -> None:
+            f = self.kmodify(ens, key, mod_fun, default, retries)
+            f.add_waiter(lambda r, i=i: accum.fill(
+                fut, [i], [r], self._safe_resolve))
+
+        if not device_ok:
+            for i, key in enumerate(keys):
+                host_one(i, key)
+            return fut
+        code, operand = dev
+        sg = self.slot_gen[ens]
+        inline = self._inline_slots[ens]
+        slot_l: List[int] = []
+        pos_l: List[int] = []
+        gen_l: List[int] = []
+        live_keys: List[Any] = []
+        miss_pos: List[int] = []
+        for i, key in enumerate(keys):
+            s = self._slot_for(ens, key, allocate=True)
+            if s is None:
+                miss_pos.append(i)
+                continue
+            if not self._rmw_eligible(ens, s):
+                host_one(i, key)  # host-payload key: per-key fallback
+                continue
+            g = sg.get(s, 0) + 1
+            sg[s] = g
+            inline.add(s)
+            slot_l.append(s)
+            pos_l.append(i)
+            gen_l.append(g)
+            live_keys.append(key)
+        if miss_pos:
+            accum.fill(fut, miss_pos, ["failed"] * len(miss_pos),
+                       self._safe_resolve)
+        if live_keys:
+            m = len(live_keys)
+            self.rmw_device_fastpath += m
+            # the batch rides an INNER future so transiently-failed
+            # rows (quorum blips — a device RMW cannot CAS-conflict)
+            # get their remaining ``retries`` through the scalar
+            # path, same contract as kmodify
+            inner = Future()
+            self._push(ens, _PendingBatch(
+                eng.OP_RMW, slot_l, [operand] * m, inner,
+                list(range(m)), live_keys, gen_l, [code] * m,
+                [0] * m, _BatchAccum(m), want_vsn=True, n=m))
+
+            def on_batch(results: Any) -> None:
+                if not isinstance(results, list):
+                    accum.fill(fut, pos_l, ["failed"] * len(pos_l),
+                               self._safe_resolve)
+                    return
+                for pos, key, r in zip(pos_l, live_keys, results):
+                    if (isinstance(r, tuple) and r[0] == "ok") \
+                            or retries <= 1 or self._dead(ens):
+                        accum.fill(fut, [pos], [r],
+                                   self._safe_resolve)
+                    else:
+                        f = self.kmodify(ens, key, mod_fun, default,
+                                         retries - 1)
+                        f.add_waiter(lambda r2, pos=pos: accum.fill(
+                            fut, [pos], [r2], self._safe_resolve))
+            inner.add_waiter(on_batch)
+        return fut
+
+    def _rmw_eligible(self, ens: int, slot: int) -> bool:
+        """A slot the device fast path may RMW: no QUEUED host-payload
+        write racing it, and device-native already or holding no
+        committed host payload (fresh/tombstoned) — running int32
+        arithmetic over a payload HANDLE (committed or about to
+        commit earlier in the same flush) would corrupt the data
+        while acking 'ok'."""
+        if slot in self._queued_handle_writes[ens]:
+            return False
+        return (slot in self._inline_slots[ens]
+                or self.slot_handle[ens].get(slot, 0) == 0)
+
+    def _note_handle_write(self, ens: int, slot: int) -> None:
+        d = self._queued_handle_writes[ens]
+        d[slot] = d.get(slot, 0) + 1
+
+    def _unnote_handle_write(self, ens: int, slot: int) -> None:
+        d = self._queued_handle_writes[ens]
+        n = d.get(slot, 0) - 1
+        if n <= 0:
+            d.pop(slot, None)
+        else:
+            d[slot] = n
+
+    def _push_rmw(self, ens: int, key: Any, slot: int,
+                  dev: Tuple[int, int], fut: Future) -> None:
+        code, operand = dev
+        gen = self.slot_gen[ens].get(slot, 0) + 1
+        self.slot_gen[ens][slot] = gen
+        # optimistic inline marking: a second kmodify racing this
+        # one's commit must still see the slot as device-native
+        self._inline_slots[ens].add(slot)
+        self.rmw_device_fastpath += 1
+        self._push(ens, _PendingOp(eng.OP_RMW, slot, operand, fut,
+                                   key, gen, exp=(code, 0),
+                                   want_vsn=True))
+
+    def _retry_later(self, ens: int, fut: Future, conflict_idx: int,
+                     thunk) -> None:
+        """Jittered backoff between CAS-conflict retries, in flush
+        calls: retry 0 is immediate (the common two-writer race wins
+        on the second round), later ones pick a uniformly random
+        delay from a doubling window so N stampeding writers spread
+        over ~N flushes instead of re-colliding every round."""
+        delay = self._rng.randrange(1 << min(conflict_idx, 4))
+        if delay == 0:
+            thunk()
+            # an immediate retry enqueued during a resolve is a chain
+            # follow-up like the CAS half
+            self._chain_kick = True
+        else:
+            self._retry_at.append((self._flush_calls + delay, ens,
+                                   fut, thunk))
+
+    def _run_due_retries(self) -> None:
+        if not self._retry_at:
+            return
+        now = self._flush_calls
+        due = [t for at, _e, fut, t in self._retry_at
+               if at <= now and not fut.done]
+        self._retry_at = [r for r in self._retry_at
+                          if r[0] > now and not r[2].done]
+        for thunk in due:
+            thunk()
+
+    def _purge_retries(self, ens: int) -> None:
+        """Fail and drop parked retries addressed to a destroyed row
+        — a thunk firing after the row recycles would run the dead
+        tenant's mod-fun against the NEW tenant's ensemble (its
+        create-if-missing CAS would even commit)."""
+        keep: List[Tuple[int, int, Future, Any]] = []
+        for at, e, fut, thunk in self._retry_at:
+            if e == ens:
+                self._safe_resolve(fut, "failed")
+            else:
+                keep.append((at, e, fut, thunk))
+        self._retry_at = keep
+
+    def _emit_kmodify_error(self) -> None:
+        """Trace a mod-fun exception, rate-limited to one traceback
+        per second (a hot fun bug at flush rate would otherwise emit
+        thousands); suppressed counts ride the next emission."""
+        now = time.monotonic()
+        if now - self._kmodify_err_at >= 1.0:
+            import traceback
+            self._kmodify_err_at = now
+            self._emit("svc_kmodify_error",
+                       {"error": traceback.format_exc(limit=8),
+                        "suppressed": self._kmodify_err_dropped})
+            self._kmodify_err_dropped = 0
+        else:
+            self._kmodify_err_dropped += 1
 
     def _recycle_on_ok(self, fut: Future, ens: int, key: Any,
                        slot: int) -> None:
@@ -1526,6 +1879,7 @@ class BatchedEnsembleService:
             "free_slots": self.free_slots,
             "slot_gen": self.slot_gen,
             "slot_handle": self.slot_handle,
+            "inline_slots": [sorted(s) for s in self._inline_slots],
             "recycle_pending": self._recycle_pending,
             "values": self.values,
             "free_handles": self._free_handles,
@@ -1630,6 +1984,8 @@ class BatchedEnsembleService:
         svc.free_slots = host["free_slots"]
         svc.slot_gen = host["slot_gen"]
         svc.slot_handle = host["slot_handle"]
+        svc._inline_slots = [set(s) for s in host.get(
+            "inline_slots", [[] for _ in range(n_ens)])]
         svc._recycle_pending = host["recycle_pending"]
         # restored pending recycles must re-enter the dirty set or
         # the sparse drain would never revisit them (leaked slots)
@@ -1754,10 +2110,27 @@ class BatchedEnsembleService:
                 obj_val[ens, :, slot] = handle
                 touched = True
                 if inline:
-                    # Bulk-array write: the int32 value IS the payload
-                    # (no handle indirection, no keyed mapping).
-                    owners.setdefault(ens, {})[slot] = None
+                    # Inline write: the int32 value IS the payload (no
+                    # handle indirection).  With a key AND a live
+                    # value it is a keyed device-native slot (a
+                    # committed RMW) — restore the mapping and the
+                    # inline marking.  A keyed inline TOMBSTONE (RMW
+                    # computed 0) replays like a delete: the live
+                    # leader recycled the slot, so retaining the
+                    # mapping would leak the slot and shadow its next
+                    # tenant.  Keyless records are bulk-array writes.
+                    if key_obj is not None and handle:
+                        self._inline_slots[ens].add(slot)
+                        self.slot_handle[ens][slot] = -1
+                        self.key_slot[ens][key_obj] = slot
+                        owners.setdefault(ens, {})[slot] = key_obj
+                    else:
+                        if key_obj is not None:
+                            self._inline_slots[ens].discard(slot)
+                            self.slot_handle[ens].pop(slot, None)
+                        owners.setdefault(ens, {})[slot] = None
                     continue
+                self._inline_slots[ens].discard(slot)
                 if handle:
                     self.values[handle] = payload
                     self._next_handle = max(self._next_handle,
@@ -1863,7 +2236,11 @@ class BatchedEnsembleService:
                 elif self.slot_gen[e].get(slot, 0) == gen \
                         and self.slot_handle[e].get(slot, 0) == 0 \
                         and self.key_slot[e].get(key) == slot:
+                    # (a committed device-native value holds the -1
+                    # sentinel in slot_handle, so inline slots with
+                    # live values never reach this branch)
                     del self.key_slot[e][key]
+                    self._inline_slots[e].discard(slot)
                     self.free_slots[e].append(slot)
                 # else: the slot was re-used meanwhile — drop the stale
                 # recycle request
@@ -1969,12 +2346,33 @@ class BatchedEnsembleService:
     def _step_fns(self) -> Tuple[Any, Any]:
         """The (full_step, full_step_wide) programs the launch path
         dispatches: the donated-state variants when donation is on and
-        the engine provides them (mesh engines may not)."""
+        the engine provides them (mesh engines may not).
+
+        An engine subclass that overrides the PLAIN step but inherits
+        the donated one (test fault injectors, wrappers) must not have
+        its override silently bypassed: the donated variant is only
+        trusted when it is defined by the same class (or instance)
+        that defines the plain step."""
         e = self.engine
         wide = getattr(e, "full_step_wide", None)
         if self._donate:
-            return (getattr(e, "full_step_donate", None) or e.full_step,
-                    getattr(e, "full_step_wide_donate", None) or wide)
+            def donated(name: str, plain_name: str, plain):
+                fn = getattr(e, name, None)
+                if fn is None:
+                    return plain
+                if name in getattr(e, "__dict__", {}):
+                    return fn  # instance-level pair: trust it
+                def definer(attr):
+                    for c in type(e).__mro__:
+                        if attr in c.__dict__:
+                            return c
+                    return None
+                return (fn if definer(name) is definer(plain_name)
+                        else plain)
+            return (donated("full_step_donate", "full_step",
+                            e.full_step),
+                    donated("full_step_wide_donate", "full_step_wide",
+                            wide))
         return e.full_step, wide
 
     def _launch_enqueue(self, kind: np.ndarray, slot: np.ndarray,
@@ -2345,6 +2743,8 @@ class BatchedEnsembleService:
             "wide_launches": self.wide_launches,
             "pipeline_depth": self.pipeline_depth,
             "launches_in_flight": len(self._inflight_launches),
+            "rmw_conflicts": self.rmw_conflicts,
+            "rmw_device_fastpath": self.rmw_device_fastpath,
         }
 
     def execute(self, kind: np.ndarray, slot: np.ndarray,
@@ -2364,8 +2764,12 @@ class BatchedEnsembleService:
         keyed/arbitrary-payload use.  Payload 0 is RESERVED as the
         tombstone (a put of 0 is a delete: it commits, and subsequent
         gets return found=False) — puts of live values must use
-        1..2^31-1.  Same semantics as queued ops: elections fold in,
-        leases check/renew, corruption triggers exchange.
+        1..2^31-1.  OP_RMW rows run the fused single-round
+        read-modify-write: ``exp_epoch`` carries the mod-fun table
+        code (funref.RMW_*), ``val`` the operand, and the committed
+        COMPUTED value comes back in the value plane.  Same semantics
+        as queued ops: elections fold in, leases check/renew,
+        corruption triggers exchange.
 
         Callers may pass DEVICE-RESIDENT int32 arrays (jax.Array):
         the op planes then never cross the host↔device link (the
@@ -2408,7 +2812,8 @@ class BatchedEnsembleService:
             exp_s=None if exp_seq is None
             else np.asarray(exp_seq, np.int32))
         if self._wal is not None:
-            self._log_execute_wal(kind, slot, val, committed, vsn)
+            self._log_execute_wal(kind, slot, val, committed, vsn,
+                                  value)
         self.ops_served += int((np.asarray(kind) != eng.OP_NOOP).sum())
         return committed, get_ok, found, value
 
@@ -2424,14 +2829,20 @@ class BatchedEnsembleService:
                 "reason": "device-resident op planes skip the WAL;"
                           " RPO is the checkpoint cadence"})
 
-    def _log_execute_wal(self, kind, slot, val, committed, vsn) -> None:
+    def _log_execute_wal(self, kind, slot, val, committed, vsn,
+                         value=None) -> None:
         """WAL records for a bulk execute's committed inline writes
-        (shared by the sync path and the execute_async settle)."""
-        wmask = (((kind == eng.OP_PUT) | (kind == eng.OP_CAS))
+        (shared by the sync path and the execute_async settle).  An
+        RMW row logs the value it COMPUTED (the ``value`` result
+        plane), not its operand."""
+        wmask = (((kind == eng.OP_PUT) | (kind == eng.OP_CAS)
+                  | (kind == eng.OP_RMW))
                  & committed)
+        wval = (val if value is None
+                else np.where(kind == eng.OP_RMW, value, val))
         js, es = np.nonzero(wmask)
         recs = [(("kv", int(e), int(slot[j, e])),
-                 (None, int(val[j, e]), int(vsn[j, e, 0]),
+                 (None, int(wval[j, e]), int(vsn[j, e, 0]),
                   int(vsn[j, e, 1]), None, True))
                 for j, e in zip(js.tolist(), es.tolist())]
         if recs:
@@ -2514,6 +2925,8 @@ class BatchedEnsembleService:
         queues settles everything before returning, so flush-until-
         done callers observe resolved futures exactly as at depth 1.
         """
+        self._flush_calls += 1
+        self._run_due_retries()
         active = self._active
         k = min(self.max_k,
                 max((self._queue_rounds[e] for e in active),
@@ -2522,8 +2935,11 @@ class BatchedEnsembleService:
         if k == 0:
             # Idle flush: settle the launch pipeline first (callers
             # that flush until done must observe resolved futures),
-            # then see whether an election-only launch is needed.
+            # then see whether an election-only launch is needed —
+            # settles may have chained follow-up ops (kmodify CAS
+            # halves), which get their own launch cycle here.
             served += self._drain_launches()
+            served += self._chain_flush()
             if not self._election_inputs()[0].any():
                 # tail settles count toward maintenance too (their
                 # WAL records / flush count advanced just the same)
@@ -2629,8 +3045,29 @@ class BatchedEnsembleService:
         # the window the NEXT flush's enqueue overlaps.
         keep = self.pipeline_depth - 1 if self._active else 0
         served += self._drain_launches(keep=keep)
+        served += self._chain_flush()
         self._flush_maintenance()
         return served
+
+    def _chain_flush(self) -> int:
+        """Same-flush chaining (the kmodify round-halving): when a
+        settle's resolutions enqueued follow-up ops — a host-path
+        kmodify read's CAS half, or an immediate conflict retry — run
+        ONE more bounded launch cycle inside the same flush() call, so
+        the follow-up costs this flush instead of the next.  Depth is
+        capped at 2 nested cycles: a chain may re-arm once (CAS →
+        conflict → fresh read) per level, and the cap bounds rounds
+        per flush call while the backoff queue carries the rest."""
+        if not self._chain_kick:
+            return 0
+        self._chain_kick = False
+        if not self._active or self._chain_depth >= 2:
+            return 0
+        self._chain_depth += 1
+        try:
+            return self.flush()
+        finally:
+            self._chain_depth -= 1
 
     def _flush_maintenance(self) -> None:
         """Post-settle upkeep shared by the normal and idle flush
@@ -2645,6 +3082,20 @@ class BatchedEnsembleService:
                 and self.flushes - self._scrubbed_at_flush
                 >= self.scrub_every_flushes):
             self.scrub()
+        if (self._retry_at and not self._active
+                and not self._inflight_launches):
+            # A fully-idle flush with only backed-off kmodify retries
+            # parked: there are no concurrent writers left to
+            # de-collide from, so the backoff delay is pure latency —
+            # and a driver using the `while any(svc.queues): flush()`
+            # idiom would stop flushing with the futures unresolved.
+            # Collapse the delays: fire every parked retry now, so
+            # their reads re-enter the queues (and re-arm the driver's
+            # loop condition) before this flush returns.
+            parked, self._retry_at = self._retry_at, []
+            for _at, _e, fut, thunk in parked:
+                if not fut.done:
+                    thunk()
 
     # -- launch pipeline (two-phase async service execution) ---------------
 
@@ -2756,7 +3207,8 @@ class BatchedEnsembleService:
         if fl.exec_wal is not None and self._wal is not None:
             kind, slot, val = fl.exec_wal
             try:
-                self._log_execute_wal(kind, slot, val, committed, vsn)
+                self._log_execute_wal(kind, slot, val, committed, vsn,
+                                      value)
             except Exception as exc:
                 self._safe_resolve(fl.exec_fut, "failed")
                 return 0, exc
@@ -2777,7 +3229,7 @@ class BatchedEnsembleService:
         """Append this flush's committed client writes to the WAL
         (latest record per (ens, slot)); called BEFORE any future
         resolves."""
-        committed, _get_ok, _found, _value, vsn = planes
+        committed, _get_ok, _found, value, vsn = planes
         if committed is None:
             return
         committed_l = committed.tolist()
@@ -2799,6 +3251,18 @@ class BatchedEnsembleService:
                                  int(vs2[i, 1]),
                                  self.values.get(h) if h else None,
                                  False)))
+                    elif op.kind == eng.OP_RMW:
+                        # keyed inline record: the committed COMPUTED
+                        # value (result plane) rides the handle field
+                        comm = committed[j + 1:j + 1 + op.n, e]
+                        vs2 = vsn[j + 1:j + 1 + op.n, e]
+                        vv = value[j + 1:j + 1 + op.n, e]
+                        for i in np.nonzero(comm)[0]:
+                            recs.append((
+                                ("kv", e, int(op.slot[i])),
+                                (op.keys[i], int(vv[i]),
+                                 int(vs2[i, 0]), int(vs2[i, 1]),
+                                 None, True)))
                     j += op.n
                     continue
                 j += 1
@@ -2809,6 +3273,14 @@ class BatchedEnsembleService:
                     recs.append((("kv", e, op.slot),
                                  (op.key, op.handle, ve, vs, payload,
                                   False)))
+                elif op.kind == eng.OP_RMW and committed_l[j][e]:
+                    # direct ndarray index: RMW scalar ops are rare
+                    # enough that a full value.tolist() per flush
+                    # would tax the pure put/get WAL hot path
+                    ve, vs = vsn_l[j][e]
+                    recs.append((("kv", e, op.slot),
+                                 (op.key, int(value[j, e]), ve, vs,
+                                  None, True)))
         if recs:
             self._wal.log(recs + self._wal_extra_records())
 
@@ -2836,9 +3308,14 @@ class BatchedEnsembleService:
     def _fail_batch(self, e: int, op: _PendingBatch) -> None:
         if op.fut.done:
             return
-        if op.kind in (eng.OP_PUT, eng.OP_CAS):
+        if op.kind in (eng.OP_PUT, eng.OP_CAS, eng.OP_RMW):
             for i in range(op.n):
-                self._release_handle(op.handle[i])
+                if op.kind != eng.OP_RMW:
+                    # an RMW entry's handle field is its int32
+                    # operand, not a payload handle
+                    self._release_handle(op.handle[i])
+                    if op.handle[i]:
+                        self._unnote_handle_write(e, op.slot[i])
                 if op.keys is not None:
                     self._queue_recycle(e, (op.keys[i], op.slot[i],
                                             op.gen[i]))
@@ -2853,11 +3330,17 @@ class BatchedEnsembleService:
             return
         if op.kind in (eng.OP_PUT, eng.OP_CAS):
             self._release_handle(op.handle)
-            # A failed put that was the slot's last queued write may
+            if op.handle:
+                self._unnote_handle_write(e, op.slot)
+        if op.kind in (eng.OP_PUT, eng.OP_CAS, eng.OP_RMW):
+            # A failed write that was the slot's last queued write may
             # leave it holding nothing committed (fresh slot, or a
             # tombstone whose delete-side recycle was skipped because
-            # this put bumped the generation): queue it for recycling
-            # or the slot leaks until the key is deleted.
+            # this write bumped the generation): queue it for
+            # recycling or the slot leaks until the key is deleted.
+            # (An RMW's handle field is its operand — nothing to
+            # release; the recycle drain's committed-handle check
+            # covers the -1 inline sentinel too.)
             if op.key is not None:
                 self._queue_recycle(e, (op.key, op.slot, op.gen))
         self._safe_resolve(op.fut, "failed")
@@ -2883,9 +3366,12 @@ class BatchedEnsembleService:
             recycle = self._recycle_pending[e].append
             self._recycle_dirty.add(e)
             release = self._release_handle
+            inline = self._inline_slots[e]
             for comm, s, h, g, key, vs in zip(comm_l, slot_l,
                                               handle_l, gen_l, keys,
                                               vs_l):
+                if h:
+                    self._unnote_handle_write(e, s)
                 if not comm:
                     release(h)
                     if key is not None:
@@ -2897,6 +3383,33 @@ class BatchedEnsembleService:
                     release(old)
                 if h:
                     slot_handle[s] = h
+                inline.discard(s)
+                append(("ok", tuple(vs)) if ack else "failed")
+        elif op.kind == eng.OP_RMW:
+            comm_l = committed[j:j + n, e].tolist()
+            vs_l = vsn[j:j + n, e].tolist()
+            val_l = value[j:j + n, e].tolist()
+            slot_handle = self.slot_handle[e]
+            inline = self._inline_slots[e]
+            release = self._release_handle
+            recycle = self._recycle_pending[e].append
+            self._recycle_dirty.add(e)
+            keys = op.keys if op.keys is not None else [None] * n
+            for comm, s, g, key, vs, v in zip(comm_l, op.slot, op.gen,
+                                              keys, vs_l, val_l):
+                if not comm:
+                    if key is not None:
+                        recycle((key, s, g))
+                    append("failed")
+                    continue
+                old = slot_handle.pop(s, 0)
+                if old > 0:
+                    release(old)
+                if v:  # live value; a computed 0 is the tombstone
+                    slot_handle[s] = -1
+                elif key is not None:  # tombstone: recycle the slot
+                    recycle((key, s, g))
+                inline.add(s)
                 append(("ok", tuple(vs)) if ack else "failed")
         else:  # OP_GET batch
             ok_l = get_ok[j:j + n, e].tolist()
@@ -2905,11 +3418,16 @@ class BatchedEnsembleService:
             vs_l = (vsn[j:j + n, e].tolist() if op.want_vsn
                     else [None] * n)
             values = self.values
+            inline = self._inline_slots[e]
             want_vsn = op.want_vsn
-            for ok, fnd, v, vs in zip(ok_l, found_l, val_l, vs_l):
+            for ok, fnd, v, vs, s in zip(ok_l, found_l, val_l, vs_l,
+                                         op.slot):
                 if ok and ack_reads:
-                    out = (values.get(v, NOTFOUND)
-                           if fnd and v != 0 else NOTFOUND)
+                    if fnd and v != 0:
+                        out = (v if s in inline
+                               else values.get(v, NOTFOUND))
+                    else:
+                        out = NOTFOUND
                     append(("ok", out, tuple(vs)) if want_vsn
                            else ("ok", out))
                 else:
@@ -2958,6 +3476,8 @@ class BatchedEnsembleService:
                 served += 1
                 if op.kind in puts:
                     if committed_l[j][e]:
+                        if op.handle:
+                            self._unnote_handle_write(e, op.slot)
                         # Release the payload this write superseded
                         # (rounds resolve in device order, so the last
                         # committed handle per slot survives).
@@ -2966,6 +3486,32 @@ class BatchedEnsembleService:
                             self._release_handle(old)
                         if op.handle:
                             slot_handle[op.slot] = op.handle
+                        # a committed put/CAS flips a device-native
+                        # slot back to handle storage
+                        self._inline_slots[e].discard(op.slot)
+                        self._safe_resolve(
+                            op.fut, ("ok", tuple(vsn_l[j][e]))
+                            if ack else "failed")
+                    else:
+                        self._fail_op(e, op)
+                elif op.kind == eng.OP_RMW:
+                    if committed_l[j][e]:
+                        old = slot_handle.pop(op.slot, 0)
+                        if old > 0:  # superseded host payload
+                            self._release_handle(old)
+                        # sentinel: LIVE value committed device-side
+                        # (no host payload) — blocks recycling like a
+                        # live handle, releases as a no-op.  A
+                        # computed 0 is the tombstone: no sentinel,
+                        # and the slot recycles like a committed
+                        # delete (the host fallback's ksafe_delete
+                        # arm recycles; the device arm must match).
+                        if value_l[j][e]:
+                            slot_handle[op.slot] = -1
+                        elif op.key is not None:
+                            self._queue_recycle(
+                                e, (op.key, op.slot, op.gen))
+                        self._inline_slots[e].add(op.slot)
                         self._safe_resolve(
                             op.fut, ("ok", tuple(vsn_l[j][e]))
                             if ack else "failed")
@@ -2974,9 +3520,14 @@ class BatchedEnsembleService:
                 else:
                     if get_ok_l[j][e] and ack_reads:
                         v = value_l[j][e]
-                        out = (self.values.get(v, NOTFOUND)
-                               if found_l[j][e] and v != 0
-                               else NOTFOUND)
+                        if found_l[j][e] and v != 0:
+                            # device-native slots carry the value
+                            # itself, not a payload handle
+                            out = (v if op.slot
+                                   in self._inline_slots[e]
+                                   else self.values.get(v, NOTFOUND))
+                        else:
+                            out = NOTFOUND
                         # vsn is the object's — a tombstone's real
                         # version rides along with NOTFOUND, so CAS
                         # chains (ksafe_delete → kupdate) work.

@@ -205,6 +205,7 @@ def dump_state(svc: BatchedEnsembleService) -> Tuple:
         list(svc._free_rows),
         list(svc._ens_names.items()),
         _pack_bool(svc.member_np.ravel()),
+        [sorted(s) for s in svc._inline_slots],
     )
     return (tuple(fields), host)
 
@@ -233,7 +234,10 @@ def install_state(svc: BatchedEnsembleService, dump: Tuple) -> None:
             np.frombuffer(raw, np.dtype(dt)).reshape(shape))
     svc.state = eng.EngineState(**new)
     (key_slot, slot_handle, values, next_handle, leader_b, dynamic,
-     live_b, free_rows, ens_names, member_b) = host
+     live_b, free_rows, ens_names, member_b, *rest) = host
+    inline = (rest[0] if rest
+              else [[] for _ in range(svc.n_ens)])
+    svc._inline_slots = [set(int(s) for s in row) for row in inline]
     svc.key_slot = [dict(pairs) for pairs in key_slot]
     svc.slot_handle = [{int(s): int(h) for s, h in pairs}
                        for pairs in slot_handle]
@@ -435,10 +439,12 @@ def save_group_meta(svc: BatchedEnsembleService, promised: int,
 
 def _entries_meta(entries, kind: np.ndarray, slot: np.ndarray,
                   values: Dict[int, Any]) -> List[Tuple]:
-    """Put/CAS lane metadata for the replicas' WALs and keyed mirrors:
-    (round j, ensemble e, key, handle, payload).  Mirrors the
-    iteration order of ``_log_wal`` so rounds line up with the op
-    planes."""
+    """Put/CAS/RMW lane metadata for the replicas' WALs and keyed
+    mirrors: (round j, ensemble e, key, handle, payload).  Mirrors
+    the iteration order of ``_log_wal`` so rounds line up with the op
+    planes.  RMW lanes carry (key, 0, None) — their committed value is
+    device-computed, so the replica reads it from its OWN result
+    planes (the kind plane says which rounds are RMW)."""
     meta: List[Tuple] = []
     if entries is None:
         return meta
@@ -452,6 +458,10 @@ def _entries_meta(entries, kind: np.ndarray, slot: np.ndarray,
                         key = op.keys[i] if op.keys is not None else None
                         meta.append((j + 1 + i, e, key, h,
                                      values.get(h) if h else None))
+                elif op.kind == eng.OP_RMW:
+                    for i in range(op.n):
+                        key = op.keys[i] if op.keys is not None else None
+                        meta.append((j + 1 + i, e, key, 0, None))
                 j += op.n
                 continue
             j += 1
@@ -459,6 +469,8 @@ def _entries_meta(entries, kind: np.ndarray, slot: np.ndarray,
                 meta.append((j, e, op.key, op.handle,
                              values.get(op.handle) if op.handle
                              else None))
+            elif op.kind == eng.OP_RMW:
+                meta.append((j, e, op.key, 0, None))
     return meta
 
 
@@ -537,7 +549,7 @@ class ReplicaCore:
             svc, kind, slot, val, k, want_vsn=want_vsn,
             exp_e=exp_e, exp_s=exp_s, elect=elect, cand=cand,
             lease_ok=lease_ok)
-        committed, _get_ok, _found, _value, vsn = \
+        committed, _get_ok, _found, value, vsn = \
             BatchedEnsembleService._launch_resolve(svc, fl)
         crc = result_crc(committed, vsn)
 
@@ -552,6 +564,16 @@ class ReplicaCore:
                 continue
             ve, vs = (int(vsn[j, e, 0]), int(vsn[j, e, 1])) \
                 if vsn is not None else (0, 0)
+            if int(kind[j, e]) == eng.OP_RMW:
+                # device RMW lane: this lane COMPUTED the committed
+                # value itself (bit-equal by determinism; the CRC
+                # pins it) — log a keyed inline record and mark the
+                # slot device-native
+                v = int(value[j, e]) if value is not None else 0
+                recs.append((("kv", e, int(slot[j, e])),
+                             (key, v, ve, vs, None, True)))
+                self._mirror_inline(e, key, int(slot[j, e]), v)
+                continue
             recs.append((("kv", e, int(slot[j, e])),
                          (key, handle, ve, vs, payload, False)))
             self._mirror_write(e, key, int(slot[j, e]), handle, payload)
@@ -577,8 +599,9 @@ class ReplicaCore:
         """Keep the keyed host mirrors live on the replica so a
         promoted leader can serve keyed ops without a WAL rescan."""
         svc = self.svc
+        svc._inline_slots[e].discard(slot)
         old = svc.slot_handle[e].pop(slot, 0)
-        if old and old != handle:
+        if old > 0 and old != handle:
             svc.values.pop(old, None)
         if handle:
             svc.values[handle] = payload
@@ -588,6 +611,29 @@ class ReplicaCore:
             if handle >= svc._next_handle:
                 svc._next_handle = handle + 1
         else:
+            if key is not None:
+                svc.key_slot[e].pop(key, None)
+
+    def _mirror_inline(self, e: int, key: Any, slot: int,
+                       value: int) -> None:
+        """Keyed mirror of a committed device RMW: the slot is
+        device-native (value lives in the engine arrays; the -1
+        slot_handle sentinel stands in for a live handle).  A
+        computed 0 is the tombstone: the mapping DROPS, exactly like
+        the host-delete mirror arm — the leader recycles the slot, so
+        a retained replica mapping would alias the key onto whatever
+        the recycled slot holds next (cross-key leak on promotion)."""
+        svc = self.svc
+        old = svc.slot_handle[e].pop(slot, 0)
+        if old > 0:
+            svc.values.pop(old, None)
+        if value:
+            svc._inline_slots[e].add(slot)
+            svc.slot_handle[e][slot] = -1
+            if key is not None:
+                svc.key_slot[e][key] = slot
+        else:
+            svc._inline_slots[e].discard(slot)
             if key is not None:
                 svc.key_slot[e].pop(key, None)
 
@@ -796,15 +842,24 @@ class ReplicaCore:
                       payload: Any) -> None:
         """One patched slot's keyed host mirrors: adopt the leader's
         (key, handle, payload) — key None means the slot is empty on
-        the leader, so any local mapping is dropped."""
+        the leader, so any local mapping is dropped.  handle -1 is the
+        leader's device-native (inline RMW) sentinel: the value rides
+        the patched engine arrays, not the payload store."""
         svc = self.svc
         old = svc.slot_handle[e].pop(s, 0)
-        if old and old != handle:
+        if old > 0 and old != handle:
             svc.values.pop(old, None)
         stale = [k for k, sl in svc.key_slot[e].items()
                  if sl == s and k != key]
         for k in stale:
             svc.key_slot[e].pop(k, None)
+        if handle == -1:
+            svc._inline_slots[e].add(s)
+            svc.slot_handle[e][s] = -1
+            if key is not None:
+                svc.key_slot[e][key] = s
+            return
+        svc._inline_slots[e].discard(s)
         if handle:
             svc.values[handle] = payload
             svc.slot_handle[e][s] = handle
@@ -833,7 +888,8 @@ class _Ticket:
     def __init__(self) -> None:
         self.event = threading.Event()
         self.result: Any = None
-        #: post time — lets the receiver's idle-timeout handling tell
+        #: send time (re-stamped by the sender as the frame goes on
+        #: the wire) — lets the receiver's idle-timeout handling tell
         #: a genuinely-overdue response (posted >= IO_TIMEOUT ago)
         #: from a request that arrived DURING the blocked recv
         self.posted = time.monotonic()
@@ -892,6 +948,14 @@ class PeerLink:
         #: at most one in-flight state snapshot; consumed (not waited
         #: on) by a later flush — installs never block the commit path
         self.install_ticket: Optional[_Ticket] = None
+        #: the pipeline seq the install was queued AHEAD of (ADVICE
+        #: r5): _settle_entry may consume the ticket only for entries
+        #: at-or-after this seq — consuming an install posted by a
+        #: LATER flush would clear needs_sync, the current entry's
+        #: nack would re-set it, and the NEXT entry's legitimate
+        #: matching ack would be discounted (one redundant full
+        #: re-sync per occurrence)
+        self.install_barrier = 0
         #: in-flight tree-diff catch-up (probe thread output)
         self.sync: Optional["_TreeSync"] = None
         #: one tree-diff attempt per connection: a failed patch falls
@@ -950,8 +1014,15 @@ class PeerLink:
                 if sock is None:
                     raise ConnectionError("dropped mid-send")
                 # append BEFORE send: the response cannot precede the
-                # send, so the receiver always finds the ticket queued
+                # send, so the receiver always finds the ticket queued.
+                # Re-stamp posted NOW — the ticket may have dwelled in
+                # the sender queue behind a large install/patch
+                # upload, and the receiver's overdue check must time
+                # the wire wait, not the queue wait (a fresh request
+                # read as overdue would drop a healthy link and force
+                # the very re-sync the idle-timeout fix removed).
                 with self._alock:
+                    ticket.posted = time.monotonic()
                     self._awaiting.append(ticket)
                 if isinstance(frame, _Encoded):
                     sock.sendall(frame.payload)
@@ -1448,6 +1519,9 @@ class ReplicatedService(BatchedEnsembleService):
                         ("install", self._ge, self._grp_seq,
                          dump_state(self), self.core.cfg))
                 link.install_ticket = link.post(snapshot)
+                # queued ahead of the NEXT stream record; only
+                # settles at-or-after it may consume the ticket
+                link.install_barrier = self._grp_seq + 1
                 self.group_stats["resyncs"] += 1
         sends = [(l, l.post(enc)) for l in self._links
                  if not l.needs_sync]
@@ -1612,6 +1686,7 @@ class ReplicatedService(BatchedEnsembleService):
                     ("install", self._ge, self._grp_seq,
                      dump_state(self), self.core.cfg))
             link.install_ticket = link.post(snapshot_frame)
+            link.install_barrier = seq  # queued ahead of THIS apply
             self.group_stats["resyncs"] += 1
 
         for link in self._links:
@@ -1633,6 +1708,7 @@ class ReplicatedService(BatchedEnsembleService):
                     patch = self._build_patch(sync)
                     sync.bytes += len(patch.payload)
                     link.install_ticket = link.post(patch)
+                    link.install_barrier = seq
                     self.group_stats["tree_resyncs"] += 1
                     self.group_stats["tree_resync_bytes"] += sync.bytes
                 elif link.connected:
@@ -1904,9 +1980,16 @@ class ReplicatedService(BatchedEnsembleService):
             # replica applied this very frame on the freshly-installed
             # state (consuming the ticket only at the next flush
             # preamble would fail the first post-install flush's
-            # quorum for no reason)
+            # quorum for no reason).  Only installs queued ahead of
+            # THIS entry or earlier (install_barrier <= entry.seq)
+            # are consumable: an install posted by a LATER flush must
+            # stay pending for the settle that can actually observe
+            # its effect (ADVICE r5 — consuming it here would clear
+            # needs_sync early, and this entry's own nack would then
+            # discount the next entry's legitimate ack).
             inst_t = link.install_ticket
-            if inst_t is not None and inst_t.event.is_set():
+            if inst_t is not None and inst_t.event.is_set() \
+                    and link.install_barrier <= entry.seq:
                 ri = inst_t.result
                 link.install_ticket = None
                 if ri is not None and ri[0] == "installed":
@@ -1953,6 +2036,12 @@ class ReplicatedService(BatchedEnsembleService):
         # work to overlap with), so flush-until-done callers and the
         # post-load read-back sweeps observe resolved futures
         self._drain_pending(block_all=not self._active)
+        # on a replicated leader, client futures resolve in the
+        # settle above (after the host quorum), so a kmodify chain's
+        # follow-up CAS lands HERE — give it its launch cycle inside
+        # the same flush call (the base flush's chain point saw
+        # nothing: resolution was deferred past it)
+        served += self._chain_flush()
         if self._cfg_txn is not None:
             self._advance_cfg()
         return served

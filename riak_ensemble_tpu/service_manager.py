@@ -342,6 +342,13 @@ class ServiceReconciler:
         committed version (a live leader's lane can never exceed it).
         """
         svc = self.svc
+        # settle in-flight launches first: at pipeline_depth > 1 a
+        # write may be committed-but-unresolved (slot_handle fills at
+        # resolve), and exporting without it while destroy's own
+        # drain then ACKS it would lose an acked write across the
+        # handoff.  Settling only the launch pipeline keeps the
+        # export+destroy tick atomic (no new ops are admitted here).
+        svc._drain_launches()
         items = [(key, slot) for key, slot in svc.key_slot[ens].items()
                  if svc.slot_handle[ens].get(slot, 0)]
         if not items:
@@ -354,16 +361,25 @@ class ServiceReconciler:
             lanes[0] = True
         eps_l = np.asarray(svc.state.obj_epoch[ens])[:, slots]  # [M, n]
         sqs_l = np.asarray(svc.state.obj_seq[ens])[:, slots]
+        vls_l = np.asarray(svc.state.obj_val[ens])[:, slots]
         mask = lanes[:, None]
         # lexicographic max: epoch first, then seq among max-epoch
         # lanes; a slot with no copy on any masked lane exports (0, 0)
         eps = np.maximum(np.where(mask, eps_l, -1).max(0), 0)   # [n]
         sqs = np.maximum(np.where(mask & (eps_l == eps[None, :]),
                                   sqs_l, -1).max(0), 0)
+        # the winning version's value — device-native (inline RMW)
+        # slots export IT as the payload: their value lives in the
+        # engine arrays, not the handle store (slot_handle holds the
+        # -1 sentinel)
+        vls = np.where(mask & (eps_l == eps[None, :])
+                       & (sqs_l == sqs[None, :]), vls_l,
+                       np.iinfo(np.int32).min).max(0)
         out = []
-        for (key, slot), ve, vs in zip(items, eps, sqs):
+        for (key, slot), ve, vs, dv in zip(items, eps, sqs, vls):
             h = svc.slot_handle[ens][slot]
-            out.append((key, svc.values[h], (int(ve), int(vs))))
+            payload = int(dv) if h == -1 else svc.values[h]
+            out.append((key, payload, (int(ve), int(vs))))
         return out
 
     def _bad_view(self, name: Any, view) -> bool:

@@ -126,6 +126,8 @@ class ServiceServer:
             # (and rejection of unregistered names) happens inside
             # kmodify — the no-code-on-decode trust model holds
             return svc.kmodify(*args)
+        if op == "kmodify_many":
+            return svc.kmodify_many(*args)
         if op == "kput_once":
             return svc.kput_once(*args)
         if op == "kdelete":
@@ -333,9 +335,17 @@ class ServiceClient:
         """Server-side modify; ``fnref`` is a
         :func:`riak_ensemble_tpu.funref.ref` tuple (names resolve in
         the SERVER's registry, the MFA discipline of
-        riak_ensemble_peer:kmodify)."""
+        riak_ensemble_peer:kmodify).  Funrefs that resolve to device
+        mod-fun table entries (rmw:add etc.) take the single-round
+        engine fast path server-side."""
         return await self.call("kmodify", ens, key, tuple(fnref),
                                default, **kw)
+
+    async def kmodify_many(self, ens, keys, fnref, default=0, **kw):
+        """Vectorized kmodify: one fnref applied to N keys, per-key
+        ('ok', vsn) | 'failed' results in order."""
+        return await self.call("kmodify_many", ens, list(keys),
+                               tuple(fnref), default, **kw)
 
     async def kdelete(self, ens, key, **kw):
         return await self.call("kdelete", ens, key, **kw)

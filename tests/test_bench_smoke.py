@@ -1,0 +1,36 @@
+"""bench.py regression smoke (tier-1, fast): exercise the RMW rung
+and the mixed runner in-process at tiny shapes, so a bench.py break
+(signature drift, a renamed stats key, an op-kind mix that can't
+commit) fails HERE instead of only at round time.
+
+Deliberately small: sub-second measured windows over tiny [K, E]
+planes — this pins that the runners RUN and report sane shapes, not
+what the numbers are.
+"""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import bench  # noqa: E402
+
+
+def test_rmw_rung_smoke():
+    out = bench.run_rmw_service(n_ens=2, n_peers=3, n_slots=8, k=3,
+                                seconds=0.05)
+    assert out["rmw_device_ops_per_sec"] > 0
+    assert out["rmw_host_ops_per_sec"] > 0
+    assert out["rmw_device_speedup"] > 0
+    # the device arm's contract: one flush per storm round, zero
+    # conflicts; the host arm pays the read→CAS retry cycle
+    assert out["rmw_device_flushes_per_round"] == 1.0
+    assert out["rmw_device_conflicts"] == 0
+    assert out["rmw_host_flushes_per_round"] >= 1.0
+
+
+def test_mixed_rung_smoke():
+    out = bench.run_mixed_service(n_ens=4, n_peers=3, n_slots=8, k=4,
+                                  seconds=0.05)
+    assert out["mixed_ops_per_sec"] > 0
+    assert out["mixed_p99_ms"] >= out["mixed_p50_ms"] >= 0
+    assert 0 < out["mixed_commit_fraction"] <= 1
