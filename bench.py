@@ -220,6 +220,9 @@ def run_service(n_ens: int, n_peers: int, n_slots: int, k: int,
     out.update(mixed)
     out.update(run_rmw_service(
         min(n_ens, 256), n_peers, n_slots, min(k, 8), seconds))
+    out.update(run_skewed_service(
+        min(n_ens, 512), n_peers, min(n_slots, 64), min(k, 16),
+        seconds))
     return out
 
 
@@ -380,6 +383,90 @@ def run_rmw_service(n_ens: int, n_peers: int, n_slots: int, k: int,
         svc.stop()
     out["rmw_device_speedup"] = (out["rmw_device_ops_per_sec"]
                                  / out["rmw_host_ops_per_sec"])
+    return out
+
+
+def run_skewed_service(n_ens: int, n_peers: int, n_slots: int, k: int,
+                       seconds: float, warm: bool = True,
+                       baseline: bool = True) -> dict:
+    """The SKEWED-load rung — active-column compaction's target
+    shape: zipf-distributed ensemble pick, so a handful of hot
+    ensembles carry deep queues while most of the [K, E] grid idles
+    (the partial-load shape a production front-end actually sees; one
+    hot ensemble still forces the full K bucket across all E
+    columns).  Keyed kput/kget futures through flush().
+
+    ``warm`` pre-compiles the (K, A) bucket grid first (the dispatch
+    p99 fix — without it, first-use compiles of each new bucket land
+    inside the timed loop).  ``baseline`` also runs the identical
+    loop with compaction disabled (RETPU_COMPACT=0 semantics), so the
+    JSON carries the compaction speedup as an A/B, not a claim.
+    Reports payload_bytes_per_flush and grid_occupancy so the
+    trajectory tracks a regression that re-inflates the transfer."""
+    from riak_ensemble_tpu.parallel.batched_host import (
+        BatchedEnsembleService, WallRuntime,
+    )
+
+    def arm(compact: bool) -> dict:
+        svc = BatchedEnsembleService(WallRuntime(), n_ens, n_peers,
+                                     n_slots, tick=None,
+                                     max_ops_per_tick=k)
+        svc._compact = compact
+        if warm:
+            svc.warmup()
+        rng = np.random.default_rng(3)
+        n_draw = 4 * k
+
+        def one_round():
+            ens = np.minimum(rng.zipf(1.5, n_draw) - 1, n_ens - 1)
+            futs = []
+            for i, e in enumerate(ens.tolist()):
+                if i % 2:
+                    futs.append(svc.kget(e, f"key{i % 4}"))
+                else:
+                    futs.append(svc.kput(e, f"key{i % 4}", i + 1))
+            while any(svc.queues):
+                svc.flush()
+            assert all(f.done for f in futs), "skewed bench: unsettled"
+            return len(futs)
+
+        one_round()  # slots allocate; elections fold in
+        svc.payload_bytes = 0
+        svc.payload_bytes_full_width = 0
+        svc._occ_sum = 0.0
+        svc._occ_launches = 0
+        f0 = svc.flushes
+        ops = 0
+        t_end = time.perf_counter() + max(seconds, 1e-3)
+        t0 = time.perf_counter()
+        while time.perf_counter() < t_end or not ops:
+            ops += one_round()
+        elapsed = time.perf_counter() - t0
+        flushes = max(svc.flushes - f0, 1)
+        st = svc.stats()
+        svc.stop()
+        return {
+            "ops_per_sec": ops / elapsed,
+            "payload_bytes_per_flush": svc.payload_bytes / flushes,
+            "payload_bytes_full_width_per_flush":
+                svc.payload_bytes_full_width / flushes,
+            "grid_occupancy": round(st["grid_occupancy"], 4),
+        }
+
+    a = arm(True)
+    out = {
+        "skewed_ops_per_sec": a["ops_per_sec"],
+        "payload_bytes_per_flush": round(
+            a["payload_bytes_per_flush"], 1),
+        "payload_bytes_full_width_per_flush": round(
+            a["payload_bytes_full_width_per_flush"], 1),
+        "grid_occupancy": a["grid_occupancy"],
+    }
+    if baseline:
+        b = arm(False)
+        out["skewed_baseline_ops_per_sec"] = b["ops_per_sec"]
+        out["skewed_compaction_speedup"] = round(
+            a["ops_per_sec"] / b["ops_per_sec"], 2)
     return out
 
 
@@ -1204,6 +1291,18 @@ def main() -> None:
             "rmw_device_flushes_per_round"),
         "rmw_host_flushes_per_round": svc.get(
             "rmw_host_flushes_per_round"),
+        "skewed_service_ops_per_sec": (
+            round(svc["skewed_ops_per_sec"], 1)
+            if svc.get("skewed_ops_per_sec") else None),
+        "skewed_baseline_ops_per_sec": (
+            round(svc["skewed_baseline_ops_per_sec"], 1)
+            if svc.get("skewed_baseline_ops_per_sec") else None),
+        "skewed_compaction_speedup": svc.get(
+            "skewed_compaction_speedup"),
+        "payload_bytes_per_flush": svc.get("payload_bytes_per_flush"),
+        "payload_bytes_full_width_per_flush": svc.get(
+            "payload_bytes_full_width_per_flush"),
+        "grid_occupancy": svc.get("grid_occupancy"),
         "repgroup_ops_per_sec": svc.get("repgroup_ops_per_sec"),
         "repgroup_p50_ms": svc.get("repgroup_p50_ms"),
         "repgroup_p99_ms": svc.get("repgroup_p99_ms"),

@@ -957,6 +957,37 @@ def validate_wide_plane(kind, slot) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Result-plane compaction (active-column gather)
+
+
+def gather_result_columns(res: KvResult,
+                          active_idx: jax.Array) -> KvResult:
+    """Active-column compaction of a packed-layout result: gather the
+    per-round ensemble axis of the CLIENT result planes down to the
+    active column set — ``[K, E] → [K, A]`` (``[G·W, E] → [G·W, A]``
+    for a wide launch already reshaped to round-major rows).
+
+    ``active_idx [A]`` holds the global column indices the flush
+    actually scheduled ops into, A pow2-bucketed by the host for
+    compile reuse (padding entries repeat index 0 and are ignored by
+    the host unpack).  Only the planes a client op consumes move:
+    ``quorum_ok`` (lease renewal reads EVERY column's epoch-check
+    outcome) and ``tree_corrupt`` (corrupt-plane flags of *inactive*
+    columns must still reach the scrub path; the ``E·M`` mask is
+    bit-packed and cheap) deliberately stay full width.  Compaction is
+    a pure re-indexing: the gathered planes are bit-identical to the
+    full-width pack's active columns, and inactive columns carry only
+    the all-false/zero NOOP results the host reconstructs at unpack.
+    """
+    def take(x):
+        return jnp.take(x, active_idx, axis=1)
+    return res._replace(
+        committed=take(res.committed), get_ok=take(res.get_ok),
+        found=take(res.found), value=take(res.value),
+        obj_vsn=take(res.obj_vsn))
+
+
+# ---------------------------------------------------------------------------
 # Integrity maintenance kernels (exchange / repair, §2.3)
 
 
@@ -1312,3 +1343,112 @@ full_step_wide = jax.jit(_full_step_wide_body,
 full_step_wide_donate = jax.jit(_full_step_wide_body,
                                 static_argnames=("axis_name",),
                                 donate_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
+# Active-column SLICED full step (the shrunk [K, A] launch grid)
+
+
+def _slice_columns(state: EngineState, active_idx: jax.Array,
+                   up: jax.Array) -> Tuple[EngineState, jax.Array]:
+    """Gather the A active ensembles' rows out of every state plane
+    (and the up mask): ``[E, ...] → [A, ...]``.  Padding entries
+    (index E, out of range) clip to row E-1 — harmless, their op
+    lanes are NOOP/elect-False so they never write, and the scatter
+    drops them."""
+    e = state.epoch.shape[0]
+    idx_c = jnp.clip(active_idx, 0, e - 1)
+    sub = jax.tree.map(lambda x: jnp.take(x, idx_c, axis=0), state)
+    return sub, jnp.take(up, idx_c, axis=0)
+
+
+def _scatter_columns(state: EngineState, sub: EngineState,
+                     active_idx: jax.Array) -> EngineState:
+    """Scatter the stepped sub-state back into the full planes.
+    Padding entries aim out of bounds (index E) and are DROPPED;
+    real indices are distinct, so the scatter is conflict-free.
+    With the full state donated, this lowers to an in-place update
+    of the A touched rows instead of a full-plane copy."""
+    return jax.tree.map(
+        lambda full, s: full.at[active_idx].set(s, mode="drop"),
+        state, sub)
+
+
+def _full_step_sliced_body(state: EngineState, active_idx: jax.Array,
+                           elect: jax.Array, cand: jax.Array,
+                           kind: jax.Array, slot: jax.Array,
+                           val: jax.Array, lease_ok: jax.Array,
+                           up: jax.Array,
+                           axis_name: Optional[str] = None,
+                           exp_epoch: Optional[jax.Array] = None,
+                           exp_seq: Optional[jax.Array] = None
+                           ) -> Tuple[EngineState, jax.Array, KvResult]:
+    """:data:`full_step` on the ACTIVE COLUMNS ONLY — the shrunk
+    launch grid.  One hot ensemble forces the [K, E] grid to its
+    queue depth even when most columns idle; ensembles are fully
+    independent in every K/V and election kernel (the batch-axis
+    premise), so the step runs bit-identically on the gathered
+    ``[A, ...]`` sub-state with ``[K, A]`` op planes — compute, HBM
+    traffic and the result surface all scale with the live working
+    set instead of E.
+
+    ``active_idx [A]`` (A pow2-bucketed; padding = E, dropped at
+    scatter) selects the columns; ``elect``/``cand`` are ``[A]``,
+    the op planes ``[K, A]``, ``up`` stays full ``[E, M]`` (gathered
+    on device — it is cached there between failure-detector
+    changes).  The caller must include every electing column in the
+    active set, and must treat the results as A-width (won/quorum/
+    corrupt planes come back ``[A(...)]``; the host scatters them).
+
+    Semantic note (vs the full-grid step): follower epoch catch-up
+    (``_adopt_epochs``) and lease-renewing quorum confirmations run
+    only for active columns — an idle ensemble's lease lapses and
+    its stragglers heal on its NEXT active launch, which is exactly
+    when the heal is first observable.  Single-shard launches only
+    (a mesh-sharded E axis cannot gather across shards without
+    resharding; the mesh service keeps the full grid and compacts
+    the packed result instead).
+    """
+    sub, up_a = _slice_columns(state, active_idx, up)
+    sub, won, res = _full_step_body(
+        sub, elect, cand, kind, slot, val, lease_ok, up_a,
+        axis_name=axis_name, exp_epoch=exp_epoch, exp_seq=exp_seq)
+    return _scatter_columns(state, sub, active_idx), won, res
+
+
+def _full_step_wide_sliced_body(state: EngineState,
+                                active_idx: jax.Array,
+                                elect: jax.Array, cand: jax.Array,
+                                kind: jax.Array, slot: jax.Array,
+                                val: jax.Array, lease_ok: jax.Array,
+                                up: jax.Array,
+                                axis_name: Optional[str] = None,
+                                exp_epoch: Optional[jax.Array] = None,
+                                exp_seq: Optional[jax.Array] = None
+                                ) -> Tuple[EngineState, jax.Array,
+                                           KvResult]:
+    """:func:`_full_step_sliced_body` with ``[G, A, W]`` conflict-free
+    wide op planes (see :func:`kv_step_scan_wide`; same active-set
+    contract as the scalar sliced step)."""
+    sub, up_a = _slice_columns(state, active_idx, up)
+    sub, won, res = _full_step_wide_body(
+        sub, elect, cand, kind, slot, val, lease_ok, up_a,
+        axis_name=axis_name, exp_epoch=exp_epoch, exp_seq=exp_seq)
+    return _scatter_columns(state, sub, active_idx), won, res
+
+
+full_step_sliced = jax.jit(_full_step_sliced_body,
+                           static_argnames=("axis_name",))
+
+#: donated-state variant (see :data:`full_step_donate`): the scatter
+#: back into the donated full planes is an in-place A-row update.
+full_step_sliced_donate = jax.jit(_full_step_sliced_body,
+                                  static_argnames=("axis_name",),
+                                  donate_argnums=(0,))
+
+full_step_wide_sliced = jax.jit(_full_step_wide_sliced_body,
+                                static_argnames=("axis_name",))
+
+full_step_wide_sliced_donate = jax.jit(_full_step_wide_sliced_body,
+                                       static_argnames=("axis_name",),
+                                       donate_argnums=(0,))

@@ -31,6 +31,21 @@ overlap the next round's device step.  Ordering, the WAL-before-ack
 barrier, and corruption→exchange semantics are preserved; see
 docs/ARCHITECTURE.md §7 "Two-phase launch pipeline".
 
+Launches are ACTIVE-COLUMN COMPACTED: one hot ensemble forces the
+`[K, E]` grid to its queue depth, but the flush gathers down to the
+columns that actually hold ops (`[K, A]`, A pow2-bucketed like the K
+ladder).  On single-shard engines at low occupancy the fused step
+itself runs on the gathered grid (``engine.full_step_sliced`` —
+compute, h2d and the packed d2h all scale with the live working
+set); mesh engines and mid-occupancy launches keep the full-grid
+step and gather only the packed result.  The host unpack scatters
+everything back to full width — pure re-indexing, results
+bit-identical to the full-width pack (``RETPU_COMPACT=0`` opts
+out); in pack-gather mode the corrupt mask stays full width so
+inactive columns' integrity flags still reach the scrub path.  See
+docs/ARCHITECTURE.md §7 "Active-column compaction and the (K, A)
+bucket grid".
+
 Read-modify-writes have a DEVICE FAST PATH: a ``kmodify`` whose
 mod-fun resolves against the funref device table (rmw:add & co) runs
 as one fused ``OP_RMW`` engine round — read, fun and commit under the
@@ -62,7 +77,8 @@ from riak_ensemble_tpu.types import NOTFOUND
 
 
 
-def _pack_results_body(won, res: eng.KvResult, want_vsn: bool):
+def _pack_results_body(won, res: eng.KvResult, want_vsn: bool,
+                       active_idx=None):
     """Flatten a launch's results into ONE uint8 vector on device.
 
     The host needs ~7 result arrays per launch; fetching them
@@ -74,10 +90,22 @@ def _pack_results_body(won, res: eng.KvResult, want_vsn: bool):
     width, bitcast into the same buffer: one fused pack, one
     transfer, ~3.6x less data than the all-int32 layout.
 
+    ACTIVE-COLUMN COMPACTION: ``active_idx [A]`` (A pow2-bucketed,
+    padding repeats index 0) gathers the per-round client planes down
+    to the columns the flush actually scheduled ops into
+    (:func:`engine.gather_result_columns`), so the payload scales
+    ``O(K·A)`` instead of ``O(K·E)`` — decoupled from the launch
+    grid.  The election/lease/corruption planes stay full width: the
+    host's lease renewal and scrub path see every column, active or
+    not.  ``None`` keeps the historical full-width layout.
+
     Layout: packbits([won E | quorum_ok E | corrupt E*M |
-    committed K*E | get_ok K*E | found K*E]) ++ bitcast_u8(
-    [value K*E | (vsn_epoch K*E | vsn_seq K*E)]).
+    committed K*A | get_ok K*A | found K*A]) ++ bitcast_u8(
+    [value K*A | (vsn_epoch K*A | vsn_seq K*A)])  (A = E when
+    uncompacted).
     """
+    if active_idx is not None:
+        res = eng.gather_result_columns(res, active_idx)
     flags = jnp.concatenate([
         won.ravel(),
         res.quorum_ok.any(0).ravel(),
@@ -100,7 +128,7 @@ _pack_results = jax.jit(_pack_results_body,
 
 @functools.partial(jax.jit, static_argnames=("want_vsn", "sharding"))
 def _pack_results_gathered(won, res: eng.KvResult, want_vsn: bool,
-                           sharding):
+                           sharding, active_idx=None):
     """Mesh-aware pack: a sharded step's result planes leave the
     kernel with MIXED shardings ('ens'-sharded [K, E] planes with E
     minor, peer-sharded corrupt masks, replicated scalars).  Raveling
@@ -114,13 +142,18 @@ def _pack_results_gathered(won, res: eng.KvResult, want_vsn: bool,
     turns the implicit remats into ordinary all-gathers riding ICI,
     and the pack itself runs replicated (no further resharding).
     ``sharding`` is the mesh's fully-replicated NamedSharding
-    (static: hashable and compile-time constant).
+    (static: hashable and compile-time constant).  The active-column
+    index vector is constrained replicated too — the column gather
+    then runs on the already-replicated planes instead of forcing a
+    resharding of its own.
     """
     def con(x):
         return jax.lax.with_sharding_constraint(x, sharding)
 
     return _pack_results_body(con(won), jax.tree.map(con, res),
-                              want_vsn)
+                              want_vsn,
+                              None if active_idx is None
+                              else con(active_idx))
 
 
 def _select_packer(engine):
@@ -150,15 +183,59 @@ def _wide_to_packed_layout(res: eng.KvResult, g: int, w: int,
         quorum_ok=t(res.quorum_ok))
 
 
+#: smallest active-column bucket the pack compiles: below 8 columns
+#: the payload is mostly headers anyway, and every extra (K, A)
+#: bucket is one more XLA program — the floor keeps the warm grid
+#: (and test suites full of tiny services) from compiling compaction
+#: variants that can't pay for themselves.
+A_BUCKET_MIN = 8
+
+#: smallest grid width the SLICED launch engages at: the slice adds
+#: fixed per-launch cost (host column slicing, the index upload, the
+#: gather/scatter dispatches) that only amortizes when the full-grid
+#: step it replaces is itself substantial — measured at the skewed
+#: CPU rung: ~3.9x ops/sec at E=512, but a net LOSS at E=64 where
+#: the full step is already sub-millisecond.  Below this, compaction
+#: still runs in pack-gather mode (the d2h payload cut is ~free).
+SLICE_MIN_E = 256
+
+
+def packed_nbytes(e: int, m: int, k: int, want_vsn: bool,
+                  a_width: Optional[int] = None) -> int:
+    """Size in bytes of one :func:`_pack_results` payload — the
+    per-flush d2h transfer.  ``a_width`` is the compacted column
+    count (None = full width E); used for the ``payload_bytes``
+    accounting and the bench's full-width-vs-compacted A/B."""
+    aw = e if a_width is None else a_width
+    nbits = 2 * e + e * m + 3 * k * aw
+    return (nbits + 7) // 8 + 4 * k * aw * (3 if want_vsn else 1)
+
+
 def unpack_results(flat: np.ndarray, e: int, m: int, k: int,
-                   want_vsn: bool):
+                   want_vsn: bool, active: Optional[np.ndarray] = None,
+                   a_width: int = 0, sliced: bool = False):
     """Invert :func:`_pack_results`: one packed uint8 vector →
     ``(won, quorum_ok, corrupt, committed, get_ok, found, value,
     vsn)`` host arrays (the k == 0 planes are None).  Module-level so
     the replica side of the replication group
     (:mod:`riak_ensemble_tpu.parallel.repgroup`) unpacks the SAME
-    layout its leader packs."""
-    nbits = 2 * e + e * m + 3 * k * e
+    layout its leader packs.
+
+    With ``active`` (the launch's active column index list, packed at
+    ``a_width`` pow2-padded columns), the per-round planes arrive
+    compacted ``[K, A]`` and are scattered back through the index
+    list into full-width ``[K, E]`` arrays — inactive columns get the
+    all-false/zero NOOP results a full-width pack would have carried
+    for them, so every downstream consumer (resolve loops, wide
+    routing, WAL, replica CRC) is layout-blind.  ``sliced`` marks a
+    launch whose step itself ran on the gathered grid: then the
+    won/quorum_ok/corrupt planes are A-width too and scatter the
+    same way (inactive columns won nothing, renewed nothing and
+    flagged nothing — exactly what the full grid reports for
+    columns no round touched)."""
+    aw = e if active is None else a_width
+    hw = aw if sliced else e  # election/quorum/corrupt plane width
+    nbits = 2 * hw + hw * m + 3 * k * aw
     bits = np.unpackbits(flat[:(nbits + 7) // 8],
                          count=nbits).astype(bool)
     ints = flat[(nbits + 7) // 8:].copy().view(np.int32)
@@ -176,74 +253,51 @@ def unpack_results(flat: np.ndarray, e: int, m: int, k: int,
         ioff += n
         return out.reshape(shape) if shape is not None else out
 
-    won = take_bits(e)
-    quorum_ok = take_bits(e)
-    corrupt = take_bits(e * m, (e, m))
+    won = take_bits(hw)
+    quorum_ok = take_bits(hw)
+    corrupt = take_bits(hw * m, (hw, m))
+    if sliced and active is not None:
+        a = len(active)
+
+        def scat_cols(c, shape):
+            out = np.zeros(shape, bool)
+            out[active] = c[:a]
+            return out
+        won = scat_cols(won, (e,))
+        quorum_ok = scat_cols(quorum_ok, (e,))
+        corrupt = scat_cols(corrupt, (e, m))
     if k:
-        committed = take_bits(k * e, (k, e))
-        get_ok = take_bits(k * e, (k, e))
-        found = take_bits(k * e, (k, e))
-        value = take_ints(k * e, (k, e))
+        committed = take_bits(k * aw, (k, aw))
+        get_ok = take_bits(k * aw, (k, aw))
+        found = take_bits(k * aw, (k, aw))
+        value = take_ints(k * aw, (k, aw))
         vsn = None
         if want_vsn:
-            vsn = np.stack([take_ints(k * e, (k, e)),
-                            take_ints(k * e, (k, e))], axis=-1)
+            vsn = np.stack([take_ints(k * aw, (k, aw)),
+                            take_ints(k * aw, (k, aw))], axis=-1)
+        if active is not None:
+            a = len(active)
+
+            def scatter(c, dtype):
+                out = np.zeros((k, e) + c.shape[2:], dtype)
+                out[:, active] = c[:, :a]
+                return out
+            committed = scatter(committed, bool)
+            get_ok = scatter(get_ok, bool)
+            found = scatter(found, bool)
+            value = scatter(value, np.int32)
+            if vsn is not None:
+                vsn = scatter(vsn, np.int32)
     else:
         committed = get_ok = found = value = vsn = None
     return won, quorum_ok, corrupt, committed, get_ok, found, value, vsn
 
 
 def warmup_kernels(svc: "BatchedEnsembleService") -> None:
-    """Pre-compile the launch path's XLA programs on a THROWAWAY
-    state (never the live one: a warmup launch that mutated
-    ``svc.state`` outside the real op stream would corrupt it — and
-    on a replication-group replica, diverge it from its group).
-    Flush depths are pow2-bucketed, so warming k in
-    {0, 1, 2, ..., max_k} covers every program a flush can launch;
-    without this, the first flush at each new depth pays a
-    tens-of-seconds compile in the middle of serving — the real p99
-    spike the steady-state breakdown can't show."""
-    import jax.numpy as jnp
-
-    e, m, s = svc.n_ens, svc.n_peers, svc.n_slots
-    pack = _select_packer(svc.engine)
-    # Warm the programs the launch path actually dispatches — with
-    # donation on, the donated executables (donation changes the
-    # compiled program's aliasing, so the plain warm wouldn't cover
-    # it).  The throwaway state is THREADED through the calls: a
-    # donated call consumes its input state.
-    step, step_wide = svc._step_fns()
-    st = svc.engine.init_state(e, m, s)
-    elect = jnp.zeros((e,), bool)
-    cand = jnp.zeros((e,), jnp.int32)
-    up = jnp.ones((e, m), bool)
-    k = 0
-    while True:
-        kind = jnp.zeros((k, e), jnp.int32)
-        lease = jnp.zeros((k, e), bool)
-        st, won, res = step(
-            st, elect, cand, kind, kind, kind, lease, up,
-            exp_epoch=kind, exp_seq=kind)
-        np.asarray(pack(won, res, True))
-        if k >= svc.max_k:
-            break
-        k = 1 if k == 0 else k * 2
-    if svc._wide and step_wide is not None:
-        # The wide gate admits plans with G in {1, 2} and pow2 W up to
-        # _pow2_at_least(flush depth) — a non-pow2 max_k still
-        # schedules into the NEXT pow2 width, so warm through it.
-        w_max = 1 << (max(svc.max_k, 1) - 1).bit_length()
-        for g in (1, 2):
-            w = 1
-            while w <= w_max:
-                kind = jnp.zeros((g, e, w), jnp.int32)
-                lease = jnp.zeros((g, e, w), bool)
-                st, won, res = step_wide(
-                    st, elect, cand, kind, kind, kind, lease, up,
-                    exp_epoch=kind, exp_seq=kind)
-                np.asarray(pack(
-                    won, _wide_to_packed_layout(res, g, w, e), True))
-                w *= 2
+    """Back-compat wrapper for
+    :meth:`BatchedEnsembleService.warmup` (the (K, A)-grid
+    pre-compile bench.py and svcnode share)."""
+    svc.warmup()
 
 
 class _LocalEngine:
@@ -258,6 +312,11 @@ class _LocalEngine:
     full_step_donate = staticmethod(eng.full_step_donate)
     full_step_wide = staticmethod(eng.full_step_wide)
     full_step_wide_donate = staticmethod(eng.full_step_wide_donate)
+    full_step_sliced = staticmethod(eng.full_step_sliced)
+    full_step_sliced_donate = staticmethod(eng.full_step_sliced_donate)
+    full_step_wide_sliced = staticmethod(eng.full_step_wide_sliced)
+    full_step_wide_sliced_donate = staticmethod(
+        eng.full_step_wide_sliced_donate)
     rebuild_trees = staticmethod(eng.rebuild_trees)
     exchange_step = staticmethod(eng.exchange_step)
     reconfig_step = staticmethod(eng.reconfig_step)
@@ -406,6 +465,15 @@ class _InFlightLaunch:
     leader_snapshot: Any
     lease_snapshot: Any
     donated: bool           # state buffers donated (no rollback)
+    #: active-column compaction: the launch's active ensemble index
+    #: list (None = full-width pack) and the pow2-bucketed packed
+    #: column count — the resolve half scatters the compact [K, A]
+    #: planes back through these.  ``sliced`` marks a launch whose
+    #: STEP ran on the gathered [K, A] grid (then the won/quorum/
+    #: corrupt planes are A-width too, not just the client planes).
+    active: Any = None
+    a_width: int = 0
+    sliced: bool = False
     #: flush path: the (ensemble, taken ops) pairs this launch serves
     taken: Any = None
     #: execute_async path: the client future + WAL planes + op count
@@ -575,6 +643,22 @@ class BatchedEnsembleService:
         #: launches that actually took the wide path (tests assert the
         #: A/B coverage is real; stats() reports it)
         self.wide_launches = 0
+        #: active-column compaction (RETPU_COMPACT=0 opts out): a
+        #: flush's packed d2h payload gathers down to the columns that
+        #: actually hold ops — O(K·A) instead of O(K·E) — with |A|
+        #: pow2-bucketed for compile reuse, mirroring the K ladder.
+        #: Pure re-indexing (results bit-identical to the full-width
+        #: pack); the corrupt mask stays full width so inactive
+        #: columns' integrity flags still reach the scrub path.
+        self._compact = os.environ.get("RETPU_COMPACT", "1") != "0"
+        #: payload observability: actual packed d2h bytes fetched, the
+        #: bytes the full-width [K, E] layout would have moved, and
+        #: the mean packed-grid occupancy (a_width / E; 1.0 for
+        #: full-width launches) — the compaction win, measurable
+        self.payload_bytes = 0
+        self.payload_bytes_full_width = 0
+        self._occ_sum = 0.0
+        self._occ_launches = 0
         #: RMW observability: host-path kmodify CAS attempts that
         #: failed and were retried (write races, plus transient
         #: quorum failures — indistinguishable client-side), and ops
@@ -631,6 +715,17 @@ class BatchedEnsembleService:
         self.data_dir = data_dir
         self.wal_sync = wal_sync
         self.wal_compact_records = wal_compact_records
+        #: WAL-compaction observability: save() is a full checkpoint
+        #: and used to run SYNCHRONOUSLY inside flush() the moment the
+        #: record bound tripped — a multi-hundred-ms pause billed to
+        #: whatever client op was in flight (the mixed p99 spike).
+        #: Compaction now waits for an idle flush (queues empty,
+        #: pipeline drained) and only runs in-line past a hard 2x
+        #: record bound; every run emits an ``svc_compaction`` latency
+        #: mark + trace event so the pause is attributable.
+        self.wal_compactions = 0
+        self.wal_compaction_ms_last = 0.0
+        self.wal_compaction_ms_total = 0.0
         self._wal = None
         self._in_save = False
         #: one-time flag: a WAL-enabled service served device-resident
@@ -2343,37 +2438,56 @@ class BatchedEnsembleService:
                                   exp_s, entries, elect, cand, lease_ok)
         return self._launch_resolve(fl)
 
-    def _step_fns(self) -> Tuple[Any, Any]:
-        """The (full_step, full_step_wide) programs the launch path
-        dispatches: the donated-state variants when donation is on and
-        the engine provides them (mesh engines may not).
+    def _step_fns(self) -> Tuple[Any, Any, Any, Any]:
+        """The (full_step, full_step_wide, full_step_sliced,
+        full_step_wide_sliced) programs the launch path dispatches:
+        the donated-state variants when donation is on and the engine
+        provides them (mesh engines may not).
 
         An engine subclass that overrides the PLAIN step but inherits
-        the donated one (test fault injectors, wrappers) must not have
-        its override silently bypassed: the donated variant is only
-        trusted when it is defined by the same class (or instance)
-        that defines the plain step."""
+        a specialized variant (test fault injectors, wrappers) must
+        not have its override silently bypassed: a donated or SLICED
+        variant is only trusted when it is defined by the same class
+        (or instance) that defines the plain step — otherwise the
+        launch falls back to the plain full-grid program (slicing is
+        an optimization, never a semantic requirement)."""
         e = self.engine
+
+        def definer(attr):
+            for c in type(e).__mro__:
+                if attr in c.__dict__:
+                    return c
+            return None
+
+        def variant(name: str, plain_name: str, fallback):
+            """The named specialized program, trusted only when its
+            definer matches the plain step's (None = use fallback)."""
+            fn = getattr(e, name, None)
+            if fn is None:
+                return fallback
+            if name in getattr(e, "__dict__", {}):
+                return fn  # instance-level pair: trust it
+            return (fn if definer(name) is definer(plain_name)
+                    else fallback)
+
         wide = getattr(e, "full_step_wide", None)
+        sliced = variant("full_step_sliced", "full_step", None)
+        wide_sliced = variant("full_step_wide_sliced",
+                              "full_step_wide", None)
         if self._donate:
-            def donated(name: str, plain_name: str, plain):
-                fn = getattr(e, name, None)
-                if fn is None:
-                    return plain
-                if name in getattr(e, "__dict__", {}):
-                    return fn  # instance-level pair: trust it
-                def definer(attr):
-                    for c in type(e).__mro__:
-                        if attr in c.__dict__:
-                            return c
-                    return None
-                return (fn if definer(name) is definer(plain_name)
-                        else plain)
-            return (donated("full_step_donate", "full_step",
+            return (variant("full_step_donate", "full_step",
                             e.full_step),
-                    donated("full_step_wide_donate", "full_step_wide",
-                            wide))
-        return e.full_step, wide
+                    variant("full_step_wide_donate", "full_step_wide",
+                            wide),
+                    # a rejected sliced step stays rejected: its
+                    # donated form must not resurrect it
+                    (variant("full_step_sliced_donate",
+                             "full_step_sliced", sliced)
+                     if sliced is not None else None),
+                    (variant("full_step_wide_sliced_donate",
+                             "full_step_wide_sliced", wide_sliced)
+                     if wide_sliced is not None else None))
+        return e.full_step, wide, sliced, wide_sliced
 
     def _launch_enqueue(self, kind: np.ndarray, slot: np.ndarray,
                         val: np.ndarray, k: int, want_vsn: bool,
@@ -2403,35 +2517,121 @@ class BatchedEnsembleService:
 
         t0 = time.perf_counter()
         plan = self._wide_plan(kind, slot, val, k, exp_e, exp_s)
+        step, step_wide, step_sliced, step_wide_sliced = \
+            self._step_fns()
+        # Active-column compaction, two strengths (the payload and
+        # the grid both decouple from E):
+        # - SLICED launch (single-shard engines, E >= SLICE_MIN_E,
+        #   |A| bucketed at or under E/4): the fused step itself
+        #   runs on the gathered [K, A] grid — compute, HBM traffic,
+        #   op-plane h2d and the packed result all scale with the
+        #   live working set.  The active set must include every
+        #   electing column (their rounds run inside the same
+        #   launch).
+        # - PACK-GATHER (mesh engines, small/mid grids, or |A| above
+        #   E/4): the step keeps the full grid; only the packed
+        #   result gathers down to [K, A] (the d2h cut alone).
+        # Buckets ride the pow2 A ladder (mirroring the K ladder's
+        # compile-reuse discipline).  Device-resident planes skip
+        # compaction (reading the kind plane back would break the
+        # zero-transfer contract).  The wide path compacts too: the
+        # scheduler only rearranges ops WITHIN their ensemble column,
+        # so the [K, E] planes' active set is the plan's as well.
+        active = aidx_j = None
+        a_width = 0
+        sliced = False
+        if self._compact and k and not isinstance(kind, jax.Array):
+            cols = np.flatnonzero(
+                (np.asarray(kind) != eng.OP_NOOP).any(axis=0)
+                | np.asarray(elect, bool))
+            if cols.size:
+                a_b = A_BUCKET_MIN
+                while a_b < cols.size:
+                    a_b <<= 1
+                if a_b < self.n_ens:
+                    active = cols.astype(np.int32)
+                    a_width = a_b
+                    have = (step_wide_sliced if plan is not None
+                            else step_sliced)
+                    sliced = (have is not None
+                              and self.n_ens >= SLICE_MIN_E
+                              and a_b * 4 <= self.n_ens)
+                    # sliced pads aim OUT OF RANGE (index E) so the
+                    # state scatter drops them; the pack gather pads
+                    # with column 0 (ignored by the host unpack)
+                    pad = np.full((a_b,),
+                                  self.n_ens if sliced else 0,
+                                  np.int32)
+                    pad[:cols.size] = active
+                    aidx_j = jnp.asarray(pad)
         # h2d slimming (the tunnel link is the throughput ceiling in
-        # both directions): the lease plane uploads as [E] and
-        # broadcasts to the op-plane shape device-side; the up mask
-        # uploads only when the failure detector actually changed it.
-        # EVERY input upload belongs to the h2d mark — an asarray
-        # inlined into the step call would bill its (synchronous)
-        # transfer to 'dispatch' and make the async-enqueue number
-        # read milliseconds of jitter it doesn't have (VERDICT r3 #4).
+        # both directions): the lease plane uploads as [E] (sliced:
+        # [A]) and broadcasts to the op-plane shape device-side; the
+        # up mask uploads only when the failure detector actually
+        # changed it (sliced launches gather it on device).  EVERY
+        # input upload belongs to the h2d mark — an asarray inlined
+        # into the step call would bill its (synchronous) transfer to
+        # 'dispatch' and make the async-enqueue number read
+        # milliseconds of jitter it doesn't have (VERDICT r3 #4).
+        a_n = 0 if active is None else len(active)
+
+        def cslice(p):
+            """Host column slice [K, E](, W) → [K, a_width](, W);
+            padding columns stay NOOP/zero."""
+            out = np.zeros(p.shape[:1] + (a_width,) + p.shape[2:],
+                           p.dtype)
+            out[:, :a_n] = np.asarray(p)[:, active]
+            return out
+
+        def vslice(v, dtype):
+            out = np.zeros((a_width,), dtype)
+            out[:a_n] = np.asarray(v)[active]
+            return out
+
+        e_w = a_width if sliced else self.n_ens
         if plan is not None:
             g_b, _, w_b = plan.kind.shape
+            lease_np = (vslice(lease_ok, bool) if sliced
+                        else np.asarray(lease_ok))
             lease_j = jnp.broadcast_to(
-                jnp.asarray(lease_ok)[None, :, None],
-                (g_b, self.n_ens, w_b))
-            kind_j, slot_j, val_j = (jnp.asarray(plan.kind),
-                                     jnp.asarray(plan.slot),
-                                     jnp.asarray(plan.val))
-            exp_e_j = jnp.asarray(plan.exp_epoch)
-            exp_s_j = jnp.asarray(plan.exp_seq)
+                jnp.asarray(lease_np)[None, :, None],
+                (g_b, e_w, w_b))
+            kp = (cslice(plan.kind), cslice(plan.slot),
+                  cslice(plan.val), cslice(plan.exp_epoch),
+                  cslice(plan.exp_seq)) if sliced else (
+                  plan.kind, plan.slot, plan.val, plan.exp_epoch,
+                  plan.exp_seq)
+            kind_j, slot_j, val_j = (jnp.asarray(kp[0]),
+                                     jnp.asarray(kp[1]),
+                                     jnp.asarray(kp[2]))
+            exp_e_j = jnp.asarray(kp[3])
+            exp_s_j = jnp.asarray(kp[4])
         else:
             g_b = w_b = 0
-            lease_j = (jnp.broadcast_to(jnp.asarray(lease_ok),
-                                        (k, self.n_ens))
+            lease_np = (vslice(lease_ok, bool) if sliced
+                        else np.asarray(lease_ok))
+            lease_j = (jnp.broadcast_to(jnp.asarray(lease_np),
+                                        (k, e_w))
                        if k else jnp.zeros((0, self.n_ens), bool))
-            kind_j, slot_j, val_j = (jnp.asarray(kind),
-                                     jnp.asarray(slot),
-                                     jnp.asarray(val))
-            exp_e_j = None if exp_e is None else jnp.asarray(exp_e)
-            exp_s_j = None if exp_s is None else jnp.asarray(exp_s)
-        elect_j, cand_j = jnp.asarray(elect), jnp.asarray(cand)
+            if sliced:
+                kind_j, slot_j, val_j = (jnp.asarray(cslice(kind)),
+                                         jnp.asarray(cslice(slot)),
+                                         jnp.asarray(cslice(val)))
+                exp_e_j = (None if exp_e is None
+                           else jnp.asarray(cslice(exp_e)))
+                exp_s_j = (None if exp_s is None
+                           else jnp.asarray(cslice(exp_s)))
+            else:
+                kind_j, slot_j, val_j = (jnp.asarray(kind),
+                                         jnp.asarray(slot),
+                                         jnp.asarray(val))
+                exp_e_j = None if exp_e is None else jnp.asarray(exp_e)
+                exp_s_j = None if exp_s is None else jnp.asarray(exp_s)
+        if sliced:
+            elect_j = jnp.asarray(vslice(elect, bool))
+            cand_j = jnp.asarray(vslice(cand, np.int32))
+        else:
+            elect_j, cand_j = jnp.asarray(elect), jnp.asarray(cand)
         up_j = self._up_device()
         t1 = time.perf_counter()
 
@@ -2446,26 +2646,45 @@ class BatchedEnsembleService:
         state_snapshot = self.state
         leader_snapshot = self.leader_np
         lease_snapshot = self.lease_until.copy()
-        step, step_wide = self._step_fns()
-        attr = ("full_step_wide_donate" if plan is not None
+        attr = ("full_step_wide_sliced_donate"
+                if plan is not None and sliced
+                else "full_step_wide_donate" if plan is not None
+                else "full_step_sliced_donate" if sliced
                 else "full_step_donate")
         donated = (self._donate
                    and getattr(self.engine, attr, None) is not None)
         try:
             if plan is not None:
-                state, won, res = step_wide(
-                    self.state, elect_j, cand_j, kind_j, slot_j, val_j,
-                    lease_j, up_j, exp_epoch=exp_e_j, exp_seq=exp_s_j)
-                res = _wide_to_packed_layout(res, g_b, w_b, self.n_ens)
+                if sliced:
+                    state, won, res = step_wide_sliced(
+                        self.state, aidx_j, elect_j, cand_j, kind_j,
+                        slot_j, val_j, lease_j, up_j,
+                        exp_epoch=exp_e_j, exp_seq=exp_s_j)
+                else:
+                    state, won, res = step_wide(
+                        self.state, elect_j, cand_j, kind_j, slot_j,
+                        val_j, lease_j, up_j, exp_epoch=exp_e_j,
+                        exp_seq=exp_s_j)
+                res = _wide_to_packed_layout(res, g_b, w_b, e_w)
                 k_eff = g_b * w_b
                 self.wide_launches += 1
             else:
-                state, won, res = step(
-                    self.state, elect_j, cand_j, kind_j, slot_j, val_j,
-                    lease_j, up_j, exp_epoch=exp_e_j, exp_seq=exp_s_j)
+                if sliced:
+                    state, won, res = step_sliced(
+                        self.state, aidx_j, elect_j, cand_j, kind_j,
+                        slot_j, val_j, lease_j, up_j,
+                        exp_epoch=exp_e_j, exp_seq=exp_s_j)
+                else:
+                    state, won, res = step(
+                        self.state, elect_j, cand_j, kind_j, slot_j,
+                        val_j, lease_j, up_j, exp_epoch=exp_e_j,
+                        exp_seq=exp_s_j)
                 k_eff = k
             self.state = state
-            flat = self._pack(won, res, want_vsn)
+            # a sliced launch's result planes are ALREADY A-width;
+            # pack-gather mode hands the pack the index vector
+            flat = self._pack(won, res, want_vsn,
+                              active_idx=None if sliced else aidx_j)
             # Kick the packed vector's d2h transfer off NOW — the
             # resolve half (possibly a full flush later) only blocks
             # on its completion, so the transfer rides under the next
@@ -2485,7 +2704,8 @@ class BatchedEnsembleService:
             elect=elect, cand=cand, now=now,
             state_snapshot=state_snapshot,
             leader_snapshot=leader_snapshot,
-            lease_snapshot=lease_snapshot, donated=donated)
+            lease_snapshot=lease_snapshot, donated=donated,
+            active=active, a_width=a_width, sliced=sliced)
 
     def _fetch_packed(self, fl: _InFlightLaunch) -> np.ndarray:
         """Block until the launch's packed result is on the host (the
@@ -2541,7 +2761,18 @@ class BatchedEnsembleService:
             e, m = self.n_ens, self.n_peers
             (won_np, quorum_ok, corrupt_np, committed, get_ok, found,
              value, vsn) = unpack_results(flat, e, m, fl.k_eff,
-                                          fl.want_vsn)
+                                          fl.want_vsn, active=fl.active,
+                                          a_width=fl.a_width,
+                                          sliced=fl.sliced)
+            # Compaction observability: the actual d2h bytes vs the
+            # full-width [K, E] layout's, and the packed-grid
+            # occupancy (skewed/partial load drives this toward 0).
+            self.payload_bytes += int(flat.nbytes)
+            self.payload_bytes_full_width += packed_nbytes(
+                e, m, fl.k_eff, fl.want_vsn)
+            self._occ_sum += (fl.a_width / e if fl.active is not None
+                              else 1.0)
+            self._occ_launches += 1
             corrupt = corrupt_np if fl.k else None
             if fl.plan is not None:
                 # Route the [G*W, E] results back to the caller's
@@ -2711,10 +2942,24 @@ class BatchedEnsembleService:
         exchange (corruption-triggered), wal (durability barrier),
         resolve (future fan-out).  'enqueue' is a derived mark
         (h2d + dispatch — the whole enqueue half) excluded from the
-        'total' sum.  This is what makes the BASELINE p99 target
-        analyzable before and after a platform change (VERDICT r2)."""
+        'total' sum.  ``svc_compaction`` (the deferred WAL fold, a
+        rare EVENT rather than a per-launch component) is reported
+        over its own occurrences only — averaging it into 1000+
+        launch records would both hide the pause (p99 = 0) and
+        inject zero samples into every launch component.  This is
+        what makes the BASELINE p99 target analyzable before and
+        after a platform change (VERDICT r2)."""
         recs = list(self.lat_records)
         out: Dict[str, Dict[str, float]] = {}
+        events = [r for r in recs if "svc_compaction" in r]
+        recs = [r for r in recs if "svc_compaction" not in r]
+        if events:
+            vals = np.asarray([r["svc_compaction"]
+                               for r in events]) * 1e3
+            out["svc_compaction"] = {
+                "p50_ms": float(np.percentile(vals, 50)),
+                "p99_ms": float(np.percentile(vals, 99)),
+                "mean_ms": float(vals.mean())}
         if not recs:
             return out
         comps = sorted({c for r in recs for c in r if c != "k"})
@@ -2745,7 +2990,161 @@ class BatchedEnsembleService:
             "launches_in_flight": len(self._inflight_launches),
             "rmw_conflicts": self.rmw_conflicts,
             "rmw_device_fastpath": self.rmw_device_fastpath,
+            # active-column compaction: packed d2h bytes actually
+            # moved vs the full-width [K, E] layout, and the mean
+            # packed-grid occupancy (a_width / E; 1.0 = uncompacted)
+            "payload_bytes": self.payload_bytes,
+            "payload_bytes_full_width": self.payload_bytes_full_width,
+            "grid_occupancy": (self._occ_sum / self._occ_launches
+                               if self._occ_launches else 1.0),
+            # WAL-compaction pauses (deferred off the hot path; the
+            # svc_compaction latency mark carries the same numbers
+            # into latency_breakdown())
+            "svc_compaction": {
+                "count": self.wal_compactions,
+                "last_ms": round(self.wal_compaction_ms_last, 3),
+                "total_ms": round(self.wal_compaction_ms_total, 3),
+            },
         }
+
+    # -- (K, A)-grid pre-compile --------------------------------------------
+
+    def _a_ladder(self) -> List[Optional[int]]:
+        """Active-column bucket widths the launch path can pack at:
+        full width (None) plus, with compaction on, the pow2 ladder
+        from A_BUCKET_MIN strictly below E."""
+        ladder: List[Optional[int]] = [None]
+        if self._compact:
+            b = A_BUCKET_MIN
+            while b < self.n_ens:
+                ladder.append(b)
+                b <<= 1
+        return ladder
+
+    def warmup(self, buckets=None) -> None:
+        """Pre-compile the launch path's XLA programs on a THROWAWAY
+        state (never the live one: a warmup launch that mutated
+        ``self.state`` outside the real op stream would corrupt it —
+        and on a replication-group replica, diverge it from its
+        group).
+
+        Flush depths are pow2-bucketed and the packed-result program
+        is additionally keyed by the active-column bucket, so the
+        grid is (K, A): K in {0, 1, 2, ..., max_k} × A in the pow2
+        ladder below E plus full width.  Without this, the first
+        flush at each new (K, A) bucket pays its compile in the
+        middle of serving — the dispatch p99 blip the steady-state
+        breakdown can't show.  The pack programs warm on the step's
+        REAL outputs so mesh-sharded result placements compile the
+        executables the live flush dispatches.
+
+        ``buckets``: optional iterable of ``(k, a_width)`` pairs
+        (a_width None = full width) restricting the PACK grid — the
+        step ladder always warms in full.  bench.py and svcnode share
+        the default full grid.
+        """
+        jnp = self._jnp
+        e, m, s = self.n_ens, self.n_peers, self.n_slots
+        pack = self._pack
+        by_k: Optional[Dict[int, List[Optional[int]]]] = None
+        if buckets is not None:
+            by_k = {}
+            for kb, aw in buckets:
+                by_k.setdefault(int(kb), []).append(aw)
+
+        def a_widths(k_eff: int) -> List[Optional[int]]:
+            if k_eff == 0:
+                return [None]  # no per-round planes to compact
+            if by_k is not None:
+                return by_k.get(k_eff, [])
+            return self._a_ladder()
+
+        # Warm the programs the launch path actually dispatches — with
+        # donation on, the donated executables (donation changes the
+        # compiled program's aliasing, so the plain warm wouldn't cover
+        # it).  The throwaway state is THREADED through the calls: a
+        # donated call consumes its input state.  Per (K, A) bucket
+        # the launch dispatches EITHER the sliced step (A <= E/4 on a
+        # sliced-capable engine: step + plain pack at A-width) OR the
+        # full-grid step with the gathering pack — warm exactly that.
+        step, step_wide, step_sliced, step_wide_sliced = \
+            self._step_fns()
+        st = self.engine.init_state(e, m, s)
+        elect = jnp.zeros((e,), bool)
+        cand = jnp.zeros((e,), jnp.int32)
+        up = jnp.ones((e, m), bool)
+
+        def warm_bucket(k_eff: int, aw: int, wide_gw=None):
+            """One (K, A) bucket: the sliced program when the launch
+            path would slice there, else the pack-gather program on
+            the full-grid result already computed by the caller."""
+            nonlocal st
+            use_sliced = ((step_wide_sliced if wide_gw else
+                           step_sliced) is not None
+                          and e >= SLICE_MIN_E and aw * 4 <= e)
+            if not use_sliced:
+                return False
+            # all-pad index vector: gathers clip harmlessly, the
+            # scatter drops everything — state untouched, program
+            # compiled
+            aidx = jnp.full((aw,), e, jnp.int32)
+            el = jnp.zeros((aw,), bool)
+            cd = jnp.zeros((aw,), jnp.int32)
+            if wide_gw:
+                g, w = wide_gw
+                kind_a = jnp.zeros((g, aw, w), jnp.int32)
+                lease_a = jnp.zeros((g, aw, w), bool)
+                st, won, res = step_wide_sliced(
+                    st, aidx, el, cd, kind_a, kind_a, kind_a,
+                    lease_a, up, exp_epoch=kind_a, exp_seq=kind_a)
+                res = _wide_to_packed_layout(res, g, w, aw)
+            else:
+                kind_a = jnp.zeros((k_eff, aw), jnp.int32)
+                lease_a = jnp.zeros((k_eff, aw), bool)
+                st, won, res = step_sliced(
+                    st, aidx, el, cd, kind_a, kind_a, kind_a,
+                    lease_a, up, exp_epoch=kind_a, exp_seq=kind_a)
+            np.asarray(pack(won, res, True))
+            return True
+
+        def warm_pack(won, res, k_eff: int, wide_gw=None) -> None:
+            for aw in a_widths(k_eff):
+                if aw is None:
+                    np.asarray(pack(won, res, True))
+                elif not warm_bucket(k_eff, aw, wide_gw):
+                    np.asarray(pack(
+                        won, res, True,
+                        active_idx=jnp.zeros((aw,), jnp.int32)))
+
+        k = 0
+        while True:
+            kind = jnp.zeros((k, e), jnp.int32)
+            lease = jnp.zeros((k, e), bool)
+            st, won, res = step(
+                st, elect, cand, kind, kind, kind, lease, up,
+                exp_epoch=kind, exp_seq=kind)
+            warm_pack(won, res, k)
+            if k >= self.max_k:
+                break
+            k = 1 if k == 0 else k * 2
+        if self._wide and step_wide is not None:
+            # The wide gate admits plans with G in {1, 2} and pow2 W
+            # up to _pow2_at_least(flush depth) — a non-pow2 max_k
+            # still schedules into the NEXT pow2 width, so warm
+            # through it.
+            w_max = 1 << (max(self.max_k, 1) - 1).bit_length()
+            for g in (1, 2):
+                w = 1
+                while w <= w_max:
+                    kind = jnp.zeros((g, e, w), jnp.int32)
+                    lease = jnp.zeros((g, e, w), bool)
+                    st, won, res = step_wide(
+                        st, elect, cand, kind, kind, kind, lease, up,
+                        exp_epoch=kind, exp_seq=kind)
+                    warm_pack(won,
+                              _wide_to_packed_layout(res, g, w, e),
+                              g * w, wide_gw=(g, w))
+                    w *= 2
 
     def execute(self, kind: np.ndarray, slot: np.ndarray,
                 val: np.ndarray,
@@ -3076,8 +3475,17 @@ class BatchedEnsembleService:
         if (self._wal is not None and not self._in_save
                 and self._wal.count >= self.wal_compact_records):
             # WAL grew past the compaction bound: fold it into a fresh
-            # checkpoint (save() rotates the generation).
-            self.save()
+            # checkpoint (save() rotates the generation) — but OFF the
+            # hot path.  save() is a full checkpoint (hundreds of ms);
+            # running it synchronously inside a loaded flush billed
+            # the pause to whatever client op was in flight (the
+            # mixed-load p99 spike vs a ~20 ms p50).  Defer to an idle
+            # flush — queues empty AND launch pipeline drained — and
+            # fall back to in-line only past a hard 2x record bound,
+            # so sustained load still bounds replay time.
+            idle = not self._active and not self._inflight_launches
+            if idle or self._wal.count >= 2 * self.wal_compact_records:
+                self._compact_wal(idle)
         if (self.scrub_every_flushes
                 and self.flushes - self._scrubbed_at_flush
                 >= self.scrub_every_flushes):
@@ -3096,6 +3504,23 @@ class BatchedEnsembleService:
             for _at, _e, fut, thunk in parked:
                 if not fut.done:
                     thunk()
+
+    def _compact_wal(self, idle: bool) -> None:
+        """Fold the WAL into a fresh checkpoint, timed and marked:
+        an ``svc_compaction`` latency record (latency_breakdown) +
+        trace event + stats() counters make the pause attributable
+        instead of vanishing into some client op's p99."""
+        records = self._wal.count
+        t0 = time.perf_counter()
+        self.save()
+        dt = time.perf_counter() - t0
+        self.wal_compactions += 1
+        self.wal_compaction_ms_last = dt * 1e3
+        self.wal_compaction_ms_total += dt * 1e3
+        self.lat_records.append({"svc_compaction": dt})
+        self._emit("svc_compaction",
+                   {"ms": round(dt * 1e3, 3), "records": records,
+                    "idle": idle})
 
     # -- launch pipeline (two-phase async service execution) ---------------
 

@@ -544,7 +544,14 @@ class ReplicaCore:
         cand = np.zeros((e_n,), np.int32)
         # unbound base calls: a ReplicatedService in the replica role
         # must apply through the PLAIN launch halves (its own
-        # overrides would try to re-replicate / demand leadership)
+        # overrides would try to re-replicate / demand leadership).
+        # Active-column compaction composes transparently: the active
+        # set is a pure function of the shipped kind plane, so this
+        # lane packs/unpacks the SAME [K, A] layout its leader did —
+        # and the unpack scatters back to full-width planes, so the
+        # apply-stream mirrors, WAL records and the ack CRC below are
+        # layout-blind (bit-identical across lanes even if one side
+        # disabled compaction via RETPU_COMPACT=0).
         fl = BatchedEnsembleService._launch_enqueue(
             svc, kind, slot, val, k, want_vsn=want_vsn,
             exp_e=exp_e, exp_s=exp_s, elect=elect, cand=cand,

@@ -424,11 +424,11 @@ async def serve(n_ens: int, n_peers: int, n_slots: int,
             runtime, n_ens, n_peers, n_slots, tick=tick, config=cfg,
             engine=engine, dynamic=bool(dynamic), data_dir=data_dir)
     if warm:
-        # pre-compile the pow2 flush-depth ladder so no client ever
-        # pays a mid-serving first-compile inside its op latency
-        from riak_ensemble_tpu.parallel.batched_host import (
-            warmup_kernels)
-        warmup_kernels(svc)
+        # pre-compile the (K, A) bucket grid — pow2 flush depths x
+        # pow2 active-column widths — so no client ever pays a
+        # mid-serving first-compile inside its op latency (the
+        # dispatch p99 blip)
+        svc.warmup()
     server = ServiceServer(svc, host, port)
     await server.start()
     return server
@@ -452,9 +452,11 @@ def main(argv=None) -> int:
     ap.add_argument("--data-dir", default=None,
                     help="durability root (WAL + checkpoints); acked "
                          "writes survive crashes")
-    ap.add_argument("--warm", action="store_true",
-                    help="pre-compile the flush-depth ladder before "
-                         "accepting clients (slower boot, no "
+    ap.add_argument("--warm", "--warm-flush-ladder", dest="warm",
+                    action="store_true",
+                    help="pre-compile the (K, A) flush ladder — pow2 "
+                         "batch depths x pow2 active-column buckets — "
+                         "before accepting clients (slower boot, no "
                          "mid-serving compile spikes)")
     args = ap.parse_args(argv)
 

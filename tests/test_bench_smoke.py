@@ -34,3 +34,20 @@ def test_mixed_rung_smoke():
     assert out["mixed_ops_per_sec"] > 0
     assert out["mixed_p99_ms"] >= out["mixed_p50_ms"] >= 0
     assert 0 < out["mixed_commit_fraction"] <= 1
+
+
+def test_skewed_rung_smoke():
+    """The compaction-regression tripwire: at the smoke shape the
+    skewed rung's per-flush packed payload must stay under 25% of the
+    full-width K·E layout's — a change that silently re-inflates the
+    d2h transfer (compaction bypassed, active set mis-computed, pack
+    layout regressed) fails tier-1 here.  warm/baseline off: the
+    smoke pins shapes and the payload ratio, not the speedup."""
+    out = bench.run_skewed_service(n_ens=128, n_peers=3, n_slots=8,
+                                   k=8, seconds=0.05, warm=False,
+                                   baseline=False)
+    assert out["skewed_ops_per_sec"] > 0
+    assert 0 < out["grid_occupancy"] < 0.25
+    assert out["payload_bytes_per_flush"] > 0
+    assert (out["payload_bytes_per_flush"]
+            < 0.25 * out["payload_bytes_full_width_per_flush"]), out
