@@ -223,6 +223,11 @@ def run_service(n_ens: int, n_peers: int, n_slots: int, k: int,
     out.update(run_skewed_service(
         min(n_ens, 512), n_peers, min(n_slots, 64), min(k, 16),
         seconds))
+    # read-heavy rung at the 512-ens shape with the fastpath-off A/B
+    # arm (the lease-protected read fast path's headline)
+    out.update(run_read_service(
+        min(n_ens, 512), n_peers, min(n_slots, 64), min(k, 16),
+        seconds))
     return out
 
 
@@ -285,8 +290,10 @@ def run_mixed_service(n_ens: int, n_peers: int, n_slots: int, k: int,
     # warm (compile both the exp and no-exp shapes)
     kind, slot, val, exp_e, exp_s = build(None)
     svc.execute(kind, slot, val, exp_epoch=exp_e, exp_seq=exp_s)
+    svc.lat_records.clear()  # tail attribution wants steady state
 
     lat = []
+    recs = []  # per-batch launch-latency record, aligned with lat
     ops = commits = gets_ok = 0
     prev_vsn = None
     t_end = time.perf_counter() + max(seconds, 1e-3)
@@ -297,6 +304,7 @@ def run_mixed_service(n_ens: int, n_peers: int, n_slots: int, k: int,
         committed, get_ok, found, value = svc.execute(
             kind, slot, val, exp_epoch=exp_e, exp_seq=exp_s)
         lat.append(time.perf_counter() - t0)
+        recs.append(svc.lat_records[-1] if svc.lat_records else {})
         ops += k * n_ens
         commits += int(committed.sum())
         gets_ok += int(get_ok.sum())
@@ -312,11 +320,38 @@ def run_mixed_service(n_ens: int, n_peers: int, n_slots: int, k: int,
     # sanity: the mix must exercise all three kernel families
     assert commits > 0 and gets_ok > 0, "mixed bench: degenerate mix"
     lat_ms = np.asarray(lat) * 1000.0
+    p50 = float(np.percentile(lat_ms, 50))
+    # TAIL ATTRIBUTION: for every batch slower than 5x the rung's own
+    # p50, name the latency mark that dominated its launch record —
+    # so the mixed p99 points at a cause (d2h stall, exchange sweep,
+    # plane build outside the record → 'untracked') instead of being
+    # an unexplained number in the round JSON.
+    tail_causes: dict = {}
+    n_tail = 0
+    for ms, rec in zip(lat_ms.tolist(), recs):
+        if ms <= 5 * p50:
+            continue
+        n_tail += 1
+        comps = {c: v for c, v in rec.items()
+                 if c not in ("k", "total", "enqueue")}
+        tracked = sum(comps.values()) * 1e3
+        if not comps or tracked < ms / 2:
+            # the launch record explains under half the batch time:
+            # the stall was outside the launch (host plane build, GC,
+            # scheduler) — say so rather than blaming a component
+            cause = "untracked_host"
+        else:
+            cause = max(comps, key=comps.get)
+        tail_causes[cause] = tail_causes.get(cause, 0) + 1
     return {
         "mixed_ops_per_sec": ops / elapsed,
-        "mixed_p50_ms": float(np.percentile(lat_ms, 50)),
+        "mixed_p50_ms": p50,
         "mixed_p99_ms": float(np.percentile(lat_ms, 99)),
         "mixed_commit_fraction": round(commits / max(ops, 1), 3),
+        "mixed_tail_batches": n_tail,
+        "mixed_tail_causes": tail_causes,
+        "mixed_tail_top_cause": (max(tail_causes, key=tail_causes.get)
+                                 if tail_causes else None),
     }
 
 
@@ -467,6 +502,211 @@ def run_skewed_service(n_ens: int, n_peers: int, n_slots: int, k: int,
         out["skewed_baseline_ops_per_sec"] = b["ops_per_sec"]
         out["skewed_compaction_speedup"] = round(
             a["ops_per_sec"] / b["ops_per_sec"], 2)
+    return out
+
+
+def run_read_service(n_ens: int, n_peers: int, n_slots: int, k: int,
+                     seconds: float, warm: bool = True,
+                     baseline: bool = True) -> dict:
+    """The READ-HEAVY rung (90/10 kget/kput over pre-populated keys)
+    — the lease-protected read fast path's target shape, as a
+    fastpath-on vs fastpath-off A/B.
+
+    With the fast path on, the 90% reads are answered from the
+    leader's committed host mirror (no OP_GET row, no flush) and only
+    the writes launch; the off arm routes every read through the
+    device round — write rounds and read rounds compete for the same
+    [K, E] grid.  Reports both arms' ops/sec, the speedup, the
+    fast-path hit rate + miss reasons, per-round latency, and an
+    EQUIVALENCE sweep: after the timed loop every key is read through
+    the fast path AND through a forced device round, and the values
+    must agree (the linearizable-read contract, cheap form)."""
+    from riak_ensemble_tpu.parallel.batched_host import (
+        BatchedEnsembleService, WallRuntime,
+    )
+
+    # disjoint read/write key sets: the rung's reads are UNCONTENDED
+    # (the hit-rate tripwire's premise) — writes land on their own
+    # keys, so no read parks on a pending same-slot write
+    n_keys = max(1, min(n_slots // 2, 8))
+    keys = [f"key{j}" for j in range(n_keys)]
+    wkeys = [f"wkey{j}" for j in range(n_keys)]
+
+    def arm(fast: bool) -> dict:
+        svc = BatchedEnsembleService(WallRuntime(), n_ens, n_peers,
+                                     n_slots, tick=None,
+                                     max_ops_per_tick=k)
+        svc.set_fast_reads(fast)
+        if warm:
+            svc.warmup()
+        # populate every key so the off arm's reads genuinely launch
+        # (absent keys short-circuit NOTFOUND in both arms)
+        futs = [svc.kput(e, kk, b"r%d" % j)
+                for e in range(n_ens)
+                for j, kk in enumerate(keys + wkeys)]
+        while any(svc.queues):
+            svc.flush()
+        assert all(f.done and f.value[0] == "ok" for f in futs), \
+            "read bench: populate failed"
+
+        # EXACTLY ceil(k/10) writes per ensemble per round — the
+        # 90/10 mix with a STABLE flush K bucket, so the warm round
+        # compiles every shape the timed loop uses (varying write
+        # draws would bounce the pow2 bucket and bill fresh XLA
+        # compiles to random rounds).  Both arms ride the VECTORIZED
+        # surface (kget_many/kput_many): the scalar path's per-op
+        # Python would cap the fast arm long before the device does,
+        # understating exactly the device-round cost this rung
+        # measures.
+        n_writes = max(1, (k + 9) // 10)
+        read_keys = [keys[j % n_keys] for j in range(k - n_writes)]
+        wvals = [b"w%d" % j for j in range(n_writes)]
+
+        # failed results accumulate across EVERY round (not just the
+        # final one) so a mid-run blip can't hide inside the
+        # throughput number
+        failed = [0]
+
+        def one_round(shift: int = 0):
+            futs = []
+            wk = [wkeys[(shift + j) % n_keys] for j in range(n_writes)]
+            for e in range(n_ens):
+                futs.append(svc.kget_many(e, read_keys))
+                futs.append(svc.kput_many(e, wk, wvals))
+            while any(svc.queues):
+                svc.flush()
+            svc.flush()  # settle any in-flight tail
+            assert all(f.done for f in futs), "read bench: unsettled"
+            failed[0] += sum(1 for f in futs for r in f.value
+                             if r[0] != "ok")
+            return futs, n_ens * len(read_keys)
+
+        # TWO warm rounds: the first's reads may still miss (the
+        # populate flush's compile outlived its own lease grant), so
+        # it re-leases and serves full-grid; the second exercises the
+        # real steady state — fast reads + the write-only small-K
+        # flush — compiling that shape outside the measured window
+        # and outside the hit-rate tripwire
+        one_round()
+        one_round()
+        svc.read_fastpath_hits = 0
+        svc.read_fastpath_misses = 0
+        svc.read_fastpath_miss_reasons.clear()
+        failed[0] = 0  # warm rounds excluded, like the counters
+
+        # -- phase 1: the 90/10 MIXED loop (write-coupled number:
+        # every round still pays its write flush, now K=ceil(k/10)
+        # instead of K=k — the reclaimed-grid write win rides here)
+        lat: list = []
+        ops = reads = rounds = 0
+        t_end = time.perf_counter() + max(seconds, 1e-3)
+        t0 = time.perf_counter()
+        while time.perf_counter() < t_end or not lat:
+            tb = time.perf_counter()
+            futs, n_reads = one_round(shift=rounds)
+            lat.append(time.perf_counter() - tb)
+            ops += n_ens * k
+            reads += n_reads
+            rounds += 1
+        elapsed = time.perf_counter() - t0
+        assert failed[0] == 0, \
+            f"read bench: {failed[0]} op(s) failed across the mix"
+
+        # -- phase 2: the UNCONTENDED read-only loop — the
+        # decoupling headline.  Fast-path rounds never launch (reads
+        # answer from the mirror; the periodic lease-renewal round
+        # when the margin trips is part of the honest steady state);
+        # the off arm pays a full device round per batch.
+        ro_reads = 0
+        ro_lat: list = []
+        t_end = time.perf_counter() + max(seconds, 1e-3)
+        t0 = time.perf_counter()
+        while time.perf_counter() < t_end or not ro_lat:
+            tb = time.perf_counter()
+            futs = [svc.kget_many(e, read_keys)
+                    for e in range(n_ens)]
+            while any(svc.queues):
+                svc.flush()
+            svc.flush()
+            assert all(f.done for f in futs), "read bench: unsettled"
+            failed[0] += sum(1 for f in futs for r in f.value
+                             if r[0] != "ok")
+            ro_lat.append(time.perf_counter() - tb)
+            ro_reads += n_ens * len(read_keys)
+        ro_elapsed = time.perf_counter() - t0
+        assert failed[0] == 0, \
+            f"read bench: {failed[0]} read(s) failed (read-only phase)"
+        # counters snapshot BEFORE the equivalence sweep (its forced
+        # device reads must not pollute the hit-rate number)
+        hits = svc.read_fastpath_hits
+        misses = svc.read_fastpath_misses
+        miss_reasons = dict(svc.read_fastpath_miss_reasons)
+
+        # equivalence sweep: fast-path answers == forced device-round
+        # answers for every key (run on the FAST arm; trivially true
+        # on the off arm)
+        equiv = 0
+        if fast:
+            for e in range(0, n_ens, max(1, n_ens // 16)):
+                fast_futs = [svc.kget(e, kk) for kk in keys]
+                svc.set_fast_reads(False)
+                dev_futs = [svc.kget(e, kk) for kk in keys]
+                while any(svc.queues):
+                    svc.flush()
+                svc.set_fast_reads(True)
+                for kk, ff, df in zip(keys, fast_futs, dev_futs):
+                    assert ff.value == df.value, (
+                        "fast/device read divergence at "
+                        f"({e}, {kk}): {ff.value!r} vs {df.value!r}")
+                    equiv += 1
+        flushes = svc.stats()["flushes"]
+        svc.stop()
+        lat_ms = np.asarray(lat) * 1e3
+        return {
+            "ops_per_sec": ops / elapsed,
+            "read_ops_per_sec": reads / elapsed,
+            "read_only_ops_per_sec": ro_reads / ro_elapsed,
+            "read_only_p50_ms": float(
+                np.percentile(np.asarray(ro_lat) * 1e3, 50)),
+            "p50_ms": float(np.percentile(lat_ms, 50)),
+            "p99_ms": float(np.percentile(lat_ms, 99)),
+            "hits": hits, "misses": misses,
+            "hit_rate": hits / max(hits + misses, 1),
+            "miss_reasons": miss_reasons,
+            "flushes": flushes,
+            "equivalence_checked": equiv,
+        }
+
+    a = arm(True)
+    out = {
+        "read_service_ops_per_sec": a["ops_per_sec"],
+        "read_only_ops_per_sec": a["read_only_ops_per_sec"],
+        "read_only_p50_ms": round(a["read_only_p50_ms"], 3),
+        "read_p50_ms": round(a["p50_ms"], 3),
+        "read_p99_ms": round(a["p99_ms"], 3),
+        "read_fastpath_hits": a["hits"],
+        "read_fastpath_misses": a["misses"],
+        "read_hit_rate": round(a["hit_rate"], 4),
+        "read_miss_reasons": a["miss_reasons"],
+        "read_flushes": a["flushes"],
+        "read_equivalence_checked": a["equivalence_checked"],
+        "read_equivalence_ok": True,  # the sweep asserts on mismatch
+    }
+    if baseline:
+        b = arm(False)
+        # the 90/10 loop's A/B: write-coupled (every round keeps its
+        # write flush) — the reclaimed-grid mixed-throughput win
+        out["read_baseline_ops_per_sec"] = b["ops_per_sec"]
+        out["read_mixed_speedup"] = round(
+            a["ops_per_sec"] / b["ops_per_sec"], 2)
+        # the read-only A/B: the decoupling headline — mirror-served
+        # reads vs a device round per batch
+        out["read_baseline_only_ops_per_sec"] = \
+            b["read_only_ops_per_sec"]
+        out["read_baseline_flushes"] = b["flushes"]
+        out["read_fastpath_speedup"] = round(
+            a["read_only_ops_per_sec"] / b["read_only_ops_per_sec"],
+            2)
     return out
 
 
@@ -1278,6 +1518,11 @@ def main() -> None:
         "mixed_p99_ms": (round(svc["mixed_p99_ms"], 3)
                          if svc.get("mixed_p99_ms") else None),
         "mixed_commit_fraction": svc.get("mixed_commit_fraction"),
+        # mixed-rung tail attribution: which latency mark dominated
+        # each >5x-p50 batch (the formerly unexplained mixed_p99)
+        "mixed_tail_batches": svc.get("mixed_tail_batches"),
+        "mixed_tail_causes": svc.get("mixed_tail_causes"),
+        "mixed_tail_top_cause": svc.get("mixed_tail_top_cause"),
         "rmw_device_ops_per_sec": (
             round(svc["rmw_device_ops_per_sec"], 1)
             if svc.get("rmw_device_ops_per_sec") else None),
@@ -1303,6 +1548,24 @@ def main() -> None:
         "payload_bytes_full_width_per_flush": svc.get(
             "payload_bytes_full_width_per_flush"),
         "grid_occupancy": svc.get("grid_occupancy"),
+        # lease-protected read fast path: the read-heavy rung with
+        # its fastpath-off A/B arm
+        "read_service_ops_per_sec": (
+            round(svc["read_service_ops_per_sec"], 1)
+            if svc.get("read_service_ops_per_sec") else None),
+        "read_only_ops_per_sec": (
+            round(svc["read_only_ops_per_sec"], 1)
+            if svc.get("read_only_ops_per_sec") else None),
+        "read_baseline_only_ops_per_sec": (
+            round(svc["read_baseline_only_ops_per_sec"], 1)
+            if svc.get("read_baseline_only_ops_per_sec") else None),
+        "read_fastpath_speedup": svc.get("read_fastpath_speedup"),
+        "read_hit_rate": svc.get("read_hit_rate"),
+        "read_fastpath_hits": svc.get("read_fastpath_hits"),
+        "read_fastpath_misses": svc.get("read_fastpath_misses"),
+        "read_miss_reasons": svc.get("read_miss_reasons"),
+        "read_p50_ms": svc.get("read_p50_ms"),
+        "read_p99_ms": svc.get("read_p99_ms"),
         "repgroup_ops_per_sec": svc.get("repgroup_ops_per_sec"),
         "repgroup_p50_ms": svc.get("repgroup_p50_ms"),
         "repgroup_p99_ms": svc.get("repgroup_p99_ms"),

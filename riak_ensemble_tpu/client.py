@@ -51,6 +51,13 @@ class Client:
     # -- API (client.erl:34-116) ---------------------------------------
 
     def kget(self, ensemble, key, timeout: float = 10.0, opts=()):
+        """Linearizable read.  When ``Config.trust_lease`` holds, the
+        ensemble leader answers from its local state inside an
+        unexpired lease without a fresh quorum round (peer.erl's
+        leased read; the batched scale plane's analog is the
+        lease-protected fast path in
+        :mod:`riak_ensemble_tpu.parallel.batched_host`, surfaced over
+        the wire by :mod:`riak_ensemble_tpu.svcnode`)."""
         return self._maybe(lambda: self._sync(
             ensemble, ("get", key, tuple(opts)), timeout))
 

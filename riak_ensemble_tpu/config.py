@@ -29,8 +29,19 @@ class Config:
     lease_duration: Optional[float] = None
 
     # Whether leaders may serve reads locally inside an unexpired lease
-    # (config.erl:41-42).
+    # (config.erl:41-42).  The batched service's lease-protected read
+    # fast path honors this: False forces every read through a device
+    # round.
     trust_lease: bool = True
+
+    # Safety margin subtracted from the lease before a leader serves a
+    # local read (the clock-skew guard of the lease argument): a fast
+    # read is allowed only while now + margin < lease expiry, and the
+    # inequality lease + margin < follower_timeout must hold — a
+    # follower must outwait any read the leader could still be
+    # serving.  Default tick/2 (well inside the 3x-lease headroom the
+    # default derivation chain leaves).
+    read_lease_margin: Optional[float] = None
 
     # How long a follower waits for leader commits before abandoning it
     # (config.erl:47-48, default 4x lease).
@@ -100,6 +111,11 @@ class Config:
         return self.follower_timeout if self.follower_timeout is not None \
             else self.lease() * 4
 
+    def read_margin(self) -> float:
+        return self.read_lease_margin \
+            if self.read_lease_margin is not None \
+            else self.ensemble_tick * 0.5
+
     def election_timeout(self, rng: random.Random) -> float:
         base = self.election_timeout_base if self.election_timeout_base is not None \
             else self.follower()
@@ -122,6 +138,22 @@ class Config:
         assert self.ensemble_tick < self.lease() < self.follower(), (
             "config invariant violated: need tick < lease < follower_timeout "
             f"got {self.ensemble_tick} / {self.lease()} / {self.follower()}"
+        )
+        # The lease-read safety inequality: a leader may serve a local
+        # read up to (lease - margin) after its last quorum contact,
+        # and a follower elects only after follower_timeout of leader
+        # silence — lease + margin < follower_timeout keeps every
+        # possible leased read strictly inside the followers' patience
+        # even under clock skew up to the margin.  Only binding when
+        # leased reads are possible at all (trust_lease): an opted-out
+        # config never serves around the quorum round and keeps the
+        # pre-existing construction contract.
+        assert not self.trust_lease or (
+            0.0 <= self.read_margin() and
+            self.lease() + self.read_margin() < self.follower()), (
+            "config invariant violated: need lease + read_margin < "
+            f"follower_timeout, got {self.lease()} + {self.read_margin()}"
+            f" vs {self.follower()}"
         )
 
 

@@ -29,6 +29,11 @@ class Lease:
         """Release (lease.erl:69-73)."""
         self._expiry = None
 
-    def check_lease(self) -> bool:
-        """lease.erl:76-88."""
-        return self._expiry is not None and self._clock() < self._expiry
+    def check_lease(self, margin: float = 0.0) -> bool:
+        """lease.erl:76-88.  ``margin`` is the clock-skew guard the
+        lease-protected read fast path subtracts before trusting the
+        lease (Config.read_margin — the scalar analog of the batched
+        service's vectorized ``lease_until`` check): the lease is
+        only trusted while ``clock + margin < expiry``."""
+        return self._expiry is not None \
+            and self._clock() + margin < self._expiry

@@ -311,3 +311,173 @@ class Workload:
                 return True
             if not self.runtime.run_until(read_ok, 60.0, poll=0.5):
                 raise Violation(f"final read of {key!r} never succeeded")
+
+
+class ServiceReadWorkload:
+    """Read-heavy workload + nemesis for the BATCHED SERVICE's
+    lease-protected read fast path (batched_host §9): random
+    concurrent kput/kdelete/kget against a
+    :class:`~riak_ensemble_tpu.parallel.batched_host.BatchedEnsembleService`
+    on the virtual clock, checked against :class:`KeyModel`.
+
+    The nemesis schedule targets exactly the hazards the fast path
+    introduces:
+
+    - **lease expiry mid-workload** — virtual-time jumps past the
+      lease horizon, so reads race renewal and must fall back to the
+      device round rather than serve a lapsed mirror;
+    - **leader step-down / re-election** — the current leader's up
+      flag drops right before the carrying flush (the election folds
+      into the same launch); a later heal re-elects.  Fast reads must
+      refuse leaderless/electing rows, and the post-election epoch
+      bump must invalidate the vsn mirror rather than hand out stale
+      CAS tokens;
+    - **skewed-margin clock** — sub-lease jumps that land the clock
+      INSIDE the safety margin ``[lease - margin, lease)``, the
+      region where a skew-prone implementation would still serve; the
+      margin must refuse there.
+
+    Fast reads resolve synchronously at submit — their linearization
+    point — so their model events apply immediately; queued ops apply
+    in resolution (device-round) order after the drain, preserving
+    the KeyModel's serialization-consistency assumption (a fast read
+    of a key with any pending write is impossible by construction:
+    the per-slot pending-write gate routes it to the round).
+
+    Raises :class:`Violation` on any stale or lost read; the caller
+    asserts coverage via the service's ``read_fastpath_hits`` /
+    ``read_fastpath_misses`` counters.
+    """
+
+    def __init__(self, svc, runtime, n_keys: int = 3,
+                 rounds: int = 40, seed: int = 0,
+                 read_frac: float = 0.7) -> None:
+        import random
+
+        self.svc = svc
+        self.runtime = runtime
+        self.rng = random.Random(seed)
+        self.rounds = rounds
+        self.read_frac = read_frac
+        self.keys = [f"k{i}" for i in range(n_keys)]
+        self.models: Dict[Any, KeyModel] = {
+            (e, k): KeyModel(f"{e}/{k}")
+            for e in range(svc.n_ens) for k in self.keys}
+        self.down: Dict[int, int] = {}
+        self._vals = itertools.count(1)
+
+    # -- nemesis arms --------------------------------------------------------
+
+    def _nemesis(self) -> None:
+        svc, rng = self.svc, self.rng
+        r = rng.random()
+        cfg = svc.config
+        if r < 0.2 and self.down:
+            # heal a downed leader: the next flush re-elects (the
+            # step-down → re-election cycle completes)
+            e = rng.choice(list(self.down))
+            svc.set_peer_up(e, self.down.pop(e), True)
+        elif r < 0.45:
+            # step-down: kill the CURRENT leader right before the
+            # flush that carries this round's ops
+            e = rng.randrange(svc.n_ens)
+            if e not in self.down and svc.leader_np[e] >= 0:
+                p = int(svc.leader_np[e])
+                svc.set_peer_up(e, p, False)
+                self.down[e] = p
+        elif r < 0.65:
+            # lease expiry mid-workload: jump the clock past every
+            # lease so the next reads race renewal
+            self.runtime.run_for(cfg.lease() * 2.5)
+        elif r < 0.85:
+            # skewed-margin clock: land INSIDE [lease - margin,
+            # lease) of the freshest grant — a correct margin check
+            # refuses to serve there even though the lease itself has
+            # not lapsed
+            horizon = float(svc.lease_until.max()) - self.runtime.now
+            if horizon > 0:
+                skew = cfg.read_margin() * rng.uniform(0.0, 1.0)
+                self.runtime.run_for(max(0.0, horizon - skew))
+
+    # -- one round -----------------------------------------------------------
+
+    def _submit(self):
+        svc, rng = self.svc, self.rng
+        pending = []
+        for _ in range(rng.randrange(3, 9)):
+            e = rng.randrange(svc.n_ens)
+            key = rng.choice(self.keys)
+            m = self.models[(e, key)]
+            r = rng.random()
+            if r < self.read_frac:
+                fut = svc.kget(e, key)
+                if fut.done:
+                    # fast path (or immediate NOTFOUND): linearizes
+                    # NOW — apply the model event at the serve point
+                    if isinstance(fut.value, tuple) \
+                            and fut.value[0] == "ok":
+                        m.ack_read(fut.value[1])
+                else:
+                    pending.append(("get", m, None, fut))
+            elif r < self.read_frac + 0.25 * (1 - self.read_frac):
+                op_id = m.invoke_write(NOTFOUND)
+                fut = svc.kdelete(e, key)
+                if fut.done:  # no slot: immediate ack of NOTFOUND
+                    m.ack_write(op_id)
+                else:
+                    pending.append(("del", m, op_id, fut))
+            else:
+                val = f"v{next(self._vals)}".encode()
+                op_id = m.invoke_write(val)
+                fut = svc.kput(e, key, val)
+                if fut.done and fut.value == "failed":
+                    m.fail_write(op_id)
+                else:
+                    pending.append(("put", m, op_id, fut))
+        return pending
+
+    def _drain(self, pending, max_flushes: int = 30) -> None:
+        for _ in range(max_flushes):
+            if all(f.done for *_x, f in pending):
+                return
+            self.svc.flush()
+            self.runtime.run_for(0.001)
+        raise Violation("service ops never resolved")
+
+    @staticmethod
+    def _apply(pending) -> None:
+        for kind, m, op_id, fut in pending:
+            r = fut.value
+            ok = isinstance(r, tuple) and r[0] == "ok"
+            if kind == "get":
+                if ok:
+                    m.ack_read(r[1])
+            elif ok:
+                m.ack_write(op_id)
+            else:
+                # the engine gates every replica write on the round's
+                # quorum commit: 'failed' is a definitive no-op
+                m.fail_write(op_id)
+
+    def run(self) -> None:
+        for _ in range(self.rounds):
+            self._nemesis()
+            pending = self._submit()
+            self._drain(pending)
+            self._apply(pending)
+        # quiesce: heal, fold elections in, read every key back
+        for e, p in list(self.down.items()):
+            self.svc.set_peer_up(e, p, True)
+        self.down.clear()
+        self.svc.flush()
+        pending = []
+        for (e, key), m in self.models.items():
+            fut = self.svc.kget(e, key)
+            if fut.done:
+                if isinstance(fut.value, tuple) \
+                        and fut.value[0] == "ok":
+                    m.ack_read(fut.value[1])
+            else:
+                pending.append(("get", m, None, fut))
+        self._drain(pending)
+        self._apply(pending)

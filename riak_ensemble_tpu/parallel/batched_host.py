@@ -55,6 +55,22 @@ int32 values (``_inline_slots``).  Arbitrary mod-funs keep the host
 path, with the CAS half chained into the flush that resolved its read
 and jittered backoff between conflicted retries.  See
 docs/ARCHITECTURE.md §3 "Device-side RMW and the mod-fun table".
+
+Reads have a LEASE-PROTECTED FAST PATH (``RETPU_FAST_READS=0`` or
+``Config.trust_lease=False`` opt out): a ``kget``/``kget_vsn``/
+``kget_many`` of a keyed slot is answered directly from the leader's
+host-resident committed mirror — no ``OP_GET`` row, no flush —
+whenever the ensemble's lease is valid on the monotonic clock with a
+safety margin (``Config.read_margin``, with lease + margin strictly
+inside the follower timeout), the slot has no queued or in-flight
+write (the per-slot ``_pending_writes`` index; otherwise the read
+falls back to the device round), the row has a live leader and is not
+corruption-flagged (flagged rows always take the device round so the
+synctree integrity gate still vets the read).  The resolve half
+updates every mirror BEFORE completing write futures, so a read
+issued after a write's ack always observes it — across pipeline
+depth, RMW inline slots and tenant install.  See
+docs/ARCHITECTURE.md §9 "Lease-protected reads".
 """
 
 from __future__ import annotations
@@ -593,6 +609,49 @@ class BatchedEnsembleService:
         self._recycle_dirty: set = set()
         #: leader leases, host-side: ensemble -> expiry (runtime.now)
         self.lease_until = np.zeros((n_ens,), dtype=float)
+        #: lease-protected read fast path (RETPU_FAST_READS=0 or
+        #: config.trust_lease=False opt out): reads of keyed slots
+        #: serve from the host committed mirror while the lease holds
+        self._fast_reads = (os.environ.get("RETPU_FAST_READS", "1")
+                            != "0") and self.config.trust_lease
+        self._read_margin = self.config.read_margin()
+        # the lease-read safety inequality (see Config.validate):
+        # every read the leader may still serve expires strictly
+        # before any follower's election patience runs out.  Only
+        # enforced while the fast path is ON — a config that opted
+        # out (trust_lease=False / RETPU_FAST_READS=0) never serves
+        # around the round and must keep constructing as before.
+        if self._fast_reads:
+            self._assert_read_margin()
+        #: committed (epoch, seq) per slot — the version a fast
+        #: kget_vsn serves.  Invalidated per-row on won elections
+        #: (the epoch bump re-versions objects lazily on next device
+        #: access); repopulated by every committed write's resolve and
+        #: refreshed by device reads.
+        self._slot_vsn: List[Dict[int, Tuple[int, int]]] = [
+            dict() for _ in range(n_ens)]
+        #: committed device-native int32 per inline (RMW) slot — the
+        #: value a fast read of a device-native key serves (the engine
+        #: arrays hold it; slot_handle only carries the -1 sentinel).
+        #: Absent entries (fresh restore) miss to the device round,
+        #: which refreshes the mirror.
+        self._inline_value: List[Dict[int, int]] = [
+            dict() for _ in range(n_ens)]
+        #: per-slot count of QUEUED + IN-FLIGHT writes (put/CAS/RMW/
+        #: tombstone): a fast read of a slot with any pending write
+        #: falls back to the device round — the round orders it after
+        #: the writes, and the mirror-before-ack discipline alone only
+        #: covers writes whose resolve already ran.
+        self._pending_writes: List[Dict[int, int]] = [
+            dict() for _ in range(n_ens)]
+        #: rows whose last resolve flagged synctree corruption: fast
+        #: reads bypass to the device round (its integrity gate vets
+        #: the read) until the exchange/scrub reports the row synced
+        self._corrupt_rows = np.zeros((n_ens,), dtype=bool)
+        #: read fast-path observability
+        self.read_fastpath_hits = 0
+        self.read_fastpath_misses = 0
+        self.read_fastpath_miss_reasons: Dict[str, int] = {}
         self.flushes = 0
         self.ops_served = 0
         #: integrity-gate detections (replica flagged corrupt in a round)
@@ -856,6 +915,10 @@ class BatchedEnsembleService:
         self._inline_slots[row] = set()
         self._queued_handle_writes[row] = {}
         self._recycle_pending[row] = []
+        self._slot_vsn[row] = {}
+        self._inline_value[row] = {}
+        self._pending_writes[row] = {}
+        self._corrupt_rows[row] = False
         # a recycled row starts with no watchers (the reference cleans
         # up watchers with their watched peer)
         self._leader_watchers.pop(row, None)
@@ -1089,11 +1152,24 @@ class BatchedEnsembleService:
         slot_l: List[int] = []
         pos_l: List[int] = []
         miss_pos: List[int] = []
+        fast_pos: List[int] = []
+        fast_res: List[Any] = []
         ks = self.key_slot[ens]
+        # ensemble-level fast-path gate checked ONCE for the batch;
+        # per-key conditions (pending write, mirror coverage) below
+        ens_reason = self._fast_read_ok(ens, self.runtime.now)
         for i, key in enumerate(keys):
             s = ks.get(key)
             if s is None:
                 miss_pos.append(i)
+                continue
+            if ens_reason is None:
+                reason, res = self._fast_read_result(ens, s, want_vsn)
+            else:
+                reason, res = ens_reason, None
+            if self._count_fast(reason):
+                fast_pos.append(i)
+                fast_res.append(res)
             else:
                 slot_l.append(s)
                 pos_l.append(i)
@@ -1102,6 +1178,8 @@ class BatchedEnsembleService:
                   else ("ok", NOTFOUND))
             accum.fill(fut, miss_pos, [nf] * len(miss_pos),
                        self._safe_resolve)
+        if fast_pos:
+            accum.fill(fut, fast_pos, fast_res, self._safe_resolve)
         if slot_l:
             m = len(slot_l)
             self._push(ens, _PendingBatch(
@@ -1111,7 +1189,10 @@ class BatchedEnsembleService:
 
     def kget(self, ens: int, key: Any) -> Future:
         """Linearizable read; resolves ('ok', value|NOTFOUND) or
-        'failed'."""
+        'failed'.  Served from the leader's committed host mirror —
+        no device round — while the lease-protected fast path's
+        conditions hold (see the module docstring); otherwise the read
+        rides an ``OP_GET`` round like always."""
         fut = Future()
         if self._dead(ens):
             fut.resolve("failed")
@@ -1119,6 +1200,10 @@ class BatchedEnsembleService:
         slot = self._slot_for(ens, key, allocate=False)
         if slot is None:
             fut.resolve(("ok", NOTFOUND))
+            return fut
+        hit, res = self._try_fast(ens, slot, False)
+        if hit:
+            self._safe_resolve(fut, res)
             return fut
         self._push(ens, _PendingOp(eng.OP_GET, slot, 0, fut))
         return fut
@@ -1136,6 +1221,10 @@ class BatchedEnsembleService:
         slot = self._slot_for(ens, key, allocate=False)
         if slot is None:
             fut.resolve(("ok", NOTFOUND, (0, 0)))
+            return fut
+        hit, res = self._try_fast(ens, slot, True)
+        if hit:
+            self._safe_resolve(fut, res)
             return fut
         self._push(ens, _PendingOp(eng.OP_GET, slot, 0, fut,
                                    want_vsn=True))
@@ -1333,8 +1422,13 @@ class BatchedEnsembleService:
         mask = np.zeros((self.n_ens, self.n_peers), bool)
         mask[ens] = True
         self.state = self.engine.rebuild_trees(st, jnp.asarray(mask))
-        for key, slot, handle, _ve, _vs, payload in applied:
+        for key, slot, handle, ve, vs, payload in applied:
             self._inline_slots[ens].discard(slot)
+            self._inline_value[ens].pop(slot, None)
+            # installs carry their committed versions: the fast
+            # path's vsn mirror adopts them (CAS-token continuity
+            # extends to leased reads)
+            self._slot_vsn[ens][slot] = (ve, vs)
             old = self.slot_handle[ens].pop(slot, 0)
             if old and old != handle:
                 # values-only drop, NEVER the handle pool: the handle
@@ -1624,6 +1718,118 @@ class BatchedEnsembleService:
                             fut, [pos], [r2], self._safe_resolve))
             inner.add_waiter(on_batch)
         return fut
+
+    # -- lease-protected read fast path -------------------------------------
+
+    def set_fast_reads(self, enabled: bool) -> None:
+        """Runtime opt-in/out for the lease-protected read fast path
+        (the programmatic form of ``RETPU_FAST_READS``); disabling
+        routes every read through the device round again."""
+        enabled = bool(enabled) and self.config.trust_lease
+        if enabled:
+            # the safety inequality is a precondition of SERVING, so
+            # it is (re-)checked at every enable, not just the one in
+            # __init__ — a config whose margin doesn't fit the
+            # lease/follower gap may run, but never fast-serve
+            self._assert_read_margin()
+        self._fast_reads = enabled
+
+    def _assert_read_margin(self) -> None:
+        assert (0.0 <= self._read_margin
+                and self.config.lease() + self._read_margin
+                < self.config.follower()), \
+            "need 0 <= read_margin and lease + read_margin " \
+            "< follower_timeout to enable lease-protected reads"
+
+    def _fast_read_ok(self, ens: int, now: float) -> Optional[str]:
+        """None when ensemble ``ens`` may serve lease-protected reads
+        right now; otherwise the miss reason.  Subclasses layer their
+        own gates (a replication-group leader adds the host-quorum
+        lease and the leader-only rule)."""
+        if not self._fast_reads:
+            return "disabled"
+        lead = self.leader_np[ens]
+        if lead < 0 or not self.up[ens, lead]:
+            # leaderless / leader-down rows are electing (the next
+            # flush folds the election in) — never serve around that
+            return "no_leader"
+        if self._corrupt_rows[ens]:
+            # flagged rows take the device round so the synctree
+            # integrity gate still vets the read (reference read-path
+            # validation); cleared once the exchange syncs the row
+            return "corrupt"
+        if self.lease_until[ens] <= now + self._read_margin:
+            return "no_lease"
+        return None
+
+    def _try_fast(self, ens: int, slot: int, want_vsn: bool
+                  ) -> Tuple[bool, Any]:
+        """The whole fast-path gate for one scalar read: (hit,
+        result).  Accounts the attempt either way; ``result`` is only
+        valid on a hit.  (``kget_many`` inlines the same sequence so
+        it can check the ensemble-level gate once per batch.)"""
+        reason = self._fast_read_ok(ens, self.runtime.now)
+        if reason is None:
+            reason, res = self._fast_read_result(ens, slot, want_vsn)
+        else:
+            res = None
+        return self._count_fast(reason), res
+
+    def _fast_read_result(self, ens: int, slot: int, want_vsn: bool
+                          ) -> Tuple[Optional[str], Any]:
+        """(miss_reason, result) for one slot read off the committed
+        host mirror; ``result`` is only valid when the reason is None.
+        The caller has already passed :meth:`_fast_read_ok`."""
+        if self._pending_writes[ens].get(slot, 0):
+            return "pending_write", None
+        vsn: Any = None
+        if want_vsn:
+            vsn = self._slot_vsn[ens].get(slot)
+            if vsn is None:
+                # unmirrored version (fresh restore / post-election
+                # invalidation): the device round re-versions and its
+                # resolve refreshes the mirror
+                return "vsn_unmirrored", None
+        h = self.slot_handle[ens].get(slot, 0)
+        if h == -1:
+            v = self._inline_value[ens].get(slot)
+            if v is None:
+                return "inline_unmirrored", None
+            out: Any = v
+        elif h:
+            out = self.values.get(h, NOTFOUND)
+        else:
+            # nothing committed (tombstone or never-written slot): the
+            # device reads it notfound too; a tombstone's real vsn
+            # rides along so CAS chains still work
+            out = NOTFOUND
+        return None, (("ok", out, vsn) if want_vsn else ("ok", out))
+
+    def _count_fast(self, reason: Optional[str]) -> bool:
+        """Account one fast-path attempt; True = hit (serve now)."""
+        if reason is None:
+            self.read_fastpath_hits += 1
+            # a mirror-served read is a served op: keep the
+            # throughput counter honest when 90% of traffic never
+            # reaches a resolve path
+            self.ops_served += 1
+            return True
+        self.read_fastpath_misses += 1
+        r = self.read_fastpath_miss_reasons
+        r[reason] = r.get(reason, 0) + 1
+        return False
+
+    def _note_write(self, ens: int, slot: int) -> None:
+        d = self._pending_writes[ens]
+        d[slot] = d.get(slot, 0) + 1
+
+    def _unnote_write(self, ens: int, slot: int) -> None:
+        d = self._pending_writes[ens]
+        n = d.get(slot, 0) - 1
+        if n <= 0:
+            d.pop(slot, None)
+        else:
+            d[slot] = n
 
     def _rmw_eligible(self, ens: int, slot: int) -> bool:
         """A slot the device fast path may RMW: no QUEUED host-payload
@@ -2216,16 +2422,21 @@ class BatchedEnsembleService:
                     # tenant.  Keyless records are bulk-array writes.
                     if key_obj is not None and handle:
                         self._inline_slots[ens].add(slot)
+                        self._inline_value[ens][slot] = handle
+                        self._slot_vsn[ens][slot] = (oe, os_)
                         self.slot_handle[ens][slot] = -1
                         self.key_slot[ens][key_obj] = slot
                         owners.setdefault(ens, {})[slot] = key_obj
                     else:
                         if key_obj is not None:
                             self._inline_slots[ens].discard(slot)
+                            self._inline_value[ens].pop(slot, None)
                             self.slot_handle[ens].pop(slot, None)
                         owners.setdefault(ens, {})[slot] = None
                     continue
                 self._inline_slots[ens].discard(slot)
+                self._inline_value[ens].pop(slot, None)
+                self._slot_vsn[ens][slot] = (oe, os_)
                 if handle:
                     self.values[handle] = payload
                     self._next_handle = max(self._next_handle,
@@ -2345,7 +2556,17 @@ class BatchedEnsembleService:
 
     def _push(self, ens: int, op) -> None:
         """Enqueue one pending entry (timestamped for the queue-wait
-        latency component) and arm the burst trigger."""
+        latency component) and arm the burst trigger.  Write entries
+        register in the per-slot pending-write index here — the ONE
+        choke point every keyed write passes — and deregister when
+        their entry resolves or fails; a slot with a nonzero count
+        never serves a lease-protected fast read."""
+        if op.kind != eng.OP_GET:
+            if isinstance(op, _PendingBatch):
+                for s in op.slot:
+                    self._note_write(ens, s)
+            else:
+                self._note_write(ens, op.slot)
         op.t_enq = time.perf_counter()
         self.queues[ens].append(op)
         self._queue_rounds[ens] += op.n
@@ -2813,10 +3034,19 @@ class BatchedEnsembleService:
                 tx = time.perf_counter()
                 self.corruptions += int(corrupt.sum())
                 run = corrupt.any(1)
+                # flagged rows fall off the read fast path until the
+                # exchange syncs them — a known-corrupt row's reads
+                # must keep taking the device round (its integrity
+                # gate vets every access)
+                self._corrupt_rows |= run
                 self.state, diverged, synced = self.engine.exchange_step(
                     self.state, jnp.asarray(run), self._up_device())
+                synced_np = np.asarray(synced)
                 self.repairs += int(
-                    np.asarray(diverged)[np.asarray(synced)].sum())
+                    np.asarray(diverged)[synced_np].sum())
+                # rows the exchange synced re-admit fast reads; any
+                # residual damage re-flags on its next device access
+                self._corrupt_rows &= ~(run & synced_np)
                 self._emit("svc_exchange", {"ensembles": int(run.sum())})
                 rec["exchange"] = time.perf_counter() - tx
             self.flushes += 1
@@ -2826,6 +3056,17 @@ class BatchedEnsembleService:
             self._rollback_launch(fl.state_snapshot, fl.leader_snapshot,
                                   fl.lease_snapshot, fl.donated)
             raise
+        # A won election bumped the row's ballot epoch: the next
+        # device access of each object re-versions it (update_key,
+        # peer.erl:1564-1596), so the fast path's vsn mirror is stale
+        # for the whole row — drop it (want_vsn reads take the device
+        # round, whose resolve re-mirrors the rewritten versions;
+        # plain value reads stay fast, the rewrite never changes
+        # values).  Only on a SUCCESSFUL launch: the except path
+        # rolled the election back.
+        if won_np.any():
+            for e2 in np.nonzero(won_np)[0].tolist():
+                self._slot_vsn[e2].clear()
         # Leader changes (won elections) notify watchers only on a
         # SUCCESSFUL launch — the except path above rolled the mirror
         # back, and a watcher told of a rolled-back leader would act
@@ -2926,6 +3167,11 @@ class BatchedEnsembleService:
         healed = found - int(still.sum())
         self.repairs += int(
             np.asarray(diverged)[np.asarray(synced)].sum())
+        # the sweep's verdict updates the read fast path's corrupt
+        # flags: swept rows with residual damage stay off the fast
+        # path, healed ones re-admit it
+        self._corrupt_rows = np.where(run, still.any(1),
+                                      self._corrupt_rows)
         self._emit("svc_scrub", {"damaged": found, "healed": healed})
         return {"replicas_damaged": found, "replicas_healed": healed,
                 "ensembles_swept": int(run.sum())}
@@ -2990,6 +3236,14 @@ class BatchedEnsembleService:
             "launches_in_flight": len(self._inflight_launches),
             "rmw_conflicts": self.rmw_conflicts,
             "rmw_device_fastpath": self.rmw_device_fastpath,
+            # lease-protected read fast path: mirror-served reads vs
+            # device-round fallbacks (by reason), and what fraction of
+            # live ensembles hold a margin-valid lease right now
+            "read_fastpath_hits": self.read_fastpath_hits,
+            "read_fastpath_misses": self.read_fastpath_misses,
+            "read_fastpath_miss_reasons": dict(
+                self.read_fastpath_miss_reasons),
+            "lease_valid_fraction": self._lease_valid_fraction(),
             # active-column compaction: packed d2h bytes actually
             # moved vs the full-width [K, E] layout, and the mean
             # packed-grid occupancy (a_width / E; 1.0 = uncompacted)
@@ -3006,6 +3260,16 @@ class BatchedEnsembleService:
                 "total_ms": round(self.wal_compaction_ms_total, 3),
             },
         }
+
+    def _lease_valid_fraction(self) -> float:
+        """Fraction of live ensembles whose lease is margin-valid on
+        the monotonic clock right now — the fast path's best-case
+        coverage (stats observability for the read router)."""
+        live = self._live
+        if not live.any():
+            return 0.0
+        horizon = self.runtime.now + self._read_margin
+        return float((self.lease_until[live] > horizon).mean())
 
     # -- (K, A)-grid pre-compile --------------------------------------------
 
@@ -3029,9 +3293,15 @@ class BatchedEnsembleService:
         group).
 
         Flush depths are pow2-bucketed and the packed-result program
-        is additionally keyed by the active-column bucket, so the
-        grid is (K, A): K in {0, 1, 2, ..., max_k} × A in the pow2
-        ladder below E plus full width.  Without this, the first
+        is additionally keyed by the active-column bucket AND the
+        static want_vsn flag, so the grid is (K, A) × {vsn, no-vsn}:
+        K in {0, 1, 2, ..., max_k} × A in the pow2 ladder below E
+        plus full width.  The small-K buckets double as the get-only
+        / read-miss flush shapes the read fast path's fallback
+        produces, and the version-less packs are what execute /
+        execute_async dispatch — all pre-compiled here so none of
+        them pays a first-use compile inside a client's latency
+        window.  Without this, the first
         flush at each new (K, A) bucket pays its compile in the
         middle of serving — the dispatch p99 blip the steady-state
         breakdown can't show.  The pack programs warm on the step's
@@ -3108,9 +3378,19 @@ class BatchedEnsembleService:
             return True
 
         def warm_pack(won, res, k_eff: int, wide_gw=None) -> None:
+            # The flush path (the read fast path's get-only/read-miss
+            # fallback batches included) always packs WITH versions —
+            # the (K, A) ladder covers those.  The version-less pack
+            # is what WAL-less execute/execute_async dispatch, and
+            # those skip compaction for device-resident planes — so
+            # warming it at FULL WIDTH per K bucket covers the real
+            # dispatch without doubling the whole warm grid (its
+            # first-use compile was still leaking into the dispatch
+            # p99 latency window).
             for aw in a_widths(k_eff):
                 if aw is None:
                     np.asarray(pack(won, res, True))
+                    np.asarray(pack(won, res, False))
                 elif not warm_bucket(k_eff, aw, wide_gw):
                     np.asarray(pack(
                         won, res, True,
@@ -3735,6 +4015,7 @@ class BatchedEnsembleService:
             return
         if op.kind in (eng.OP_PUT, eng.OP_CAS, eng.OP_RMW):
             for i in range(op.n):
+                self._unnote_write(e, op.slot[i])
                 if op.kind != eng.OP_RMW:
                     # an RMW entry's handle field is its int32
                     # operand, not a payload handle
@@ -3758,6 +4039,7 @@ class BatchedEnsembleService:
             if op.handle:
                 self._unnote_handle_write(e, op.slot)
         if op.kind in (eng.OP_PUT, eng.OP_CAS, eng.OP_RMW):
+            self._unnote_write(e, op.slot)
             # A failed write that was the slot's last queued write may
             # leave it holding nothing committed (fresh slot, or a
             # tombstone whose delete-side recycle was skipped because
@@ -3792,9 +4074,13 @@ class BatchedEnsembleService:
             self._recycle_dirty.add(e)
             release = self._release_handle
             inline = self._inline_slots[e]
+            inline_val = self._inline_value[e]
+            slot_vsn = self._slot_vsn[e]
+            unnote_w = self._unnote_write
             for comm, s, h, g, key, vs in zip(comm_l, slot_l,
                                               handle_l, gen_l, keys,
                                               vs_l):
+                unnote_w(e, s)
                 if h:
                     self._unnote_handle_write(e, s)
                 if not comm:
@@ -3809,6 +4095,8 @@ class BatchedEnsembleService:
                 if h:
                     slot_handle[s] = h
                 inline.discard(s)
+                inline_val.pop(s, None)
+                slot_vsn[s] = tuple(vs)  # mirror before the ack
                 append(("ok", tuple(vs)) if ack else "failed")
         elif op.kind == eng.OP_RMW:
             comm_l = committed[j:j + n, e].tolist()
@@ -3816,12 +4104,16 @@ class BatchedEnsembleService:
             val_l = value[j:j + n, e].tolist()
             slot_handle = self.slot_handle[e]
             inline = self._inline_slots[e]
+            inline_val = self._inline_value[e]
+            slot_vsn = self._slot_vsn[e]
             release = self._release_handle
             recycle = self._recycle_pending[e].append
             self._recycle_dirty.add(e)
+            unnote_w = self._unnote_write
             keys = op.keys if op.keys is not None else [None] * n
             for comm, s, g, key, vs, v in zip(comm_l, op.slot, op.gen,
                                               keys, vs_l, val_l):
+                unnote_w(e, s)
                 if not comm:
                     if key is not None:
                         recycle((key, s, g))
@@ -3832,27 +4124,38 @@ class BatchedEnsembleService:
                     release(old)
                 if v:  # live value; a computed 0 is the tombstone
                     slot_handle[s] = -1
-                elif key is not None:  # tombstone: recycle the slot
-                    recycle((key, s, g))
+                    inline_val[s] = v  # mirror before the ack
+                else:
+                    inline_val.pop(s, None)
+                    if key is not None:  # tombstone: recycle the slot
+                        recycle((key, s, g))
                 inline.add(s)
+                slot_vsn[s] = tuple(vs)
                 append(("ok", tuple(vs)) if ack else "failed")
         else:  # OP_GET batch
             ok_l = get_ok[j:j + n, e].tolist()
             found_l = found[j:j + n, e].tolist()
             val_l = value[j:j + n, e].tolist()
-            vs_l = (vsn[j:j + n, e].tolist() if op.want_vsn
+            vs_l = (vsn[j:j + n, e].tolist() if vsn is not None
                     else [None] * n)
             values = self.values
             inline = self._inline_slots[e]
+            inline_val = self._inline_value[e]
+            slot_vsn = self._slot_vsn[e]
             want_vsn = op.want_vsn
             for ok, fnd, v, vs, s in zip(ok_l, found_l, val_l, vs_l,
                                          op.slot):
                 if ok and ack_reads:
                     if fnd and v != 0:
-                        out = (v if s in inline
-                               else values.get(v, NOTFOUND))
+                        if s in inline:
+                            out = v
+                            inline_val[s] = v  # refresh fast mirror
+                        else:
+                            out = values.get(v, NOTFOUND)
                     else:
                         out = NOTFOUND
+                    if vs is not None:
+                        slot_vsn[s] = tuple(vs)  # refresh fast mirror
                     append(("ok", out, tuple(vs)) if want_vsn
                            else ("ok", out))
                 else:
@@ -3901,6 +4204,7 @@ class BatchedEnsembleService:
                 served += 1
                 if op.kind in puts:
                     if committed_l[j][e]:
+                        self._unnote_write(e, op.slot)
                         if op.handle:
                             self._unnote_handle_write(e, op.slot)
                         # Release the payload this write superseded
@@ -3914,6 +4218,10 @@ class BatchedEnsembleService:
                         # a committed put/CAS flips a device-native
                         # slot back to handle storage
                         self._inline_slots[e].discard(op.slot)
+                        self._inline_value[e].pop(op.slot, None)
+                        # mirror-before-ack: a fast read issued after
+                        # this future resolves must see the write
+                        self._slot_vsn[e][op.slot] = tuple(vsn_l[j][e])
                         self._safe_resolve(
                             op.fut, ("ok", tuple(vsn_l[j][e]))
                             if ack else "failed")
@@ -3921,6 +4229,7 @@ class BatchedEnsembleService:
                         self._fail_op(e, op)
                 elif op.kind == eng.OP_RMW:
                     if committed_l[j][e]:
+                        self._unnote_write(e, op.slot)
                         old = slot_handle.pop(op.slot, 0)
                         if old > 0:  # superseded host payload
                             self._release_handle(old)
@@ -3933,10 +4242,15 @@ class BatchedEnsembleService:
                         # arm recycles; the device arm must match).
                         if value_l[j][e]:
                             slot_handle[op.slot] = -1
-                        elif op.key is not None:
-                            self._queue_recycle(
-                                e, (op.key, op.slot, op.gen))
+                            self._inline_value[e][op.slot] = \
+                                value_l[j][e]
+                        else:
+                            self._inline_value[e].pop(op.slot, None)
+                            if op.key is not None:
+                                self._queue_recycle(
+                                    e, (op.key, op.slot, op.gen))
                         self._inline_slots[e].add(op.slot)
+                        self._slot_vsn[e][op.slot] = tuple(vsn_l[j][e])
                         self._safe_resolve(
                             op.fut, ("ok", tuple(vsn_l[j][e]))
                             if ack else "failed")
@@ -3948,14 +4262,22 @@ class BatchedEnsembleService:
                         if found_l[j][e] and v != 0:
                             # device-native slots carry the value
                             # itself, not a payload handle
-                            out = (v if op.slot
-                                   in self._inline_slots[e]
-                                   else self.values.get(v, NOTFOUND))
+                            if op.slot in self._inline_slots[e]:
+                                out = v
+                                # refresh the fast path's inline
+                                # mirror from the device read
+                                self._inline_value[e][op.slot] = v
+                            else:
+                                out = self.values.get(v, NOTFOUND)
                         else:
                             out = NOTFOUND
                         # vsn is the object's — a tombstone's real
                         # version rides along with NOTFOUND, so CAS
-                        # chains (ksafe_delete → kupdate) work.
+                        # chains (ksafe_delete → kupdate) work.  The
+                        # device read also refreshes the fast path's
+                        # vsn mirror (repopulating it after the
+                        # post-election invalidation).
+                        self._slot_vsn[e][op.slot] = tuple(vsn_l[j][e])
                         self._safe_resolve(
                             op.fut, ("ok", out, tuple(vsn_l[j][e]))
                             if op.want_vsn else ("ok", out))

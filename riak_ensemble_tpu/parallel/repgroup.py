@@ -260,13 +260,20 @@ def install_state(svc: BatchedEnsembleService, dump: Tuple) -> None:
 def rebuild_derived(svc: BatchedEnsembleService) -> None:
     """Recompute free-slot lists / slot generations from the keyed
     mirrors (used after install and before a replica checkpoints —
-    replicas don't maintain them incrementally)."""
+    replicas don't maintain them incrementally).  The read fast
+    path's caches reset with them: the snapshot superseded whatever
+    versions/inline values were mirrored (they repopulate lazily —
+    each miss takes the device round, whose resolve re-mirrors).
+    The pending-write index is NOT cleared: it tracks live queue
+    entries, which survive an install and still resolve afterward."""
     for e in range(svc.n_ens):
         used = set(svc.key_slot[e].values())
         svc.free_slots[e] = [s for s in range(svc.n_slots)
                              if s not in used]
         svc.slot_gen[e] = {}
         svc._recycle_pending[e] = []
+        svc._slot_vsn[e] = {}
+        svc._inline_value[e] = {}
 
 
 # -- incremental (Merkle) catch-up -------------------------------------------
@@ -579,11 +586,13 @@ class ReplicaCore:
                 v = int(value[j, e]) if value is not None else 0
                 recs.append((("kv", e, int(slot[j, e])),
                              (key, v, ve, vs, None, True)))
-                self._mirror_inline(e, key, int(slot[j, e]), v)
+                self._mirror_inline(e, key, int(slot[j, e]), v,
+                                    ve, vs)
                 continue
             recs.append((("kv", e, int(slot[j, e])),
                          (key, handle, ve, vs, payload, False)))
-            self._mirror_write(e, key, int(slot[j, e]), handle, payload)
+            self._mirror_write(e, key, int(slot[j, e]), handle,
+                               payload, ve, vs)
         self.applied_ge, self.applied_seq = ge, seq
         self.last_crc = crc
         recs.append((_GRP_KEY, (self.promised, ge, seq, self.cfg)))
@@ -602,11 +611,15 @@ class ReplicaCore:
         return ("applied", ge, seq, crc)
 
     def _mirror_write(self, e: int, key: Any, slot: int, handle: int,
-                      payload: Any) -> None:
+                      payload: Any, ve: int = 0, vs: int = 0) -> None:
         """Keep the keyed host mirrors live on the replica so a
-        promoted leader can serve keyed ops without a WAL rescan."""
+        promoted leader can serve keyed ops — leased fast reads
+        included (the vsn mirror rides along) — without a WAL
+        rescan."""
         svc = self.svc
         svc._inline_slots[e].discard(slot)
+        svc._inline_value[e].pop(slot, None)
+        svc._slot_vsn[e][slot] = (int(ve), int(vs))
         old = svc.slot_handle[e].pop(slot, 0)
         if old > 0 and old != handle:
             svc.values.pop(old, None)
@@ -622,7 +635,7 @@ class ReplicaCore:
                 svc.key_slot[e].pop(key, None)
 
     def _mirror_inline(self, e: int, key: Any, slot: int,
-                       value: int) -> None:
+                       value: int, ve: int = 0, vs: int = 0) -> None:
         """Keyed mirror of a committed device RMW: the slot is
         device-native (value lives in the engine arrays; the -1
         slot_handle sentinel stands in for a live handle).  A
@@ -634,13 +647,16 @@ class ReplicaCore:
         old = svc.slot_handle[e].pop(slot, 0)
         if old > 0:
             svc.values.pop(old, None)
+        svc._slot_vsn[e][slot] = (int(ve), int(vs))
         if value:
             svc._inline_slots[e].add(slot)
+            svc._inline_value[e][slot] = int(value)
             svc.slot_handle[e][slot] = -1
             if key is not None:
                 svc.key_slot[e][key] = slot
         else:
             svc._inline_slots[e].discard(slot)
+            svc._inline_value[e].pop(slot, None)
             if key is not None:
                 svc.key_slot[e].pop(key, None)
 
@@ -823,9 +839,9 @@ class ReplicaCore:
             rows = np.zeros((svc.n_ens, svc.n_peers), bool)
             rows[np.unique([p[0] for p in patches])] = True
             svc.state = eng.rebuild_trees(st, jnp.asarray(rows))
-            for e, s, _ep, _sq, _vl, key, handle, payload in patches:
+            for e, s, ep, sq, vl, key, handle, payload in patches:
                 self._mirror_patch(int(e), int(s), key, int(handle),
-                                   payload)
+                                   payload, int(ep), int(sq), int(vl))
         # control-plane vectors land LAST (ADVICE r5): an exception
         # anywhere above leaves this lane's (ge, seq) markers — and
         # its ballot/view vectors — untouched, so the replica is
@@ -846,12 +862,16 @@ class ReplicaCore:
         return ("installed", ge, seq)
 
     def _mirror_patch(self, e: int, s: int, key: Any, handle: int,
-                      payload: Any) -> None:
+                      payload: Any, ep: int = 0, sq: int = 0,
+                      vl: int = 0) -> None:
         """One patched slot's keyed host mirrors: adopt the leader's
         (key, handle, payload) — key None means the slot is empty on
         the leader, so any local mapping is dropped.  handle -1 is the
         leader's device-native (inline RMW) sentinel: the value rides
-        the patched engine arrays, not the payload store."""
+        the patched engine arrays, not the payload store (the read
+        fast path's inline mirror adopts it from the patch's value
+        plane).  The slot's committed (epoch, seq) rides into the vsn
+        mirror so a later promotion serves leased kget_vsn from it."""
         svc = self.svc
         old = svc.slot_handle[e].pop(s, 0)
         if old > 0 and old != handle:
@@ -860,13 +880,16 @@ class ReplicaCore:
                  if sl == s and k != key]
         for k in stale:
             svc.key_slot[e].pop(k, None)
+        svc._slot_vsn[e][s] = (int(ep), int(sq))
         if handle == -1:
             svc._inline_slots[e].add(s)
+            svc._inline_value[e][s] = int(vl)
             svc.slot_handle[e][s] = -1
             if key is not None:
                 svc.key_slot[e][key] = s
             return
         svc._inline_slots[e].discard(s)
+        svc._inline_value[e].pop(s, None)
         if handle:
             svc.values[handle] = payload
             svc.slot_handle[e][s] = handle
@@ -909,10 +932,10 @@ class _PendingFlush:
     when the host-quorum outcome is known."""
 
     __slots__ = ("seq", "crc", "sends", "deadline", "taken", "planes",
-                 "ack", "ack_reads")
+                 "ack", "ack_reads", "shipped_at")
 
-    def __init__(self, seq: int, crc: int, sends, deadline: float
-                 ) -> None:
+    def __init__(self, seq: int, crc: int, sends, deadline: float,
+                 shipped_at: float = 0.0) -> None:
         self.seq = seq
         self.crc = crc
         self.sends = sends
@@ -921,6 +944,12 @@ class _PendingFlush:
         self.planes: Any = None
         self.ack = True
         self.ack_reads = True
+        #: runtime.now when the flush was enqueued/shipped — the base
+        #: of any host-lease grant its settle may issue (the quorum
+        #: contact is no fresher than the ship; granting from settle-
+        #: processing time would stretch the leased-read window by
+        #: the whole settle delay)
+        self.shipped_at = shipped_at
 
 
 class PeerLink:
@@ -1196,6 +1225,7 @@ class ReplicatedService(BatchedEnsembleService):
                  install_timeout: float = 60.0,
                  repl_window: int = 4,
                  self_addr: Optional[Tuple[str, int]] = None,
+                 trust_host_lease: bool = False,
                  **kw) -> None:
         # the (runtime, n_ens, n_peers, n_slots) positional prefix
         # matches the base class so restore() reconstructs us from a
@@ -1231,6 +1261,22 @@ class ReplicatedService(BatchedEnsembleService):
         self._deposed = False
         self._is_leader = False
         self._last_quorum_ok = True
+        #: lease-protected fast reads on a replication group are
+        #: LEADER-ONLY and additionally gated on a HOST-side lease:
+        #: renewed only by a settle whose host quorum confirmed this
+        #: leader's epoch, zeroed the moment a settle loses the
+        #: quorum or a higher promise is observed (a deposed leader
+        #: invalidates before its next ack).  OPT-IN
+        #: (``trust_host_lease=True``): unlike the device-lane lease,
+        #: host promises are not time-fenced — a candidate may be
+        #: granted a takeover at any moment, so a superseded-but-live
+        #: leader could serve up to ``config.lease()`` of leased
+        #: reads before its next settle observes the fencing.  The
+        #: default keeps the strict reads-need-the-host-quorum
+        #: barrier; opt in when the deployment's promotion discipline
+        #: waits out the lease (docs/ARCHITECTURE.md §9).
+        self.trust_host_lease = bool(trust_host_lease)
+        self._host_lease_until = 0.0
         self._links: List[PeerLink] = [
             PeerLink(h, p, lambda: self._ge) for h, p in peers]
         #: replication window: shipped-but-unsettled flushes, oldest
@@ -1251,6 +1297,21 @@ class ReplicatedService(BatchedEnsembleService):
     @property
     def is_leader(self) -> bool:
         return self._is_leader and not self._deposed
+
+    def _fast_read_ok(self, ens: int, now: float):
+        """Group-mode gate over the base lease fast path: replicas
+        NEVER serve (leader-only), and a leader serves only inside a
+        host-quorum lease — and only when the operator opted into
+        trusting it (``trust_host_lease``); the default keeps every
+        read behind the host-quorum round."""
+        if self._links or self.group_size > 1:
+            if not self.is_leader:
+                return "not_leader"
+            if not self.trust_host_lease:
+                return "no_host_lease_trust"
+            if self._host_lease_until <= now + self._read_margin:
+                return "no_lease"
+        return super()._fast_read_ok(ens, now)
 
     def attach_peers(self, peers: Sequence[Tuple[str, int]]) -> None:
         assert not self._links, "peers already attached"
@@ -1359,6 +1420,9 @@ class ReplicatedService(BatchedEnsembleService):
                                 self._grp_seq, self.core.cfg)
                 self._deposed = False
                 self._is_leader = True
+                # a fresh reign starts lease-less: the first quorum-
+                # confirmed settle grants the host read lease
+                self._host_lease_until = 0.0
             # a persisted explicit config defines the quorum size now
             if self.core.cfg[1] is not None:
                 self.group_size = len(self.core.cfg[1])
@@ -1783,7 +1847,8 @@ class ReplicatedService(BatchedEnsembleService):
         self.core.applied_seq = fl.grp_seq
         self.core.last_crc = crc
         entry = _PendingFlush(fl.grp_seq, crc, sends,
-                              time.monotonic() + self.ack_timeout)
+                              time.monotonic() + self.ack_timeout,
+                              shipped_at=fl.now)
         self._pending_flushes.append(entry)
         self._unclaimed = entry
         self.group_stats["applies"] += 1
@@ -2030,7 +2095,25 @@ class ReplicatedService(BatchedEnsembleService):
                 link.needs_sync = True
         q = self._quorum_from(acked) and not self._deposed
         self._last_quorum_ok = q
-        if not q:
+        # the HOST lease for leader-local fast reads: only a settle
+        # whose host quorum confirmed this epoch renews it, and a
+        # lost quorum revokes it BEFORE any of this flush's futures
+        # resolve (the mirror updates below run under ack_reads=False
+        # then — a minority leader serves nothing).  The grant is
+        # based at the flush's SHIP time, not settle-processing time
+        # (mirroring the device lane's fl.now discipline): the quorum
+        # contact the acks prove is no fresher than the ship, and a
+        # promoter waiting out lease() counts from the fencing — a
+        # settle delayed in the pipeline must not stretch the leased
+        # window past what those acks can vouch for.  max() keeps a
+        # later-shipped flush's settle from shrinking an earlier
+        # grant (settles process in FIFO ship order anyway).
+        if q:
+            self._host_lease_until = max(
+                self._host_lease_until,
+                entry.shipped_at + self.config.lease())
+        else:
+            self._host_lease_until = 0.0
             self.group_stats["quorum_failures"] += 1
         if entry.taken is not None:
             super()._resolve_flush(entry.taken, entry.planes,
@@ -2098,6 +2181,9 @@ class ReplicatedService(BatchedEnsembleService):
             self.group_stats["depositions"] += 1
             self._emit("grp_deposed", {"superseded_by": promised})
         self._deposed = True
+        # a deposed leader invalidates its read lease BEFORE its next
+        # ack — no leased read may outlive the observed fencing
+        self._host_lease_until = 0.0
         self.core.promised = max(self.core.promised, promised)
 
     # -- replicated dynamic lifecycle ---------------------------------------
@@ -2205,6 +2291,10 @@ class ReplicatedService(BatchedEnsembleService):
             "peers_synced": sum(not l.needs_sync for l in self._links),
             "repl_window": self.repl_window,
             "pipeline_pending": len(self._pending_flushes),
+            "trust_host_lease": self.trust_host_lease,
+            "host_lease_valid": bool(
+                self._host_lease_until
+                > self.runtime.now + self._read_margin),
             **self.group_stats,
         }
         return s
@@ -2241,7 +2331,8 @@ class ReplicaServer:
                  peers: Sequence[Tuple[str, int]] = (),
                  auto_failover: Optional[float] = None,
                  dynamic: bool = False,
-                 advertise: Optional[Tuple[str, int]] = None) -> None:
+                 advertise: Optional[Tuple[str, int]] = None,
+                 trust_host_lease: bool = False) -> None:
         runtime = WallRuntime()
         if data_dir is not None and (
                 os.path.exists(os.path.join(data_dir, "META"))
@@ -2250,12 +2341,14 @@ class ReplicaServer:
             self.svc = ReplicatedService.restore(
                 runtime, data_dir, group_size=group_size,
                 data_dir=data_dir, config=config,
-                ack_timeout=ack_timeout, **dyn_kw)
+                ack_timeout=ack_timeout,
+                trust_host_lease=trust_host_lease, **dyn_kw)
         else:
             self.svc = ReplicatedService(
                 runtime, n_ens, 1, n_slots, group_size=group_size,
                 data_dir=data_dir, config=config,
-                ack_timeout=ack_timeout, dynamic=dynamic)
+                ack_timeout=ack_timeout, dynamic=dynamic,
+                trust_host_lease=trust_host_lease)
         self.core = self.svc.core
         warmup_kernels(self.svc)
         self.tick = tick
@@ -2966,6 +3059,11 @@ def main(argv=None) -> int:
                     help="self-promote when no leader traffic for "
                          "this long and this host ranks first among "
                          "reachable peers")
+    ap.add_argument("--trust-host-lease", action="store_true",
+                    help="serve lease-protected fast reads when this "
+                         "host leads (opt-in: trusts the host-quorum "
+                         "lease between settles — see "
+                         "docs/ARCHITECTURE.md §9)")
     args = ap.parse_args(argv)
 
     from riak_ensemble_tpu.config import fast_test_config
@@ -2984,7 +3082,8 @@ def main(argv=None) -> int:
         host=args.host, data_dir=args.data_dir,
         config=fast_test_config() if args.fast else None,
         peers=peers, auto_failover=args.auto_failover,
-        dynamic=args.dynamic, advertise=adv)
+        dynamic=args.dynamic, advertise=adv,
+        trust_host_lease=args.trust_host_lease)
     print(f"repgroup replica repl={srv.repl_port} "
           f"client={srv.client_port}", flush=True)
     try:

@@ -1202,8 +1202,12 @@ class Peer(Actor):
                 yield self._sync_to_self(("request_failed",))
 
     def _check_lease(self):
-        """peer.erl:1493-1516."""
-        if self.config.trust_lease and self.lease_obj.check_lease():
+        """peer.erl:1493-1516.  The lease is trusted only up to the
+        clock-skew margin (Config.read_margin) — the same guard the
+        batched plane's read fast path applies; past it the read
+        falls back to the check_epoch quorum round."""
+        if self.config.trust_lease and \
+                self.lease_obj.check_lease(self.config.read_margin()):
             return True
         fut = self._blocking_send_all(("check_epoch", self.id, self.epoch))
         outcome = yield fut

@@ -29,6 +29,14 @@ requests and correlate out-of-order completions:
     ens, keys)                       -> [per-key results, in order]
     ("stats",)                       -> dict
 
+Reads (``kget``/``kget_vsn``/``kget_many``) are served through the
+service's lease-protected fast path when its conditions hold — the
+response arrives without waiting for a flush; semantics are
+unchanged (linearizable).  ``--no-fast-reads`` (or
+``RETPU_FAST_READS=0`` in the server's environment) opts out;
+``("stats",)`` reports ``read_fastpath_hits``/``misses`` with
+per-reason miss counters and the live ``lease_valid_fraction``.
+
 Dynamic-lifecycle ops (service constructed with ``dynamic=True``;
 the runtime create/destroy surface of
 ``riak_ensemble_manager:create_ensemble``, manager.erl:157-166):
@@ -390,14 +398,19 @@ async def serve(n_ens: int, n_peers: int, n_slots: int,
                 config: Optional[Config] = None,
                 engine: Any = None, dynamic: Optional[bool] = None,
                 data_dir: Optional[str] = None,
-                warm: bool = False) -> ServiceServer:
+                warm: bool = False,
+                fast_reads: Optional[bool] = None) -> ServiceServer:
     """Bring up runtime + service + server; returns the started
     server (call ``await server.stop()`` to tear down).
 
     ``dynamic`` is tri-state: None (default) = no assertion — a
     restore adopts the persisted lifecycle mode; True/False = the
     caller's explicit assertion — a restore of a data_dir persisted
-    with the OTHER mode fails loudly (``_merge_dynamic``)."""
+    with the OTHER mode fails loudly (``_merge_dynamic``).
+
+    ``fast_reads`` is tri-state too: None keeps the service default
+    (RETPU_FAST_READS env + config.trust_lease); True/False forces
+    the lease-protected read fast path on/off for this server."""
     runtime = NetRuntime("svc", {"svc": (host, 0)})
     runtime.loop = asyncio.get_running_loop()
     cfg = config if config is not None else Config()
@@ -423,11 +436,14 @@ async def serve(n_ens: int, n_peers: int, n_slots: int,
         svc = BatchedEnsembleService(
             runtime, n_ens, n_peers, n_slots, tick=tick, config=cfg,
             engine=engine, dynamic=bool(dynamic), data_dir=data_dir)
+    if fast_reads is not None:
+        svc.set_fast_reads(fast_reads)
     if warm:
         # pre-compile the (K, A) bucket grid — pow2 flush depths x
-        # pow2 active-column widths — so no client ever pays a
-        # mid-serving first-compile inside its op latency (the
-        # dispatch p99 blip)
+        # pow2 active-column widths, both want_vsn pack variants
+        # (covers the read fast path's get-only fallback shapes) — so
+        # no client ever pays a mid-serving first-compile inside its
+        # op latency (the dispatch p99 blip)
         svc.warmup()
     server = ServiceServer(svc, host, port)
     await server.start()
@@ -458,6 +474,10 @@ def main(argv=None) -> int:
                          "batch depths x pow2 active-column buckets — "
                          "before accepting clients (slower boot, no "
                          "mid-serving compile spikes)")
+    ap.add_argument("--no-fast-reads", action="store_true",
+                    help="disable the lease-protected read fast path "
+                         "(every read takes a device round; same as "
+                         "RETPU_FAST_READS=0)")
     args = ap.parse_args(argv)
 
     async def run() -> None:
@@ -466,7 +486,8 @@ def main(argv=None) -> int:
             args.port, args.tick,
             config=fast_test_config() if args.fast else None,
             dynamic=args.dynamic, data_dir=args.data_dir,
-            warm=args.warm)
+            warm=args.warm,
+            fast_reads=False if args.no_fast_reads else None)
         print(f"svcnode serving {args.n_ens} ensembles on "
               f"{server.host}:{server.port}", flush=True)
         try:

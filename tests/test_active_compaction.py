@@ -279,6 +279,10 @@ def test_corrupt_flag_reaches_scrub_under_compaction():
         leaf = np.asarray(s.state.tree_leaf).copy()
         leaf[5, 1, slot] ^= 0xDEAD
         s.state = s.state._replace(tree_leaf=jnp.asarray(leaf))
+        # leased fast reads never touch the device — expire the
+        # leases so this read takes the (compacted) round and
+        # exercises the full-width corrupt mask under test
+        s.lease_until[:] = 0.0
         g = s.kget(5, "k")  # active set = {5}: maximally compacted
         while any(s.queues):
             s.flush()

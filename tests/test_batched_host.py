@@ -144,8 +144,14 @@ def test_service_heals_device_corruption():
     for e in range(4):
         ov = ov.at[e, 2, slot_k[e]].set(424242)
     svc.state = svc.state._replace(obj_val=ov)
+    # Out-of-band device damage is only visible to a DEVICE round (a
+    # leased fast read serves the host committed mirror — like cold
+    # slots, damage waits for the next device access or scrub): expire
+    # the leases before each read so every one takes the round and
+    # trips the gate (a flush's quorum round re-leases every column).
     # Reads still serve the committed value; repair kicks in.
     for e in range(4):
+        svc.lease_until[:] = 0.0
         assert settle(runtime, svc.kget(e, "k")) == ("ok", b"v")
     assert svc.corruptions > 0   # detected on device, surfaced to host
     from riak_ensemble_tpu.ops import engine as eng
@@ -646,11 +652,16 @@ def test_launch_failure_fails_ops_instead_of_orphaning():
 
     FlakyEngine.fail_next = True
     f1 = svc.kput(0, "b", b"2")
+    # a leased read of an untouched key serves from the committed
+    # mirror BEFORE the failing launch — the failure can't reach it
     f2 = svc.kget(0, "a")
+    assert f2.done and f2.value == ("ok", b"1")
+    # while one of the write-pended key rides the (failing) round
+    f2b = svc.kget(0, "b")
     with pytest.raises(RuntimeError, match="injected"):
         svc.flush()
     assert f1.done and f1.value == "failed"
-    assert f2.done and f2.value == "failed"
+    assert f2b.done and f2b.value == "failed"
     # payload of the failed put released, slot queued for recycle
     assert len(svc.values) == 1  # only "a"'s committed payload
 
@@ -659,9 +670,9 @@ def test_launch_failure_fails_ops_instead_of_orphaning():
     while any(svc.queues):
         svc.flush()
     assert f3.done and f3.value[0] == "ok"
-    assert svc.kget(0, "b").done is False
-    while any(svc.queues):
-        svc.flush()
+    # the committed write is immediately visible to a leased read
+    # (mirror-before-ack), no second round needed
+    assert svc.kget(0, "b").value == ("ok", b"3")
 
 
 def test_async_launch_failure_rolls_back_state():

@@ -51,3 +51,37 @@ def test_skewed_rung_smoke():
     assert out["payload_bytes_per_flush"] > 0
     assert (out["payload_bytes_per_flush"]
             < 0.25 * out["payload_bytes_full_width_per_flush"]), out
+
+
+def test_read_rung_smoke():
+    """The read fast-path regression tripwire: on the uncontended
+    read workload (disjoint read/write key sets) the fast-path
+    hit-rate must exceed 90%, and the fastpath-off A/B arm must pass
+    the fast-vs-device equivalence check (run_read_service asserts
+    value equality internally and reports the count)."""
+    out = bench.run_read_service(n_ens=32, n_peers=3, n_slots=8, k=8,
+                                 seconds=0.2, warm=False)
+    assert out["read_hit_rate"] > 0.9, out
+    assert out["read_fastpath_hits"] > 0
+    assert out["read_equivalence_ok"] is True
+    assert out["read_equivalence_checked"] > 0
+    # both arms measured, sane rates; the headline speedup is pinned
+    # at round time (512-ens shape), not at smoke scale
+    assert out["read_baseline_only_ops_per_sec"] > 0
+    assert out["read_only_ops_per_sec"] > 0
+    assert out["read_fastpath_speedup"] > 0
+
+
+def test_mixed_tail_attribution_smoke():
+    """The mixed rung names a dominant latency mark for every
+    >5x-p50 batch (the tail-attribution satellite): keys present and
+    internally consistent — cause counts sum to the tail count."""
+    out = bench.run_mixed_service(n_ens=4, n_peers=3, n_slots=8, k=4,
+                                  seconds=0.05)
+    assert "mixed_tail_batches" in out
+    causes = out["mixed_tail_causes"]
+    assert sum(causes.values()) == out["mixed_tail_batches"]
+    if out["mixed_tail_batches"]:
+        assert out["mixed_tail_top_cause"] in causes
+    else:
+        assert out["mixed_tail_top_cause"] is None

@@ -134,3 +134,73 @@ def test_workload_multinode_partitions(seed):
     assert sum(w.op_counts.values()) >= 90
     outcomes = {ev[0] for m in w.models.values() for ev in m.history}
     assert "ack" in outcomes and "read" in outcomes
+
+
+# -- batched-service read fast path under nemesis ---------------------------
+#
+# The lease-protected read fast path (batched_host, ARCHITECTURE §9)
+# serves linearizable kgets from the leader's committed host mirror —
+# no device round — inside a margin-checked lease.  These sweeps drive
+# it through ServiceReadWorkload's nemesis schedule: lease expiry
+# mid-workload, leader step-down/re-election, and a skewed-margin
+# clock; the KeyModel raises Violation on any stale or lost read.
+
+
+def _read_fastpath_sweep(seed, *, pipeline_depth=1, margin=None,
+                         rounds=40):
+    pytest.importorskip("jax")
+    from riak_ensemble_tpu.config import fast_test_config
+    from riak_ensemble_tpu.linearizability import ServiceReadWorkload
+    from riak_ensemble_tpu.parallel.batched_host import (
+        BatchedEnsembleService,
+    )
+    from riak_ensemble_tpu.runtime import Runtime
+
+    config = fast_test_config()
+    if margin is not None:
+        config.read_lease_margin = margin
+    runtime = Runtime(seed=seed)
+    svc = BatchedEnsembleService(runtime, 4, 5, n_slots=8, tick=None,
+                                 max_ops_per_tick=8, config=config,
+                                 pipeline_depth=pipeline_depth)
+    w = ServiceReadWorkload(svc, runtime, seed=seed, rounds=rounds)
+    w.run()  # raises Violation on a stale/lost read
+    return svc
+
+
+@pytest.mark.parametrize("seed", [1201, 1202, 1203])
+def test_service_read_fastpath_linearizable(seed):
+    svc = _read_fastpath_sweep(seed)
+    # the sweep must exercise BOTH sides of the router: mirror-served
+    # hits AND device-round fallbacks forced by the nemesis
+    assert svc.read_fastpath_hits > 0
+    assert svc.read_fastpath_misses > 0
+    reasons = svc.read_fastpath_miss_reasons
+    assert reasons.get("no_lease", 0) > 0, reasons  # lease/margin races
+    assert reasons.get("pending_write", 0) > 0, reasons
+
+
+@pytest.mark.parametrize("seed", [1301, 1302])
+def test_service_read_fastpath_linearizable_pipelined(seed):
+    """Same sweep across the depth-2 launch pipeline: an acked write
+    must be visible to every later fast read even while its launch's
+    resolve runs one flush late (the pending-write index spans the
+    in-flight window)."""
+    svc = _read_fastpath_sweep(seed, pipeline_depth=2)
+    assert svc.read_fastpath_hits > 0
+    assert svc.read_fastpath_misses > 0
+
+
+@pytest.mark.parametrize("seed", [1401])
+def test_service_read_fastpath_skewed_margin(seed):
+    """A margin close to the whole lease (the skewed-clock model:
+    trust almost nothing of the grant) must stay linearizable and
+    push traffic onto the fallback round — the fast path degrades to
+    correctness, never to staleness."""
+    from riak_ensemble_tpu.config import fast_test_config
+
+    cfg = fast_test_config()
+    wide_margin = cfg.lease() * 0.9  # still < follower() - lease()
+    svc = _read_fastpath_sweep(seed, margin=wide_margin)
+    assert svc.read_fastpath_misses > 0
+    assert svc.read_fastpath_miss_reasons.get("no_lease", 0) > 0

@@ -188,7 +188,10 @@ def test_corruption_deferral_repairs_before_next_ack():
     # two read batches through the pipeline: batch 1's read trips the
     # integrity gate; its corrupt plane is inspected at resolve —
     # after batch 2's enqueue — and the exchange dispatches before
-    # batch 2's futures resolve
+    # batch 2's futures resolve.  Expire the leases first: a leased
+    # fast read would serve the host mirror and never take the device
+    # round whose integrity gate this test exercises.
+    svc.lease_until[:] = 0.0
     svc.events.clear()
 
     def on_ack(j):
