@@ -771,80 +771,150 @@ def run_keyed_service(n_ens: int, n_peers: int, n_slots: int, k: int,
     return {"scalar": scalar_rate, "batched": ops / elapsed}
 
 
-def run_repgroup(seconds: float, smoke: bool) -> dict:
-    """Cross-host replication-group rung: a 3-host group (leader
-    in-process + 2 replica OS processes), fsync WALs, host-majority
-    commit barrier.  Measures the keyed client surface end to end —
-    what the availability story costs per op vs the single-process
-    service."""
-    import shutil
-    import signal
+def run_repgroup(seconds: float, smoke: bool,
+                 baseline: bool = True) -> dict:
+    """Cross-host replication-group rung: a 3-host group, fsync WALs,
+    host-majority commit barrier.  Measures the keyed client surface
+    end to end — what the availability story costs per op vs the
+    single-process service.
+
+    Round 6: the main arm ships changed-slot DELTA frames (one
+    coalesced raw frame per flush per link, batched replica apply);
+    the ``baseline`` arm re-runs the identical workload with
+    ``RETPU_REPL_DELTA=0`` semantics (full-plane frames) and reports
+    ``repl_delta_speedup``.  Both arms meter shipped bytes per entry
+    against the full-plane equivalent and break the leader's
+    replication cost into build/encode/ack components.  The smoke
+    shape runs the replica hosts IN PROCESS (threaded servers, shared
+    jit cache) and additionally verifies delta/full equivalence: every
+    replica lane's engine state must be bit-equal to the leader's."""
+    n_ens, n_slots, k = (16, 16, 8) if smoke else (64, 32, 16)
+    out = _repgroup_arm(seconds, smoke, n_ens, n_slots, k, delta=True)
+    res = {
+        "repgroup_ops_per_sec": out["ops_per_sec"],
+        "repgroup_p50_ms": out["p50_ms"],
+        "repgroup_p99_ms": out["p99_ms"],
+        "repl_bytes_per_entry": out["bytes_per_entry"],
+        "repl_bytes_per_entry_full_plane": out["bytes_full_equiv"],
+        "repl_delta_entries": out["delta_entries"],
+        "repl_full_entries": out["full_entries"],
+        "repl_ship_breakdown_ms": out["breakdown_ms"],
+    }
+    if "equivalence_ok" in out:
+        res["repl_equivalence_ok"] = out["equivalence_ok"]
+    if baseline:
+        base = _repgroup_arm(seconds, smoke, n_ens, n_slots, k,
+                             delta=False)
+        res["repgroup_baseline_ops_per_sec"] = base["ops_per_sec"]
+        res["repl_bytes_per_entry_baseline"] = base["bytes_per_entry"]
+        res["repl_delta_speedup"] = round(
+            out["ops_per_sec"] / max(base["ops_per_sec"], 1e-9), 3)
+    return res
+
+
+def _repgroup_spawn_subprocess(n_ens, n_slots, tmp, i, procs):
+    """One replica host OS process (the full-shape arm: real failure
+    domains, real sockets, real fsync).  The child lands in ``procs``
+    the moment it exists — BEFORE the ready-line parse — so a
+    malformed ready line can't leak a live replica past the caller's
+    SIGKILL sweep."""
     import subprocess
-    import tempfile
     import textwrap
 
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child = textwrap.dedent(f"""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        sys.path.insert(0, {repo!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        # replica warmup compiles the same pow2 ladder as the
+        # leader: share the persistent compile cache or each
+        # child pays minutes of XLA compile on a 1-core box
+        jax.config.update("jax_compilation_cache_dir",
+                          {repo!r} + "/.jax_cache")
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 1.0)
+        from riak_ensemble_tpu.parallel import repgroup
+        repgroup.main(["--n-ens", "{n_ens}", "--group-size", "3",
+                       "--n-slots", "{n_slots}", "--fast",
+                       "--data-dir", {tmp!r} + "/r{i}"])
+    """)
+    # stderr → DEVNULL and stdout drained by a daemon thread after
+    # the ready line: replicas live for the whole bench, and a chatty
+    # child blocking on a full 64 KiB pipe would stop acking and
+    # stall the quorum (review r4)
+    p = subprocess.Popen([sys.executable, "-c", child],
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.DEVNULL, text=True,
+                         env=env)
+    procs.append(p)
+    line = p.stdout.readline()
+    assert line, "repgroup replica died before ready line"
+    parts = dict(kv.split("=") for kv in line.split()[2:])
+    import threading
+    threading.Thread(target=lambda f=p.stdout: [None for _ in f],
+                     daemon=True).start()
+    return int(parts["repl"])
+
+
+def _repgroup_arm(seconds: float, smoke: bool, n_ens: int,
+                  n_slots: int, k: int, delta: bool) -> dict:
+    import shutil
+    import signal
+    import tempfile
+
+    from riak_ensemble_tpu.config import fast_test_config
     from riak_ensemble_tpu.parallel import repgroup
     from riak_ensemble_tpu.parallel.batched_host import WallRuntime
 
-    n_ens, n_slots, k = (16, 16, 8) if smoke else (64, 32, 16)
     tmp = tempfile.mkdtemp(prefix="bench_repgroup_")
-    repo = os.path.dirname(os.path.abspath(__file__))
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
     procs = []
+    servers = []
     try:
-        for i in (1, 2):
-            child = textwrap.dedent(f"""
-                import os, sys
-                os.environ["JAX_PLATFORMS"] = "cpu"
-                sys.path.insert(0, {repo!r})
-                import jax
-                jax.config.update("jax_platforms", "cpu")
-                # replica warmup compiles the same pow2 ladder as the
-                # leader: share the persistent compile cache or each
-                # child pays minutes of XLA compile on a 1-core box
-                jax.config.update("jax_compilation_cache_dir",
-                                  {repo!r} + "/.jax_cache")
-                jax.config.update(
-                    "jax_persistent_cache_min_compile_time_secs", 1.0)
-                from riak_ensemble_tpu.parallel import repgroup
-                repgroup.main(["--n-ens", "{n_ens}", "--group-size",
-                               "3", "--n-slots", "{n_slots}",
-                               "--fast",
-                               "--data-dir", {tmp!r} + "/r{i}"])
-            """)
-            # stderr → DEVNULL and stdout drained by a daemon thread
-            # after the ready line: replicas live for the whole bench,
-            # and a chatty child blocking on a full 64 KiB pipe would
-            # stop acking and stall the quorum (review r4)
-            p = subprocess.Popen([sys.executable, "-c", child],
-                                 stdout=subprocess.PIPE,
-                                 stderr=subprocess.DEVNULL, text=True,
-                                 env=env)
-            procs.append(p)
-        import threading
         ports = []
-        for p in procs:
-            line = p.stdout.readline()
-            assert line, "repgroup replica died before ready line"
-            parts = dict(kv.split("=") for kv in line.split()[2:])
-            ports.append(int(parts["repl"]))
-            threading.Thread(target=lambda f=p.stdout: [None for _
-                                                        in f],
-                             daemon=True).start()
-
-        from riak_ensemble_tpu.config import fast_test_config
+        if smoke:
+            for i in (1, 2):
+                servers.append(repgroup.ReplicaServer(
+                    n_ens, 3, n_slots, data_dir=f"{tmp}/r{i}",
+                    config=fast_test_config()))
+            ports = [s.repl_port for s in servers]
+        else:
+            for i in (1, 2):
+                ports.append(_repgroup_spawn_subprocess(
+                    n_ens, n_slots, tmp, i, procs))
         svc = repgroup.ReplicatedService(
             WallRuntime(), n_ens, 1, n_slots, group_size=3,
             peers=[("127.0.0.1", p) for p in ports],
             ack_timeout=60.0, max_ops_per_tick=k,
-            config=fast_test_config(), data_dir=tmp + "/leader")
+            config=fast_test_config(), data_dir=tmp + "/leader",
+            # the PR-1 async launch pipeline: overlap round N+1's
+            # device step with round N's resolve/build/ship (the
+            # repl_window ack pipeline stacks on top — settles stay
+            # quorum-barriered either way)
+            pipeline_depth=2)
+        if not delta:
+            svc._repl_delta = False  # the RETPU_REPL_DELTA=0 arm
         repgroup.warmup_kernels(svc)
         assert svc.takeover(), "repgroup bench: takeover failed"
 
         keys = [f"key{j}" for j in range(k)]
         vals = [b"v%d" % j for j in range(k // 2)]
 
+        # smoke: writes rotate over a QUARTER of the columns per
+        # round — the skewed serving shape (§7/§10 premise: the live
+        # write set is sparse relative to the grid), so the byte
+        # meter exercises the payload-proportional-to-change property
+        # the tier-1 tripwire guards.  The full shape keeps the
+        # seed's dense round unchanged, for ops_per_sec comparability
+        # across bench rounds.
+        stride = 4 if smoke else 1
+        rnd = [0]
+
         def one_round():
+            # dense warm round regardless of skew: every column
+            # allocates its slots and elects BEFORE the meter starts
             futs = []
             for e in range(n_ens):
                 futs.append(svc.kput_many(e, keys[:k // 2], vals))
@@ -856,6 +926,7 @@ def run_repgroup(seconds: float, smoke: bool) -> dict:
 
         one_round()  # warm (slots, remote compile, sync settled)
         svc.ack_timeout = 10.0
+        g0 = dict(svc.stats()["group"])
 
         # Pipelined measured loop (VERDICT r4 weak #5): keep up to 4
         # rounds in flight so flush N+1's build/ship/local-launch
@@ -864,8 +935,10 @@ def run_repgroup(seconds: float, smoke: bool) -> dict:
         # submit -> every future of the round resolved.
         def submit():
             futs = []
+            rnd[0] += 1
             for e in range(n_ens):
-                futs.append(svc.kput_many(e, keys[:k // 2], vals))
+                if e % stride == rnd[0] % stride:
+                    futs.append(svc.kput_many(e, keys[:k // 2], vals))
                 futs.append(svc.kget_many(e, keys[k // 2:]))
             return futs
 
@@ -882,7 +955,9 @@ def run_repgroup(seconds: float, smoke: bool) -> dict:
             while inflight and all(f.done for f in inflight[0][1]):
                 tb, _futs = inflight.pop(0)
                 lat.append(time.perf_counter() - tb)
-                ops += n_ens * k
+                # each future is a many-batch of k//2 keys (dense:
+                # 2*n_ens batches/round = the seed's n_ens*k count)
+                ops += len(_futs) * (k // 2)
             if now >= t_end and (not inflight and lat):
                 break
             assert now < t_end + 120.0, "repgroup bench wedged"
@@ -890,16 +965,71 @@ def run_repgroup(seconds: float, smoke: bool) -> dict:
         g = svc.stats()["group"]
         assert g["quorum_failures"] == 0, g
         assert g["peers_synced"] == 2, g
-        lat_ms = np.asarray(lat) * 1e3
-        svc.stop()
-        return {
-            "repgroup_ops_per_sec": round(ops / elapsed, 1),
-            "repgroup_p50_ms": round(float(np.percentile(lat_ms, 50)),
-                                     3),
-            "repgroup_p99_ms": round(float(np.percentile(lat_ms, 99)),
-                                     3),
+        entries = max((g["repl_delta_entries"] + g["repl_full_entries"])
+                      - (g0["repl_delta_entries"]
+                         + g0["repl_full_entries"]), 1)
+        frames = max(g["repl_frames"] - g0["repl_frames"], 1)
+        acked = max(g["repl_acked_batches"] - g0["repl_acked_batches"],
+                    1)
+        out = {
+            "ops_per_sec": round(ops / elapsed, 1),
+            "p50_ms": round(float(np.percentile(
+                np.asarray(lat) * 1e3, 50)), 3),
+            "p99_ms": round(float(np.percentile(
+                np.asarray(lat) * 1e3, 99)), 3),
+            "bytes_per_entry": round(
+                (g["repl_bytes_sections"] - g0["repl_bytes_sections"])
+                / entries, 1),
+            "bytes_full_equiv": round(
+                (g["repl_bytes_full_equiv"]
+                 - g0["repl_bytes_full_equiv"]) / entries, 1),
+            "delta_entries": g["repl_delta_entries"]
+            - g0["repl_delta_entries"],
+            "full_entries": g["repl_full_entries"]
+            - g0["repl_full_entries"],
+            "breakdown_ms": {
+                "build": round((g["repl_build_s"] - g0["repl_build_s"])
+                               / entries * 1e3, 3),
+                "encode": round(
+                    (g["repl_encode_s"] - g0["repl_encode_s"])
+                    / frames * 1e3, 3),
+                "ack": round((g["repl_ack_s"] - g0["repl_ack_s"])
+                             / acked * 1e3, 3),
+            },
         }
+        if smoke:
+            # delta/full equivalence tripwire: every replica lane's
+            # engine state bit-equal to the leader's after drain.
+            # Quorum settles at majority, so first wait for every
+            # lane to reach the leader's applied position (a slow
+            # replica may still be draining its link backlog).
+            for _ in range(3):
+                svc.heartbeat()
+            svc._drain_pending(block_all=True)
+            want_pos = (svc.core.applied_ge, svc.core.applied_seq)
+            end = time.monotonic() + 60.0
+            while time.monotonic() < end:
+                done = True
+                for s in servers:
+                    with s._lock:
+                        done = done and ((s.core.applied_ge,
+                                          s.core.applied_seq)
+                                         >= want_pos)
+                if done:
+                    break
+                time.sleep(0.02)
+            d_l = repgroup.dump_state(svc)
+            ok = True
+            for s in servers:
+                with s._lock:
+                    d_r = repgroup.dump_state(s.svc)
+                ok = ok and d_l[0] == d_r[0]
+            out["equivalence_ok"] = ok
+        svc.stop()
+        return out
     finally:
+        for s in servers:
+            s.stop()
         for p in procs:
             try:
                 p.send_signal(signal.SIGKILL)
@@ -1456,7 +1586,7 @@ def main() -> None:
                            420.0, force_cpu)
             if r is not None:
                 svc.update({k: v for k, v in r.items()
-                            if k.startswith("repgroup_")})
+                            if k.startswith(("repgroup_", "repl_"))})
         # Flicker-window evidence (round 4): the preflight saw a live
         # accelerator but the headline landed on a CPU rung (or not at
         # all) — the chip is answering yet too slow/unstable for the
@@ -1569,6 +1699,13 @@ def main() -> None:
         "repgroup_ops_per_sec": svc.get("repgroup_ops_per_sec"),
         "repgroup_p50_ms": svc.get("repgroup_p50_ms"),
         "repgroup_p99_ms": svc.get("repgroup_p99_ms"),
+        "repgroup_baseline_ops_per_sec":
+            svc.get("repgroup_baseline_ops_per_sec"),
+        "repl_delta_speedup": svc.get("repl_delta_speedup"),
+        "repl_bytes_per_entry": svc.get("repl_bytes_per_entry"),
+        "repl_bytes_per_entry_full_plane":
+            svc.get("repl_bytes_per_entry_full_plane"),
+        "repl_ship_breakdown_ms": svc.get("repl_ship_breakdown_ms"),
         "latency_breakdown_ms": svc.get("latency_breakdown"),
         "tpu_stepprobe": svc.get("tpu_stepprobe"),
         **{k: round(v, 1) for k, v in svc.get("ladder", {}).items()},

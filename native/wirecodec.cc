@@ -270,7 +270,15 @@ struct Reader {
   }
 };
 
-PyObject *decode_value(Reader &r, int depth);
+// Raw-frame context: the resolved memoryview slices a 'B' frame's
+// buffer table describes (wire.py's raw-buffer section).  Slices are
+// built once in py_decode and borrowed by 'r' tag resolution; they
+// keep the input payload alive through the master memoryview.
+struct RawCtx {
+  std::vector<PyObject *> slices;  // strong refs, released by caller
+};
+
+PyObject *decode_value(Reader &r, int depth, RawCtx *ctx);
 
 PyObject *decode_int(const uint8_t *p, size_t n) {
   if (n == 0) return PyLong_FromLong(0);  // matches int.from_bytes(b"")
@@ -306,10 +314,10 @@ PyObject *decode_int(const uint8_t *p, size_t n) {
 // preallocated — each element consumes >= 1 byte, so growth is
 // bounded by the payload.
 int decode_items(Reader &r, int depth, uint64_t n,
-                 std::vector<PyObject *> *items) {
+                 std::vector<PyObject *> *items, RawCtx *ctx) {
   items->reserve(n < 4096 ? n : 4096);
   for (uint64_t i = 0; i < n; ++i) {
-    PyObject *item = decode_value(r, depth + 1);
+    PyObject *item = decode_value(r, depth + 1, ctx);
     if (!item) {
       for (PyObject *o : *items) Py_DECREF(o);
       items->clear();
@@ -334,7 +342,7 @@ PyObject *wrap_unhashable(const char *what) {
   return nullptr;
 }
 
-PyObject *decode_value(Reader &r, int depth) {
+PyObject *decode_value(Reader &r, int depth, RawCtx *ctx) {
   if (depth > kMaxDepth) {
     set_wire_error("frame too deep");
     return nullptr;
@@ -343,6 +351,19 @@ PyObject *decode_value(Reader &r, int depth) {
   if (r.take(1, &tp) < 0) return nullptr;
   uint8_t tag = *tp;
   switch (tag) {
+    case 'r': {
+      uint64_t idx;
+      if (r.uvarint(&idx) < 0) return nullptr;
+      if (!ctx || idx >= ctx->slices.size()) {
+        PyErr_Format(g_wire_error,
+                     "buffer ref %llu outside raw frame",
+                     static_cast<unsigned long long>(idx));
+        return nullptr;
+      }
+      PyObject *mv = ctx->slices[static_cast<size_t>(idx)];
+      Py_INCREF(mv);
+      return mv;
+    }
     case 'N':
       Py_RETURN_NONE;
     case 'T':
@@ -394,7 +415,7 @@ PyObject *decode_value(Reader &r, int depth) {
       uint64_t n;
       if (r.uvarint(&n) < 0) return nullptr;
       std::vector<PyObject *> items;
-      if (decode_items(r, depth, n, &items) < 0) return nullptr;
+      if (decode_items(r, depth, n, &items, ctx) < 0) return nullptr;
       if (tag == 't') {
         PyObject *out = PyTuple_New(static_cast<Py_ssize_t>(items.size()));
         if (!out) {
@@ -441,12 +462,12 @@ PyObject *decode_value(Reader &r, int depth) {
       PyObject *out = PyDict_New();
       if (!out) return nullptr;
       for (uint64_t i = 0; i < n; ++i) {
-        PyObject *key = decode_value(r, depth + 1);
+        PyObject *key = decode_value(r, depth + 1, ctx);
         if (!key) {
           Py_DECREF(out);
           return nullptr;
         }
-        PyObject *val = decode_value(r, depth + 1);
+        PyObject *val = decode_value(r, depth + 1, ctx);
         if (!val) {
           Py_DECREF(key);
           Py_DECREF(out);
@@ -477,7 +498,7 @@ PyObject *decode_value(Reader &r, int depth) {
       PyObject *kw = PyDict_New();
       if (!kw) return nullptr;
       for (Py_ssize_t i = 0; i < nf; ++i) {
-        PyObject *val = decode_value(r, depth + 1);
+        PyObject *val = decode_value(r, depth + 1, ctx);
         if (!val) {
           Py_DECREF(kw);
           return nullptr;
@@ -552,6 +573,68 @@ PyObject *py_encode(PyObject *, PyObject *v) {
                                    static_cast<Py_ssize_t>(b.s.size()));
 }
 
+// Decode a 'B'-tagged raw frame (wire.py's raw-buffer section):
+// buffer-length table, term section, then the raw bytes — resolved as
+// memoryview slices of the input object (zero-copy; the slices hold
+// the payload alive through the master memoryview).
+PyObject *decode_raw_frame(PyObject *arg, const uint8_t *buf,
+                           size_t len) {
+  Reader tr{buf, len, 1};  // past the 'B' tag
+  uint64_t nbufs;
+  if (tr.uvarint(&nbufs) < 0) return nullptr;
+  std::vector<uint64_t> lens;
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < nbufs; ++i) {
+    uint64_t n;
+    if (tr.uvarint(&n) < 0) return nullptr;
+    if (n > len || total + n > len) {
+      set_wire_error("raw-buffer table exceeds frame");
+      return nullptr;
+    }
+    lens.push_back(n);
+    total += n;
+  }
+  size_t data_start = len - static_cast<size_t>(total);
+  if (data_start < tr.pos) {
+    set_wire_error("raw-buffer table exceeds frame");
+    return nullptr;
+  }
+  PyObject *master = PyMemoryView_FromObject(arg);
+  if (!master) return nullptr;
+  RawCtx ctx;
+  int ok = 0;
+  size_t off = data_start;
+  for (uint64_t n : lens) {
+    PyObject *lo = PyLong_FromSize_t(off);
+    PyObject *hi = PyLong_FromSize_t(off + static_cast<size_t>(n));
+    PyObject *slice = (lo && hi) ? PySlice_New(lo, hi, nullptr)
+                                 : nullptr;
+    Py_XDECREF(lo);
+    Py_XDECREF(hi);
+    PyObject *mv = slice ? PyObject_GetItem(master, slice) : nullptr;
+    Py_XDECREF(slice);
+    if (!mv) {
+      ok = -1;
+      break;
+    }
+    ctx.slices.push_back(mv);
+    off += static_cast<size_t>(n);
+  }
+  PyObject *out = nullptr;
+  if (ok == 0) {
+    Reader r{buf, data_start, tr.pos};
+    out = decode_value(r, 0, &ctx);
+    if (out && r.pos != data_start) {
+      Py_DECREF(out);
+      out = nullptr;
+      set_wire_error("trailing bytes in frame");
+    }
+  }
+  for (PyObject *mv : ctx.slices) Py_DECREF(mv);
+  Py_DECREF(master);
+  return out;
+}
+
 PyObject *py_decode(PyObject *, PyObject *arg) {
   if (!g_wire_error) {
     PyErr_SetString(PyExc_RuntimeError, "wire codec not registered");
@@ -559,13 +642,19 @@ PyObject *py_decode(PyObject *, PyObject *arg) {
   }
   Py_buffer view;
   if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
-  Reader r{static_cast<const uint8_t *>(view.buf),
-           static_cast<size_t>(view.len), 0};
-  PyObject *out = decode_value(r, 0);
-  if (out && r.pos != r.len) {
-    Py_DECREF(out);
-    out = nullptr;
-    set_wire_error("trailing bytes in frame");
+  const uint8_t *buf = static_cast<const uint8_t *>(view.buf);
+  size_t len = static_cast<size_t>(view.len);
+  PyObject *out;
+  if (len > 0 && buf[0] == 'B') {
+    out = decode_raw_frame(arg, buf, len);
+  } else {
+    Reader r{buf, len, 0};
+    out = decode_value(r, 0, nullptr);
+    if (out && r.pos != r.len) {
+      Py_DECREF(out);
+      out = nullptr;
+      set_wire_error("trailing bytes in frame");
+    }
   }
   PyBuffer_Release(&view);
   return out;

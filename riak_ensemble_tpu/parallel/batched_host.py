@@ -498,9 +498,18 @@ class _InFlightLaunch:
     exec_ops: int = 0
     t_enq: float = 0.0
     #: replication-group extension (repgroup.ReplicatedService): the
-    #: shipped frame's group seq + per-link apply tickets
+    #: launch's group seq, its captured ship inputs (op planes +
+    #: elect/lease vectors), its put-lane metadata, and the
+    #: corruption-counter snapshot that gates delta eligibility.
+    #: ``grp_ship`` is the discriminator: None = not a replicated
+    #: leader launch.
     grp_seq: int = 0
-    grp_sends: Any = None
+    grp_ship: Any = None
+    grp_meta: Any = None
+    grp_corr0: int = 0
+    #: the round's quorum confirmations [E], stashed by the resolve
+    #: half for subclass hooks (delta frames ship them)
+    quorum_np: Any = None
 
 
 class BatchedEnsembleService:
@@ -3013,6 +3022,11 @@ class BatchedEnsembleService:
 
             # Host mirror: a won election installed our candidate.
             self.leader_np = np.where(won_np, fl.cand, self.leader_np)
+            #: the round's quorum confirmations, kept on the launch
+            #: record for subclass resolve hooks (the replication
+            #: group's delta frames ship them so replica lanes renew
+            #: leases exactly as a re-executed launch would)
+            fl.quorum_np = quorum_ok
 
             # Lease renewal: a won election, or any round in which the
             # leader confirmed its epoch with a quorum — the

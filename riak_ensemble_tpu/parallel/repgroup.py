@@ -76,10 +76,21 @@ Wire protocol (length-prefixed frames, :mod:`riak_ensemble_tpu.wire`):
       ("promise", ge)                   takeover prepare
       ("pull",)                         fetch full state (new leader)
       ("install", ge, seq, state, cfg)  push full state (re-sync)
-      ("apply", ge, seq, k, want_vsn, elect, lease, kind, slot, val,
-       exp_e, exp_s, meta)              one launch; meta = put-lane
+      ("abatch", ge, [entry, ...])      coalesced launch batch; one
+                                        raw frame, one cumulative ack.
+                                        entry is either a changed-slot
+                                        DELTA ("d", seq, k, nc, cols,
+                                        counts, js, slots, vals,
+                                        rmw_bits, quorum_bits, crc,
+                                        meta) or a FULL-plane fallback
+                                        ("f", seq, k, want_vsn, elect,
+                                        lease, kind, slot, val, exp_e,
+                                        exp_s, meta); meta = put-lane
                                         (round, ens, key, handle,
                                         payload) records
+      ("apply", ge, seq, k, want_vsn, elect, lease, kind, slot, val,
+       exp_e, exp_s, meta)              legacy single full-plane
+                                        launch (still served)
       ("cfg", ge, seq, cver, hosts, joint)  group-config record
       ("promote", peers, tick)          control: become the leader
       ("status",)                       control: role/epoch/seq
@@ -91,6 +102,25 @@ Wire protocol (length-prefixed frames, :mod:`riak_ensemble_tpu.wire`):
 
 Frames are pipelined per link (FIFO window): responses return in send
 order over the replica's sequential per-connection loop.
+
+Delta replication transport (round 6): the leader's resolve half knows
+exactly which (ensemble, slot) rows a launch committed, so the common
+apply frame ships ONLY those rows — the wire cost scales with what
+changed, not with the [K, E] grid (the synctree
+payload-proportional-to-change economics applied to the apply stream).
+A replica applies a delta IN PLACE: scatter the committed cells into
+its object planes, advance the per-ensemble seq counters, rebuild the
+touched rows' trees — no device re-execution — and the result is
+bit-equal to a full-plane re-execution by construction (commit
+epochs/seqs are derivable: epoch is the replica's own ballot plane,
+seqs are consecutive per column from its own obj_seq_ctr).  Launches
+with elections, leader-side corruption/exchange, bulk device-resident
+planes, or a delta-ineligible shape fall back to full-plane entries in
+the same stream; re-syncs and install barriers ride ahead exactly as
+before.  Entries coalesce: all launches settled by one flush (up to
+``repl_window``) ship as ONE raw frame per link — one encode, one
+scatter-gather write — and the replica applies the batch through one
+mirror/WAL pass, answering one cumulative ack.
 """
 
 from __future__ import annotations
@@ -359,6 +389,100 @@ def install_meta(svc: BatchedEnsembleService, meta: Tuple) -> None:
     svc._up_dev = None
 
 
+_DELTA_SCATTER_FN = None
+_DELTA_FINISH_FN = None
+#: scatter chunk cap — bounds the pow2 program ladder the replica can
+#: ever compile for the cell scatter (8..cap); wider cell runs loop in
+#: cap-sized chunks.  An uncapped bucket would hit a NEW bucket (and a
+#: fresh mid-run XLA compile, hundreds of ms on CPU) the first time a
+#: coalesced batch spanned more entries than any before it — measured
+#: as 2x ack latency and 4x ack p99 on the bench's pipelined loop.
+_DELTA_SCATTER_CAP = 1024
+
+
+def _delta_fns():
+    """The replica's in-place delta apply as TWO compiled programs:
+    the three object-plane scatters (one program per pow2 bucket up
+    to ``_DELTA_SCATTER_CAP``) and a finish pass (counter swap +
+    touched-row tree rebuild, one program).  The eager op-by-op
+    version dispatched the whole hash-tree rebuild one primitive at
+    a time — ~6x the per-batch replica ack cost."""
+    global _DELTA_SCATTER_FN, _DELTA_FINISH_FN
+    if _DELTA_SCATTER_FN is None:
+        import jax
+
+        def scatter(st, e_j, s_j, eps, sqs, vls):
+            return st._replace(
+                obj_epoch=st.obj_epoch.at[e_j, 0, s_j].set(
+                    eps, mode="drop"),
+                obj_seq=st.obj_seq.at[e_j, 0, s_j].set(
+                    sqs, mode="drop"),
+                obj_val=st.obj_val.at[e_j, 0, s_j].set(
+                    vls, mode="drop"))
+
+        def finish(st, ctr, rows):
+            return eng.rebuild_trees(
+                st._replace(obj_seq_ctr=ctr), rows)
+
+        _DELTA_SCATTER_FN = jax.jit(scatter)
+        _DELTA_FINISH_FN = jax.jit(finish)
+    return _DELTA_SCATTER_FN, _DELTA_FINISH_FN
+
+
+def _delta_scatter_cells(svc: BatchedEnsembleService,
+                         cells: np.ndarray, ctr_np: np.ndarray,
+                         rows: np.ndarray) -> None:
+    """Land committed cells ``[n, (e, s, epoch, seq, val)]`` in the
+    service's object planes through the capped bucket ladder, then
+    swap the counters and rebuild the touched rows' trees."""
+    import jax.numpy as jnp
+
+    scatter, finish = _delta_fns()
+    st = svc.state
+    for off in range(0, cells.shape[0], _DELTA_SCATTER_CAP):
+        chunk = cells[off:off + _DELTA_SCATTER_CAP]
+        b = 8
+        while b < chunk.shape[0]:
+            b <<= 1
+        pad = b - chunk.shape[0]
+        if pad:
+            # pads aim at slot index S and drop out of range
+            chunk = np.concatenate(
+                [chunk, np.tile(np.asarray(
+                    [[0, svc.n_slots, 0, 0, 0]], np.int32),
+                    (pad, 1))])
+        st = scatter(st, jnp.asarray(chunk[:, 0]),
+                     jnp.asarray(chunk[:, 1]),
+                     jnp.asarray(chunk[:, 2]),
+                     jnp.asarray(chunk[:, 3]),
+                     jnp.asarray(chunk[:, 4]))
+    svc.state = finish(st, jnp.asarray(ctr_np), jnp.asarray(rows))
+
+
+def warm_delta_apply(svc: BatchedEnsembleService) -> None:
+    """Pre-compile the delta-apply programs — the WHOLE scatter
+    bucket ladder (8..min(cap, E*S): any batch lands on a warmed
+    shape) plus the finish pass — so no replica delta batch ever eats
+    an XLA compile in its ack latency.  Pure no-op on state: every
+    pad aims out of range and the rebuild mask is all-false."""
+    import jax.numpy as jnp
+
+    scatter, finish = _delta_fns()
+    top = 8
+    while top < min(_DELTA_SCATTER_CAP, svc.n_ens * svc.n_slots):
+        top <<= 1
+    st, b = svc.state, 8
+    while b <= top:
+        e_j = jnp.zeros((b,), jnp.int32)
+        s_j = jnp.full((b,), svc.n_slots, jnp.int32)  # o-o-r: drop
+        z = jnp.zeros((b,), jnp.int32)
+        st = scatter(st, e_j, s_j, z, z, z)
+        b <<= 1
+    svc.state = finish(
+        st, jnp.asarray(np.asarray(st.obj_seq_ctr, np.int32)),
+        jnp.zeros((svc.n_ens, svc.n_peers), bool))
+
+
 def tree_roots(svc: BatchedEnsembleService) -> np.ndarray:
     """Per-ensemble root hashes of the single-peer lane: [E, LANES]
     (the root is the LAST entry of the concatenated upper levels)."""
@@ -492,6 +616,139 @@ def build_apply_frame(ge: int, seq: int, k: int, want_vsn: bool,
             _pack_i32(val), _pack_i32(exp_e), _pack_i32(exp_s), meta)
 
 
+def record_digest(items) -> int:
+    """Canonical digest for replicated admin records (the
+    version-preserving install's allocation): int-coerced tuples
+    through the wire codec.  ``repr`` of mixed numpy/int tuples is not
+    a stable contract across Python/numpy versions (``np.int32(5)``
+    reprs differently between numpy 1.x and 2.x); the wire encoding
+    of plain ints is the format both ends already agree on."""
+    return zlib.crc32(wire.encode(
+        [tuple(int(x) for x in item) for item in items]))
+
+
+# -- changed-slot delta entries ----------------------------------------------
+#
+# A delta entry carries, per ensemble column with commits, the
+# committed cells in round order: the round index, the written slot
+# and the written value.  Everything else a full-plane re-execution
+# would have produced is DERIVABLE on the replica from its own
+# (bit-equal) state: the commit epoch is its ballot plane's leader
+# epoch, the commit seqs are consecutive from its obj_seq_ctr, a
+# GET-rewrite's value is the slot's current value (it rides in the
+# shipped vals plane anyway — the leader's result planes report it).
+# Sections ship as wire.Raw buffers: native byte order (the same
+# contract _pack_i32 frames always had), int16 round/slot indices
+# (guarded: k and n_slots must fit), int32 elsewhere.
+
+def _idx_dtype(bound: int):
+    """Narrowest unsigned dtype holding indices < bound (byte count
+    rides the entry so both ends agree)."""
+    return np.uint8 if bound <= 256 else np.uint16
+
+
+def build_delta_entry(seq: int, k: int, committed: Optional[np.ndarray],
+                      value: Optional[np.ndarray],
+                      kind: np.ndarray, slot: np.ndarray,
+                      val: np.ndarray, quorum_ok: np.ndarray,
+                      meta: List[Tuple],
+                      n_slots: int = 65536) -> Tuple[Tuple, int, int]:
+    """Build one delta entry from the leader's resolved planes.
+
+    Returns ``(entry, crc, delta_bytes)`` — the wire entry tuple, the
+    CRC over its raw sections (the ack/integrity contract), and the
+    section byte count (the shipped-bytes meter).  Index sections use
+    the narrowest width that fits (round index by K, slot by S,
+    column/count by E/K as uint16) — at a dense write batch the entry
+    runs ~6-7 bytes per committed cell against the full planes' 20."""
+    j_dt = _idx_dtype(max(k, 1))
+    s_dt = _idx_dtype(n_slots)
+    if committed is not None and committed.any():
+        jj, ee = np.nonzero(committed)
+        order = np.lexsort((jj, ee))  # column-major, round order within
+        jj = jj[order].astype(j_dt)
+        slots = slot[committed][order].astype(s_dt)
+        is_put = np.isin(kind[committed][order],
+                         (eng.OP_PUT, eng.OP_CAS))
+        vals = np.where(is_put, val[committed][order],
+                        value[committed][order]).astype(np.int32)
+        rmw = (kind[committed][order] == eng.OP_RMW)
+        cols, counts = np.unique(ee[order], return_counts=True)
+        cols = cols.astype(np.uint16)
+        counts = counts.astype(np.uint16)
+        rmw_b = np.packbits(rmw)
+    else:
+        jj = np.zeros((0,), j_dt)
+        slots = np.zeros((0,), s_dt)
+        vals = np.zeros((0,), np.int32)
+        cols = np.zeros((0,), np.uint16)
+        counts = np.zeros((0,), np.uint16)
+        rmw_b = np.zeros((0,), np.uint8)
+    q_b = np.packbits(np.asarray(quorum_ok, bool))
+    sections = (cols, counts, jj, slots, vals, rmw_b, q_b)
+    crc = 0
+    nbytes = 0
+    for s in sections:
+        b = np.ascontiguousarray(s)
+        crc = zlib.crc32(b.tobytes(), crc)
+        nbytes += b.nbytes
+    entry = ("d", int(seq), int(k), int(jj.size),
+             int(j_dt().nbytes), int(s_dt().nbytes),
+             wire.Raw(np.ascontiguousarray(cols)),
+             wire.Raw(np.ascontiguousarray(counts)),
+             wire.Raw(np.ascontiguousarray(jj)),
+             wire.Raw(np.ascontiguousarray(slots)),
+             wire.Raw(np.ascontiguousarray(vals)),
+             wire.Raw(np.ascontiguousarray(rmw_b)),
+             wire.Raw(np.ascontiguousarray(q_b)), crc, meta)
+    return entry, crc, nbytes
+
+
+def build_full_entry(seq: int, k: int, want_vsn: bool,
+                     elect: np.ndarray, lease_ok: np.ndarray,
+                     kind: np.ndarray, slot: np.ndarray,
+                     val: np.ndarray, exp_e: Optional[np.ndarray],
+                     exp_s: Optional[np.ndarray],
+                     meta: List[Tuple]) -> Tuple[Tuple, int]:
+    """Full-plane fallback entry (re-executed by the replica through
+    the plain launch halves — elections, corruption/exchange rounds
+    and delta-ineligible shapes).  Planes ride as Raw buffers so even
+    the fallback never concatenates them into an intermediate bytes.
+    Returns ``(entry, plane_bytes)``."""
+
+    def raw_i32(p):
+        return (None if p is None
+                else wire.Raw(np.ascontiguousarray(p, np.int32)))
+
+    eb = np.packbits(np.asarray(elect, bool))
+    lb = np.packbits(np.asarray(lease_ok, bool))
+    nbytes = (eb.nbytes + lb.nbytes
+              + sum(int(np.asarray(p).nbytes) for p in
+                    (kind, slot, val) if p is not None)
+              + sum(int(np.asarray(p).nbytes) for p in (exp_e, exp_s)
+                    if p is not None))
+    entry = ("f", int(seq), int(k), bool(want_vsn), wire.Raw(eb),
+             wire.Raw(lb), raw_i32(kind), raw_i32(slot), raw_i32(val),
+             raw_i32(exp_e), raw_i32(exp_s), meta)
+    return entry, nbytes
+
+
+def full_plane_nbytes(k: int, n_ens: int, cas: bool) -> int:
+    """What a full-plane entry's sections cost at [K, E]: the
+    kind/slot/val planes (+ exp_e/exp_s only when the launch carried
+    CAS expectations — matching what :func:`build_full_entry` would
+    actually ship) + the elect/lease bit vectors — the denominator of
+    the delta-savings meter."""
+    planes = 5 if cas else 3
+    return planes * k * n_ens * 4 + 2 * ((n_ens + 7) // 8)
+
+
+def _crc_chain(acc: int, entry_crc: int) -> int:
+    """Fold one entry's CRC into a batch's cumulative ack CRC (order-
+    sensitive: a reordered or dropped entry cannot collide)."""
+    return zlib.crc32(int(entry_crc).to_bytes(8, "big"), acc)
+
+
 # -- replica-side apply ------------------------------------------------------
 
 class ReplicaCore:
@@ -527,10 +784,18 @@ class ReplicaCore:
                     self.applied_seq, self.cfg)
 
     def handle_apply(self, frame: Tuple) -> Tuple:
-        (_, ge, seq, k, want_vsn, elect_b, lease_b, kind_b, slot_b,
-         val_b, exp_e_b, exp_s_b, meta) = frame
-        svc = self.svc
-        e_n = svc.n_ens
+        """Legacy single full-plane apply (kept on the wire for
+        compatibility; the leader now ships ``abatch`` frames)."""
+        _, ge, seq = frame[:3]
+        bad = self._check_stream(ge, seq)
+        if bad is not None:
+            return bad
+        crc = self._apply_full_entry(ge, ("f",) + tuple(frame[2:]))
+        return ("applied", ge, seq, crc)
+
+    def _check_stream(self, ge: int, seq: int) -> Optional[Tuple]:
+        """The (epoch, seq) stream discipline shared by every apply
+        shape; None = accept, else the response to send."""
         if ge != self.promised or ge < self.applied_ge:
             return ("nack", "epoch", self.promised, self.applied_ge,
                     self.applied_seq)
@@ -540,7 +805,212 @@ class ReplicaCore:
         if seq != self.applied_seq + 1:
             return ("nack", "seq", self.promised, self.applied_ge,
                     self.applied_seq)
+        return None
 
+    def handle_abatch(self, frame: Tuple) -> Tuple:
+        """Coalesced launch batch: delta entries apply in place
+        through ONE device scatter + mirror/WAL pass per contiguous
+        run; full-plane entries re-execute through the plain launch
+        halves.  One cumulative ack (the chained per-entry CRCs)
+        covers the whole frame."""
+        _, ge, entries = frame
+        if ge != self.promised or ge < self.applied_ge:
+            return ("nack", "epoch", self.promised, self.applied_ge,
+                    self.applied_seq)
+        if not entries:
+            return ("applied", ge, self.applied_seq, self.last_crc)
+        if ge == self.applied_ge \
+                and int(entries[-1][1]) <= self.applied_seq:
+            # retransmit of a fully-applied batch (ack was lost);
+            # anything partially behind is a protocol break — nack
+            # and let the leader re-sync
+            if int(entries[-1][1]) == self.applied_seq:
+                return ("applied", ge, self.applied_seq, self.last_crc)
+            return ("nack", "seq", self.promised, self.applied_ge,
+                    self.applied_seq)
+        combined = 0
+        i, n = 0, len(entries)
+        while i < n:
+            ent = entries[i]
+            if int(ent[1]) != self.applied_seq + 1:
+                return ("nack", "seq", self.promised, self.applied_ge,
+                        self.applied_seq)
+            if ent[0] == "f":
+                crc = self._apply_full_entry(ge, ent)
+                combined = _crc_chain(combined, crc)
+                i += 1
+            elif ent[0] == "d":
+                # group only the CONSECUTIVE-seq prefix: a gap inside
+                # the run must stop it so the next top-of-loop check
+                # nacks "seq" with the in-order prefix applied
+                j, nxt = i, self.applied_seq + 1
+                while j < n and entries[j][0] == "d" \
+                        and int(entries[j][1]) == nxt:
+                    j += 1
+                    nxt += 1
+                crcs = self._apply_delta_run(ge, entries[i:j])
+                if crcs is None:
+                    return ("nack", "crc", self.promised,
+                            self.applied_ge, self.applied_seq)
+                for c in crcs:
+                    combined = _crc_chain(combined, c)
+                i = j
+            else:
+                return ("nack", "bad-entry", self.promised,
+                        self.applied_ge, self.applied_seq)
+        return ("applied", ge, self.applied_seq, combined)
+
+    def _apply_delta_run(self, ge: int,
+                         run: Sequence[Tuple]) -> Optional[List[int]]:
+        """Apply consecutive changed-slot delta entries IN PLACE — no
+        device re-execution.  Everything the full launch would have
+        produced is derived from this lane's own (bit-equal) state:
+        commit epochs from its ballot plane, commit seqs consecutive
+        from its obj_seq_ctr, the touched rows' trees rebuilt from the
+        scattered objects.  The WHOLE run lands through one device
+        scatter, one tree rebuild and one WAL sync (the batched apply
+        economics).  Returns per-entry CRCs, or None on a section-CRC
+        or shape violation (the leader re-syncs)."""
+        svc = self.svc
+        e_n = svc.n_ens
+        epoch_np = np.asarray(svc.state.epoch[:, 0], np.int32)
+        ctr_np = np.asarray(svc.state.obj_seq_ctr, np.int32).copy()
+        final: Dict[Tuple[int, int], Tuple[int, int, int]] = {}
+        touched = np.zeros((e_n,), bool)
+        recs: List[Tuple[Any, Any]] = []
+        crcs: List[int] = []
+        now = svc.runtime.now
+        lease_s = svc.config.lease()
+        def _buf(x):
+            return x.buf if isinstance(x, wire.Raw) else x
+
+        # Validation pass: decode + vet EVERY entry of the run before
+        # touching any state.  A mid-run failure after entry-by-entry
+        # mutation would leave this lane advertising an applied
+        # position (promise grants, campaign ranking) whose effects
+        # were never scattered or WAL-logged — a would-be promoter
+        # could adopt a state that silently lost acked writes.  All-
+        # or-nothing keeps the advertised position truthful.
+        decoded = []
+        for ent in run:
+            try:
+                (_, seq, _k, nc, jw, sw, cols_b, counts_b, jj_b,
+                 slots_b, vals_b, rmw_b, q_b, crc_ship, meta) = ent
+            except ValueError:
+                return None
+            if int(jw) not in (1, 2) or int(sw) not in (1, 2):
+                return None
+            j_dt = np.uint8 if int(jw) == 1 else np.uint16
+            s_dt = np.uint8 if int(sw) == 1 else np.uint16
+            try:
+                cols = np.frombuffer(_buf(cols_b), np.uint16)
+                counts = np.frombuffer(_buf(counts_b), np.uint16)
+                jj = np.frombuffer(_buf(jj_b), j_dt)
+                slots = np.frombuffer(_buf(slots_b), s_dt)
+                vals = np.frombuffer(_buf(vals_b), np.int32)
+                rmwb = np.frombuffer(_buf(rmw_b), np.uint8)
+                qb = np.frombuffer(_buf(q_b), np.uint8)
+            except ValueError:
+                return None
+            crc = 0
+            for b in (cols, counts, jj, slots, vals, rmwb, qb):
+                crc = zlib.crc32(b.tobytes(), crc)
+            nc = int(nc)
+            if crc != int(crc_ship) or jj.size != nc \
+                    or slots.size != nc or vals.size != nc \
+                    or cols.size != counts.size \
+                    or int(counts.sum()) != nc \
+                    or rmwb.size < (nc + 7) // 8 \
+                    or qb.size < (e_n + 7) // 8 \
+                    or (nc and (int(cols.min()) < 0
+                                or int(cols.max()) >= e_n
+                                or int(slots.min()) < 0
+                                or int(slots.max()) >= svc.n_slots)):
+                return None
+            # put-lane metadata feeds the mirror/WAL mutation loop
+            # below: vet shape and ensemble range up front too
+            try:
+                meta = [(int(j), int(e), key, handle, payload)
+                        for j, e, key, handle, payload in meta]
+            except (ValueError, TypeError):
+                return None
+            if any(e < 0 or e >= e_n for _, e, _k2, _h, _p in meta):
+                return None
+            decoded.append((int(seq), int(crc_ship), cols, counts,
+                            jj, slots, vals, rmwb, qb, meta))
+
+        # Apply pass: nothing below can fail validation — mutations
+        # land for the whole run or not at all.
+        for (seq, crc_ship, cols, counts, jj, slots, vals, rmwb, qb,
+             meta) in decoded:
+            # committed cells, column-grouped in round order: derive
+            # each cell's (epoch, seq) exactly as the kernel assigns
+            # them (obj_sequence: consecutive per column)
+            cell: Dict[Tuple[int, int],
+                       Tuple[int, int, int, bool, int]] = {}
+            pos = 0
+            for c_i, cnt in zip(cols.tolist(), counts.tolist()):
+                ep = int(epoch_np[c_i])
+                base = int(ctr_np[c_i])
+                for r_i in range(cnt):
+                    idx = pos + r_i
+                    s_i = int(slots[idx])
+                    vl = int(vals[idx])
+                    rm = bool(rmwb[idx >> 3] & (0x80 >> (idx & 7)))
+                    final[(c_i, s_i)] = (ep, base + r_i + 1, vl)
+                    cell[(int(jj[idx]), c_i)] = (ep, base + r_i + 1,
+                                                 vl, rm, s_i)
+                ctr_np[c_i] = base + cnt
+                touched[c_i] = True
+                pos += cnt
+            # keyed WAL records + host mirrors: the same meta-driven
+            # iteration the full-plane path runs
+            for j, e, key, handle, payload in meta:
+                hit = cell.get((int(j), int(e)))
+                if hit is None:
+                    continue  # that round didn't commit
+                ep, sq, vl, rm, s_i = hit
+                if rm:
+                    recs.append((("kv", e, s_i),
+                                 (key, vl, ep, sq, None, True)))
+                    self._mirror_inline(e, key, s_i, vl, ep, sq)
+                else:
+                    recs.append((("kv", e, s_i),
+                                 (key, handle, ep, sq, payload, False)))
+                    self._mirror_write(e, key, s_i, handle, payload,
+                                       ep, sq)
+            # lease renewal from the shipped quorum bits (the full
+            # path's quorum_ok renewal, on this lane's own clock)
+            renew = _unpack_bool(qb.tobytes(), e_n)
+            svc.lease_until[renew] = now + lease_s
+            self.applied_ge, self.applied_seq = int(ge), int(seq)
+            self.last_crc = int(crc_ship)
+            crcs.append(int(crc_ship))
+        if final:
+            cells = np.asarray(
+                [(e, s, ep, sq, vl)
+                 for (e, s), (ep, sq, vl) in final.items()], np.int32)
+            rows = np.zeros((e_n, svc.n_peers), bool)
+            rows[touched] = True
+            _delta_scatter_cells(svc, cells, ctr_np, rows)
+        # Durability barrier: one log()/sync covers every entry of the
+        # run + the advanced group meta, BEFORE the cumulative ack.
+        recs.append((_GRP_KEY, (self.promised, self.applied_ge,
+                                self.applied_seq, self.cfg)))
+        if svc._wal is not None:
+            svc._wal.log(recs)
+            if svc._wal.count >= svc.wal_compact_records:
+                rebuild_derived(svc)
+                svc.save()
+                save_group_meta(svc, self.promised, self.applied_ge,
+                                self.applied_seq, self.cfg)
+        return crcs
+
+    def _apply_full_entry(self, ge: int, ent: Tuple) -> int:
+        (_, seq, k, want_vsn, elect_b, lease_b, kind_b, slot_b,
+         val_b, exp_e_b, exp_s_b, meta) = ent
+        svc = self.svc
+        e_n = svc.n_ens
         elect = _unpack_bool(elect_b, e_n)
         lease_ok = _unpack_bool(lease_b, e_n)
         kind = _unpack_i32(kind_b, (k, e_n))
@@ -593,7 +1063,7 @@ class ReplicaCore:
                          (key, handle, ve, vs, payload, False)))
             self._mirror_write(e, key, int(slot[j, e]), handle,
                                payload, ve, vs)
-        self.applied_ge, self.applied_seq = ge, seq
+        self.applied_ge, self.applied_seq = int(ge), int(seq)
         self.last_crc = crc
         recs.append((_GRP_KEY, (self.promised, ge, seq, self.cfg)))
         if svc._wal is not None:
@@ -608,7 +1078,7 @@ class ReplicaCore:
                 # into a quorum while the new-epoch leader commits
                 # elsewhere (review r4: split-brain via compaction).
                 save_group_meta(svc, self.promised, ge, seq, self.cfg)
-        return ("applied", ge, seq, crc)
+        return crc
 
     def _mirror_write(self, e: int, key: Any, slot: int, handle: int,
                       payload: Any, ve: int = 0, vs: int = 0) -> None:
@@ -770,8 +1240,7 @@ class ReplicaCore:
             return ("nack", "seq", self.promised, self.applied_ge,
                     self.applied_seq)
         applied = [tuple(a) for a in applied]
-        crc = zlib.crc32(repr([(a[1], a[2], a[3], a[4])
-                               for a in applied]).encode())
+        crc = record_digest((a[1], a[2], a[3], a[4]) for a in applied)
         self.applied_ge, self.applied_seq = int(ge), int(seq)
         self.last_crc = crc
         BatchedEnsembleService._apply_installed(
@@ -912,10 +1381,40 @@ class _Encoded:
         self.payload = _HDR.pack(len(p)) + p
 
 
-class _Ticket:
-    __slots__ = ("event", "result", "posted")
+class _EncodedParts:
+    """A raw frame encoded ONCE as scatter-gather parts: the length
+    header + term section, then the bulk numpy buffers UNCOPIED (the
+    arrays stay alive through the part references until every link's
+    sender has written them).  One encode per flush, one ``sendmsg``
+    per link."""
 
-    def __init__(self) -> None:
+    __slots__ = ("parts", "nbytes")
+
+    def __init__(self, value: Any) -> None:
+        parts = wire.encode_parts(value)
+        total = sum(memoryview(p).nbytes for p in parts)
+        self.parts = [_HDR.pack(total)] + parts
+        self.nbytes = total
+
+
+def _send_parts(sock: socket.socket, parts) -> None:
+    """Scatter-gather send: every part goes to the kernel straight
+    from its owning buffer (one syscall in the common case; partial
+    sends and IOV_MAX overflow re-slice and continue)."""
+    views = [memoryview(p).cast("B") for p in parts]
+    while views:
+        sent = sock.sendmsg(views[:512])
+        while views and sent >= views[0].nbytes:
+            sent -= views[0].nbytes
+            views.pop(0)
+        if views and sent:
+            views[0] = views[0][sent:]
+
+
+class _Ticket:
+    __slots__ = ("event", "result", "posted", "on_done")
+
+    def __init__(self, on_done=None) -> None:
         self.event = threading.Event()
         self.result: Any = None
         #: send time (re-stamped by the sender as the frame goes on
@@ -923,33 +1422,103 @@ class _Ticket:
         #: a genuinely-overdue response (posted >= IO_TIMEOUT ago)
         #: from a request that arrived DURING the blocked recv
         self.posted = time.monotonic()
+        #: completion hook (the batch settle's shared condition),
+        #: attached at creation — BEFORE the frame can complete, so a
+        #: wakeup can never be missed
+        self.on_done = on_done
+
+    def _fire(self) -> None:
+        self.event.set()
+        cb = self.on_done
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
 
 
-class _PendingFlush:
-    """One shipped-but-unsettled flush in the replication pipeline:
-    its apply tickets, result CRC, and (once the service's resolve
-    hook claims it) the client futures + result planes to resolve
-    when the host-quorum outcome is known."""
+class _PendingEntry:
+    """One resolved-but-unsettled flush riding the replication
+    pipeline: its stream seq, its ack-CRC contribution, its wire
+    entry, and (once the service's resolve hook claims it) the client
+    futures + result planes to resolve when the batch's host-quorum
+    outcome is known."""
 
-    __slots__ = ("seq", "crc", "sends", "deadline", "taken", "planes",
-                 "ack", "ack_reads", "shipped_at")
+    __slots__ = ("seq", "crc", "entry", "taken", "planes", "ack",
+                 "ack_reads", "shipped_at")
 
-    def __init__(self, seq: int, crc: int, sends, deadline: float,
+    def __init__(self, seq: int, crc: int, entry: Tuple,
                  shipped_at: float = 0.0) -> None:
         self.seq = seq
         self.crc = crc
-        self.sends = sends
-        self.deadline = deadline
+        self.entry = entry
         self.taken: Optional[list] = None
         self.planes: Any = None
         self.ack = True
         self.ack_reads = True
-        #: runtime.now when the flush was enqueued/shipped — the base
-        #: of any host-lease grant its settle may issue (the quorum
-        #: contact is no fresher than the ship; granting from settle-
+        #: runtime.now when the flush was enqueued — the base of any
+        #: host-lease grant its settle may issue (the quorum contact
+        #: is no fresher than the ship; granting from settle-
         #: processing time would stretch the leased-read window by
         #: the whole settle delay)
         self.shipped_at = shipped_at
+
+
+class _PendingShip:
+    """One shipped batch (coalesced frame) awaiting its cumulative
+    acks: member entries in seq order, one ticket per link, and a
+    shared condition so the settle wakes on EVERY ack as it lands —
+    the quorum decision fires at majority time, not after the slowest
+    link (nor after a wait-links-in-list-order slow prefix)."""
+
+    __slots__ = ("entries", "sends", "deadline", "crc", "first_seq",
+                 "cond", "ship_t", "shipped_at")
+
+    def __init__(self, entries: List[_PendingEntry],
+                 deadline: float) -> None:
+        self.entries = entries
+        self.first_seq = entries[0].seq
+        crc = 0
+        for e in entries:
+            crc = _crc_chain(crc, e.crc)
+        self.crc = crc
+        self.sends = []
+        self.deadline = deadline
+        self.cond = threading.Condition()
+        self.ship_t = time.monotonic()
+        self.shipped_at = max(e.shipped_at for e in entries)
+
+    def _notify(self) -> None:
+        with self.cond:
+            self.cond.notify_all()
+
+    def _acked_now(self) -> set:
+        """Addresses whose cumulative ack already matches (cheap
+        snapshot, re-evaluated per wakeup)."""
+        acked = set()
+        for link, t in self.sends:
+            if not t.event.is_set():
+                continue
+            r = t.result
+            if r is not None and r[0] == "applied" \
+                    and int(r[3]) == self.crc and not link.needs_sync:
+                acked.add((link.host, link.port))
+        return acked
+
+    def wait_quorum(self, quorum_eval) -> None:
+        """Block until a majority ack is in, every ticket completed,
+        or the deadline passes — whichever is first (ack latency =
+        time to majority, not max over links)."""
+        with self.cond:
+            while True:
+                if all(t.event.is_set() for _l, t in self.sends):
+                    return
+                if quorum_eval(self._acked_now()):
+                    return
+                remaining = self.deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                self.cond.wait(remaining)
 
 
 class PeerLink:
@@ -985,7 +1554,7 @@ class PeerLink:
         #: on) by a later flush — installs never block the commit path
         self.install_ticket: Optional[_Ticket] = None
         #: the pipeline seq the install was queued AHEAD of (ADVICE
-        #: r5): _settle_entry may consume the ticket only for entries
+        #: r5): _settle_batch may consume the ticket only for batches
         #: at-or-after this seq — consuming an install posted by a
         #: LATER flush would clear needs_sync, the current entry's
         #: nack would re-set it, and the NEXT entry's legitimate
@@ -1011,8 +1580,8 @@ class PeerLink:
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
-    def post(self, frame: Tuple) -> _Ticket:
-        t = _Ticket()
+    def post(self, frame: Tuple, on_done=None) -> _Ticket:
+        t = _Ticket(on_done)
         self._q.put((frame, t))
         return t
 
@@ -1062,6 +1631,8 @@ class PeerLink:
                     self._awaiting.append(ticket)
                 if isinstance(frame, _Encoded):
                     sock.sendall(frame.payload)
+                elif isinstance(frame, _EncodedParts):
+                    _send_parts(sock, frame.parts)
                 else:
                     send_frame(sock, frame)
             except (OSError, ConnectionError, wire.WireError,
@@ -1138,7 +1709,7 @@ class PeerLink:
                 self._drop()
                 return
             t.result = resp
-            t.event.set()
+            t._fire()
 
     #: per-operation socket timeout: generous enough for an install
     #: (state transfer + replica-side checkpoint), bounded so a
@@ -1159,7 +1730,7 @@ class PeerLink:
             dead = list(self._awaiting)
             self._awaiting.clear()
         for t in dead:
-            t.event.set()
+            t._fire()
         self._sock = socket.create_connection(
             (self.host, self.port), timeout=10.0)
         self._sock.settimeout(self.IO_TIMEOUT)
@@ -1193,9 +1764,9 @@ class PeerLink:
             dead = list(self._awaiting)
             self._awaiting.clear()
         for t in dead:
-            t.event.set()
+            t._fire()
         if fail_also is not None:
-            fail_also.event.set()
+            fail_also._fire()
         if not self._stop:
             time.sleep(self.RECONNECT_DELAY)
 
@@ -1279,18 +1850,44 @@ class ReplicatedService(BatchedEnsembleService):
         self._host_lease_until = 0.0
         self._links: List[PeerLink] = [
             PeerLink(h, p, lambda: self._ge) for h, p in peers]
-        #: replication window: shipped-but-unsettled flushes, oldest
-        #: first; at most repl_window deep before the ship path blocks
-        #: on the head entry (per-flush quorum barrier stands —
+        #: replication window: resolved-but-unsettled flush entries,
+        #: oldest first; at most repl_window deep before the ship path
+        #: blocks on the head batch (per-flush quorum barrier stands —
         #: futures resolve only at settlement).  Distinct from the
         #: base service's pipeline_depth (the DEVICE launch pipeline).
         self.repl_window = max(1, int(repl_window))
-        self._pending_flushes: "deque[_PendingFlush]" = deque()
-        self._unclaimed: Optional[_PendingFlush] = None
+        self._pending_flushes: "deque[_PendingShip]" = deque()
+        self._unclaimed: Optional[_PendingEntry] = None
+        #: resolved entries awaiting their coalesced ship (all the
+        #: entries one flush settles ride ONE frame per link)
+        self._ship_buf: List[_PendingEntry] = []
+        #: tickets of batches settled at majority whose stragglers'
+        #: outcomes (needs_sync, depose nacks) still need bookkeeping
+        self._stragglers: List[Tuple[PeerLink, _Ticket, int]] = []
+        #: changed-slot delta shipping (RETPU_REPL_DELTA=0 pins the
+        #: full-plane frames — the A/B arm and operational escape
+        #: hatch); int16 round/slot indices bound the eligible shape
+        self._repl_delta = os.environ.get(
+            "RETPU_REPL_DELTA", "1") != "0"
+        #: reentrancy guard: shipping may drain the launch pipeline,
+        #: whose settles call back into _drain_pending
+        self._shipping = False
+        self._delta_shape_ok = (n_slots <= 32767
+                                and self.max_k <= 32767)
         #: replication observability
         self.group_stats = {"applies": 0, "quorum_failures": 0,
                             "resyncs": 0, "depositions": 0,
-                            "tree_resyncs": 0, "tree_resync_bytes": 0}
+                            "tree_resyncs": 0, "tree_resync_bytes": 0,
+                            "repl_delta_entries": 0,
+                            "repl_full_entries": 0,
+                            "repl_frames": 0,
+                            "repl_bytes_shipped": 0,
+                            "repl_bytes_sections": 0,
+                            "repl_bytes_full_equiv": 0,
+                            "repl_encode_s": 0.0,
+                            "repl_build_s": 0.0,
+                            "repl_ack_s": 0.0,
+                            "repl_acked_batches": 0}
 
     # -- leadership ---------------------------------------------------------
 
@@ -1704,13 +2301,14 @@ class ReplicatedService(BatchedEnsembleService):
     def _launch_enqueue(self, kind, slot, val, k, want_vsn,
                         exp_e=None, exp_s=None, entries=None,
                         elect=None, cand=None, lease_ok=None):
-        """Replicated ENQUEUE half: ship the apply frame to every
-        replica link (their remote launches overlap ours), then
-        dispatch the local launch through the base enqueue half.  The
-        group seq / ticket bookkeeping rides on the in-flight record;
-        the resolve half turns it into a pipelined commit barrier —
-        so a service-level ``pipeline_depth`` > 1 overlaps device
-        rounds with host resolve on a replication-group leader too."""
+        """Replicated ENQUEUE half: allocate the stream seq, capture
+        the exact launch inputs for the ship, and dispatch the local
+        launch through the base enqueue half.  The SHIP itself now
+        rides the RESOLVE half (the delta transport needs the result
+        planes to know what changed); the pipelined commit barrier is
+        unchanged — acks are never awaited inline, and a
+        ``pipeline_depth`` > 1 still overlaps device rounds with host
+        resolve on a replication-group leader."""
         if not self._links and self.group_size == 1:
             return super()._launch_enqueue(kind, slot, val, k, want_vsn,
                                            exp_e, exp_s, entries, elect,
@@ -1732,32 +2330,146 @@ class ReplicatedService(BatchedEnsembleService):
             val = np.asarray(val)
         seq = self._grp_seq + 1
         meta = _entries_meta(entries, kind, slot, self.values)
-        frame = _Encoded(build_apply_frame(
-            self._ge, seq, k, want_vsn, elect, lease_ok, kind, slot,
-            val, exp_e, exp_s, meta))
+        corr0 = self.corruptions
+        fl = super()._launch_enqueue(kind, slot, val, k, want_vsn,
+                                     exp_e, exp_s, None, elect,
+                                     cand, lease_ok)
+        # the seq advances at ENQUEUE (later pipelined launches must
+        # ship strictly increasing seqs); the core's applied position
+        # advances only at resolve, in settle order.  An enqueue
+        # failure consumed nothing — the stream has no gap.
+        self._grp_seq = seq
+        fl.grp_seq = seq
+        fl.grp_meta = meta
+        fl.grp_corr0 = corr0
+        fl.grp_ship = (np.asarray(kind), np.asarray(slot),
+                       np.asarray(val),
+                       None if exp_e is None else np.asarray(exp_e),
+                       None if exp_s is None else np.asarray(exp_s),
+                       np.asarray(elect, bool),
+                       np.asarray(lease_ok, bool))
+        return fl
 
-        # Ship first: the network fan-out and the remote launches
-        # overlap our local launch.  A link needing re-sync gets a
-        # catch-up queued ahead of the apply (FIFO per link keeps the
-        # order) — but the flush NEVER blocks on it: the outcome is
-        # consumed on a later flush, and at most one install/patch is
-        # in flight per link (a slow replica must not stall every
-        # client future for install_timeout, nor accrue a queue of
-        # redundant snapshots — review r4).  Catch-up prefers the
-        # tree-diff patch (O(diffs)); the full snapshot remains the
-        # fallback for heavy divergence, non-frozen replicas, and any
-        # probe/patch failure.
-        sends: List[Tuple[PeerLink, _Ticket]] = []
+    def _launch_resolve(self, fl, wait_key="device_d2h"):
+        """Replicated RESOLVE half: finish the local launch, build
+        this flush's wire entry from the RESULT planes — the common
+        changed-slot DELTA (payload proportional to what committed),
+        or the full-plane fallback when the launch elected, hit
+        corruption (its exchange mutated state beyond the results), or
+        the shape is delta-ineligible — and buffer it for the
+        coalesced ship.  Acks are NOT awaited here (the pipelined
+        commit barrier, VERDICT r4 weak #5): the flush's client
+        futures resolve only once its batch's host-quorum outcome is
+        known (_settle_batch), while the NEXT flush's build, ship and
+        local launch overlap this one's ack wait.  _resolve_flush
+        claims this entry and attaches the futures/planes;
+        heartbeat()-style direct launches leave taken=None."""
+        if getattr(fl, "grp_ship", None) is None:
+            # single-lane mode / replica role: the plain resolve
+            return super()._launch_resolve(fl, wait_key)
+        seq = fl.grp_seq
+        try:
+            out = super()._launch_resolve(fl, wait_key)
+        except BaseException:
+            # the local launch rolled back AFTER the stream consumed
+            # this seq: nothing was shipped, but later ships arrive
+            # with a seq gap — replicas nack and re-sync heals; mark
+            # them now so the very next ship queues the install
+            for link in self._links:
+                link.needs_sync = True
+            raise
+        committed, _g, _f, value, vsn = out
+        t0 = time.perf_counter()
+        kind, slot, val, exp_e, exp_s, elect, lease_ok = fl.grp_ship
+        meta = fl.grp_meta
+        delta_ok = (self._repl_delta and self._delta_shape_ok
+                    and self.n_peers == 1
+                    and not bool(elect.any())
+                    and self.corruptions == fl.grp_corr0)
+        if delta_ok:
+            entry_t, crc, nbytes = build_delta_entry(
+                seq, fl.k, committed, value, kind, slot, val,
+                fl.quorum_np, meta, n_slots=self.n_slots)
+            self.group_stats["repl_delta_entries"] += 1
+        else:
+            entry_t, nbytes = build_full_entry(
+                seq, fl.k, fl.want_vsn, elect, lease_ok, kind, slot,
+                val, exp_e, exp_s, meta)
+            crc = result_crc(committed, vsn)
+            self.group_stats["repl_full_entries"] += 1
+        self.group_stats["repl_bytes_sections"] += nbytes
+        self.group_stats["repl_bytes_full_equiv"] += \
+            full_plane_nbytes(fl.k, self.n_ens, cas=exp_e is not None)
+        self.group_stats["repl_build_s"] += time.perf_counter() - t0
+        fl.rec["repl_build"] = time.perf_counter() - t0
+        self.core.applied_ge = self._ge
+        self.core.applied_seq = seq
+        self.core.last_crc = crc
+        entry = _PendingEntry(seq, crc, entry_t, shipped_at=fl.now)
+        self._ship_buf.append(entry)
+        self._unclaimed = entry
+        self.group_stats["applies"] += 1
+        # Group meta persists via _wal_extra_records inside the flush's
+        # own durability barrier (one sync, and atomically with the kv
+        # records — a leader restart must never see data-bearing kv
+        # records from a seq its meta doesn't cover, or takeover could
+        # adopt an older replica state over its own acked writes).
+        # Data-less launches (heartbeats, pure reads) skip it: adopting
+        # a state that differs only by empty batches loses nothing.
+        return out
+
+    def _ship_now(self) -> None:
+        """Coalesce every buffered entry into ONE raw frame and post
+        it to every synced link — one encode, one scatter-gather write
+        per replica per flush.  A link needing re-sync gets its
+        catch-up queued INSTEAD of the batch (the catch-up lands the
+        very state the batch produced, so sending both would
+        double-apply); the ship never blocks on it — the outcome is
+        consumed on a later ship/settle, and at most one install/patch
+        is in flight per link (a slow replica must not stall every
+        client future for install_timeout, nor accrue redundant
+        snapshots — review r4).  Catch-up prefers the tree-diff patch
+        (O(diffs)); the full snapshot remains the fallback for heavy
+        divergence, non-frozen replicas, and any probe/patch failure.
+        Catch-up state is dumped only with the launch pipeline drained
+        (an enqueued-unresolved launch's effects are already in the
+        device arrays, and a snapshot stamped behind them would make
+        the next batch double-apply on the installed replica)."""
+        if self._shipping or not self._ship_buf:
+            return
+        need_catchup = any(
+            link.connected and link.install_ticket is None
+            and (link.needs_sync or (link.sync is not None
+                                     and link.sync.result is not None))
+            for link in self._links)
+        if need_catchup and self._inflight_launches:
+            self._shipping = True
+            try:
+                self._drain_launches()
+            finally:
+                self._shipping = False
+        entries = self._ship_buf
+        self._ship_buf = []
+        first_seq = entries[0].seq
+        t0 = time.perf_counter()
+        enc = _EncodedParts(
+            ("abatch", self._ge, [e.entry for e in entries]))
+        self.group_stats["repl_encode_s"] += time.perf_counter() - t0
+        self.group_stats["repl_frames"] += 1
+        self.group_stats["repl_bytes_shipped"] += enc.nbytes
+        batch = _PendingShip(entries,
+                             time.monotonic() + self.ack_timeout)
         snapshot_frame = None
+        synced_pos = self.core.applied_seq  # == entries[-1].seq
 
         def full_install(link) -> None:
             nonlocal snapshot_frame
             if snapshot_frame is None:
                 snapshot_frame = _Encoded(
-                    ("install", self._ge, self._grp_seq,
+                    ("install", self._ge, synced_pos,
                      dump_state(self), self.core.cfg))
             link.install_ticket = link.post(snapshot_frame)
-            link.install_barrier = seq  # queued ahead of THIS apply
+            link.install_barrier = synced_pos + 1  # next batch counts
             self.group_stats["resyncs"] += 1
 
         for link in self._links:
@@ -1773,20 +2485,25 @@ class ReplicatedService(BatchedEnsembleService):
                     self._note_depose(int(r[2]))
             sync = link.sync
             if sync is not None and sync.result is not None \
-                    and link.install_ticket is None:
+                    and link.install_ticket is None \
+                    and not self._inflight_launches:
+                # (a probe finishing between the drain above and here
+                # stays pending one ship: patch/install state must be
+                # dumped at the resolved position only)
                 link.sync = None
                 if sync.result == "patch" and link.connected:
                     patch = self._build_patch(sync)
                     sync.bytes += len(patch.payload)
                     link.install_ticket = link.post(patch)
-                    link.install_barrier = seq
+                    link.install_barrier = synced_pos + 1
                     self.group_stats["tree_resyncs"] += 1
                     self.group_stats["tree_resync_bytes"] += sync.bytes
                 elif link.connected:
                     full_install(link)
             elif link.needs_sync and link.connected \
                     and link.install_ticket is None \
-                    and link.sync is None:
+                    and link.sync is None \
+                    and not self._inflight_launches:
                 if self._tree_sync_eligible(link):
                     link.tried_tree = True
                     link.sync = _TreeSync()
@@ -1795,71 +2512,32 @@ class ReplicatedService(BatchedEnsembleService):
                                      daemon=True).start()
                 else:
                     full_install(link)
-            sends.append((link, link.post(frame)))
-
-        try:
-            fl = super()._launch_enqueue(kind, slot, val, k, want_vsn,
-                                         exp_e, exp_s, None, elect,
-                                         cand, lease_ok)
-        except BaseException:
-            # local launch failed AFTER the batch was shipped: any
-            # replica that applied seq N is now ahead of us — roll
-            # them back to our (rolled-back) state via re-sync before
-            # they can count toward a quorum again.
-            for link in self._links:
-                link.needs_sync = True
-            raise
-        # the seq advances at ENQUEUE (later pipelined launches must
-        # ship strictly increasing seqs); the core's applied position
-        # advances only at resolve, in settle order
-        self._grp_seq = seq
-        fl.grp_seq = seq
-        fl.grp_sends = sends
-        return fl
-
-    def _launch_resolve(self, fl, wait_key="device_d2h"):
-        """Replicated RESOLVE half: finish the local launch, then
-        stash the flush's replication tickets as a pending entry for
-        the PIPELINED commit barrier (VERDICT r4 weak #5): the acks
-        are NOT awaited here.  The flush's client futures resolve only
-        once its host-quorum outcome is known (_settle_entry — the
-        per-flush barrier stands), but the NEXT flush's build, ship
-        and local launch overlap this one's ack wait, so replication
-        throughput is bounded by the replica apply pipeline, not by
-        RTT + apply per flush.  _resolve_flush claims this entry and
-        attaches the futures/planes; heartbeat()-style direct
-        launches leave taken=None (nothing to resolve)."""
-        sends = getattr(fl, "grp_sends", None)
-        if sends is None:
-            # single-lane mode / replica role: the plain resolve
-            return super()._launch_resolve(fl, wait_key)
-        try:
-            out = super()._launch_resolve(fl, wait_key)
-        except BaseException:
-            # replicas already applied a seq our rolled-back local
-            # state never kept — re-sync before they count again
-            for link in self._links:
-                link.needs_sync = True
-            raise
-        committed, _g, _f, _v, vsn = out
-        crc = result_crc(committed, vsn)
-        self.core.applied_ge = self._ge
-        self.core.applied_seq = fl.grp_seq
-        self.core.last_crc = crc
-        entry = _PendingFlush(fl.grp_seq, crc, sends,
-                              time.monotonic() + self.ack_timeout,
-                              shipped_at=fl.now)
-        self._pending_flushes.append(entry)
-        self._unclaimed = entry
-        self.group_stats["applies"] += 1
-        # Group meta persists via _wal_extra_records inside the flush's
-        # own durability barrier (one sync, and atomically with the kv
-        # records — a leader restart must never see data-bearing kv
-        # records from a seq its meta doesn't cover, or takeover could
-        # adopt an older replica state over its own acked writes).
-        # Data-less launches (heartbeats, pure reads) skip it: adopting
-        # a state that differs only by empty batches loses nothing.
-        return out
+            # a needs_sync link joins the batch as soon as the batch
+            # starts PAST its queued catch-up (install_barrier <=
+            # first_seq): the link's FIFO delivers the install/patch
+            # first, the replica lands exactly at first_seq - 1, and
+            # the batch applies cleanly — its ack becomes countable
+            # the moment the settle consumes the install ticket (the
+            # ADVICE r5 adjacency, kept under coalescing).  The ship
+            # that QUEUED the catch-up must exclude it (that batch's
+            # seqs are already inside the snapshot — sending both
+            # would read as a diverged retransmit and loop the
+            # re-sync); so must ships while a probe is still running
+            # (the tree diff needs the replica frozen).
+            if not link.needs_sync \
+                    or (link.install_ticket is not None
+                        and link.install_barrier <= first_seq):
+                batch.sends.append(
+                    (link, link.post(enc, on_done=batch._notify)))
+            elif not link.connected and link.install_ticket is None \
+                    and link.sync is None:
+                # a dropped link reconnects by CONSUMING a queued
+                # frame (the sender thread owns the socket); excluded
+                # from the batch, it still needs a nudge or it would
+                # never dial back in — the cheap handshake serves
+                # (its response is consumed FIFO and ignored)
+                link.post(("hello", self._ge))
+        self._pending_flushes.append(batch)
 
     def _settle_execute(self, fl, planes):
         """Bulk execute_async resolves directly to its caller (no
@@ -2017,51 +2695,97 @@ class ReplicatedService(BatchedEnsembleService):
         self._drain_pending(down_to=self.repl_window)
         return 0
 
+    def _outstanding(self) -> int:
+        return (sum(len(b.entries) for b in self._pending_flushes)
+                + len(self._ship_buf))
+
     def _drain_pending(self, block_all: bool = False,
                        down_to: Optional[int] = None) -> None:
-        """Settle pending flushes oldest-first.  Non-blocking by
-        default (an entry settles once every ticket completed or its
-        deadline passed); ``down_to=N`` blocks only until at most N
-        entries remain (the steady-state ship path — draining to empty
-        would collapse the very window the pipeline provides);
-        ``block_all`` waits every entry out — used before a
+        """Ship anything buffered, then settle pending batches
+        oldest-first.  Non-blocking by default (a batch settles once
+        a majority acked, every ticket completed, or its deadline
+        passed); ``down_to=N`` blocks only until at most N flush
+        entries remain outstanding (the steady-state ship path —
+        draining to empty would collapse the very window the pipeline
+        provides); ``block_all`` waits every batch out — used before a
         checkpoint/takeover/lifecycle op and by idle flushes so
         flush-until-done callers observe resolved futures."""
+        self._reap_stragglers()
+        if block_all or down_to is None \
+                or self._outstanding() > down_to:
+            self._ship_now()
         while self._pending_flushes:
-            entry = self._pending_flushes[0]
-            done = all(t.event.is_set() for _l, t in entry.sends)
+            batch = self._pending_flushes[0]
+            done = all(t.event.is_set() for _l, t in batch.sends)
             if not done:
                 must_free = (down_to is not None
-                             and len(self._pending_flushes) > down_to)
-                if not (block_all or must_free) \
-                        and time.monotonic() < entry.deadline:
+                             and self._outstanding() > down_to)
+                if block_all or must_free:
+                    batch.wait_quorum(self._quorum_from)
+                elif not self._quorum_from(batch._acked_now()) \
+                        and time.monotonic() < batch.deadline:
                     break
-                for _l, t in entry.sends:
-                    t.event.wait(max(0.0,
-                                     entry.deadline - time.monotonic()))
             self._pending_flushes.popleft()
-            self._settle_entry(entry)
+            self._settle_batch(batch)
 
-    def _settle_entry(self, entry: "_PendingFlush") -> None:
-        """Count one flush's acks, decide its host-quorum outcome, and
-        resolve its client futures accordingly."""
+    def _account_ack(self, link: PeerLink, r: Any, crc: int,
+                     acked: set) -> None:
+        """Bookkeep one link's cumulative-ack outcome."""
+        if r is None:
+            link.needs_sync = True
+        elif r[0] == "applied" and int(r[3]) == crc \
+                and not link.needs_sync:
+            acked.add((link.host, link.port))
+        elif r[0] == "applied":
+            # applied but diverged (CRC mismatch): physical
+            # corruption or a missed batch — heal via re-sync
+            link.needs_sync = True
+        elif r[0] == "nack" and r[1] == "epoch":
+            # Depose ONLY when the replica promised a genuinely
+            # newer epoch.  A LOWER promised (a blank replacement
+            # host, or one whose meta was lost) is merely stale —
+            # deposing on it would let a dead disk take down a
+            # healthy majority leader (review r4).  It re-syncs
+            # instead (install raises its promise).
+            if int(r[2]) > self._ge:
+                self._note_depose(int(r[2]))
+            link.needs_sync = True
+        else:
+            link.needs_sync = True
+
+    def _reap_stragglers(self) -> None:
+        """Bookkeep tickets of batches that settled at majority
+        before every link answered: a late nack still marks its link
+        for re-sync (and a late epoch nack still deposes) — nothing a
+        slow socket reports is ever dropped, it just stops holding
+        the settled batch's futures hostage."""
+        if not self._stragglers:
+            return
+        still = []
+        sink: set = set()
+        for link, t, crc in self._stragglers:
+            if not t.event.is_set():
+                still.append((link, t, crc))
+                continue
+            self._account_ack(link, t.result, crc, sink)
+        self._stragglers = still
+
+    def _settle_batch(self, batch: "_PendingShip") -> None:
+        """Count one batch's cumulative acks, decide its host-quorum
+        outcome, and resolve every member entry's client futures
+        accordingly (the per-flush barrier stands — the batch is the
+        unit of ack, the entry stays the unit of resolution)."""
         acked = set()
-        for link, apply_t in entry.sends:
-            # a catch-up that completed AHEAD of this apply in the
-            # link's FIFO makes the replica's ack countable NOW — the
-            # replica applied this very frame on the freshly-installed
-            # state (consuming the ticket only at the next flush
-            # preamble would fail the first post-install flush's
-            # quorum for no reason).  Only installs queued ahead of
-            # THIS entry or earlier (install_barrier <= entry.seq)
-            # are consumable: an install posted by a LATER flush must
-            # stay pending for the settle that can actually observe
-            # its effect (ADVICE r5 — consuming it here would clear
-            # needs_sync early, and this entry's own nack would then
-            # discount the next entry's legitimate ack).
+        for link, apply_t in batch.sends:
+            # a catch-up that completed BEFORE this settle makes the
+            # link countable for LATER batches — consumable only when
+            # it was queued ahead of this batch or earlier
+            # (install_barrier <= first_seq): an install posted by a
+            # LATER ship must stay pending for the settle that can
+            # actually observe its effect (ADVICE r5)
             inst_t = link.install_ticket
             if inst_t is not None and inst_t.event.is_set() \
-                    and link.install_barrier <= entry.seq:
+                    and link.install_barrier <= batch.first_seq:
                 ri = inst_t.result
                 link.install_ticket = None
                 if ri is not None and ri[0] == "installed":
@@ -2070,55 +2794,45 @@ class ReplicatedService(BatchedEnsembleService):
                 elif ri is not None and ri[0] == "nack" \
                         and int(ri[2]) > self._ge:
                     self._note_depose(int(ri[2]))
-            r = apply_t.result if apply_t.event.is_set() else None
-            if r is None:
-                link.needs_sync = True
+            if not apply_t.event.is_set():
+                # quorum settled without this link: its outcome is
+                # bookkept when it lands (or its connection drops) —
+                # a slow socket must not hold every future to the
+                # deadline (max-of-links, not sum-of-slow-prefix)
+                self._stragglers.append((link, apply_t, batch.crc))
                 continue
-            if r[0] == "applied" and int(r[3]) == entry.crc \
-                    and not link.needs_sync:
-                acked.add((link.host, link.port))
-            elif r[0] == "applied":
-                # applied but diverged (CRC mismatch): physical
-                # corruption or a missed batch — heal via re-sync
-                link.needs_sync = True
-            elif r[0] == "nack" and r[1] == "epoch":
-                # Depose ONLY when the replica promised a genuinely
-                # newer epoch.  A LOWER promised (a blank replacement
-                # host, or one whose meta was lost) is merely stale —
-                # deposing on it would let a dead disk take down a
-                # healthy majority leader (review r4).  It re-syncs
-                # instead (install raises its promise).
-                if int(r[2]) > self._ge:
-                    self._note_depose(int(r[2]))
-                link.needs_sync = True
-            else:
-                link.needs_sync = True
+            self._account_ack(link, apply_t.result, batch.crc, acked)
         q = self._quorum_from(acked) and not self._deposed
         self._last_quorum_ok = q
         # the HOST lease for leader-local fast reads: only a settle
         # whose host quorum confirmed this epoch renews it, and a
-        # lost quorum revokes it BEFORE any of this flush's futures
+        # lost quorum revokes it BEFORE any of this batch's futures
         # resolve (the mirror updates below run under ack_reads=False
         # then — a minority leader serves nothing).  The grant is
-        # based at the flush's SHIP time, not settle-processing time
-        # (mirroring the device lane's fl.now discipline): the quorum
-        # contact the acks prove is no fresher than the ship, and a
-        # promoter waiting out lease() counts from the fencing — a
-        # settle delayed in the pipeline must not stretch the leased
-        # window past what those acks can vouch for.  max() keeps a
-        # later-shipped flush's settle from shrinking an earlier
-        # grant (settles process in FIFO ship order anyway).
+        # based at the batch's newest enqueue time, not settle-
+        # processing time (mirroring the device lane's fl.now
+        # discipline): the quorum contact the acks prove is no
+        # fresher than the ship, and a promoter waiting out lease()
+        # counts from the fencing — a settle delayed in the pipeline
+        # must not stretch the leased window past what those acks can
+        # vouch for.  max() keeps a later-shipped batch's settle from
+        # shrinking an earlier grant (settles process in FIFO ship
+        # order anyway).
         if q:
             self._host_lease_until = max(
                 self._host_lease_until,
-                entry.shipped_at + self.config.lease())
+                batch.shipped_at + self.config.lease())
+            self.group_stats["repl_ack_s"] += \
+                time.monotonic() - batch.ship_t
+            self.group_stats["repl_acked_batches"] += 1
         else:
             self._host_lease_until = 0.0
             self.group_stats["quorum_failures"] += 1
-        if entry.taken is not None:
-            super()._resolve_flush(entry.taken, entry.planes,
-                                   ack=entry.ack and q,
-                                   ack_reads=entry.ack_reads and q)
+        for entry in batch.entries:
+            if entry.taken is not None:
+                super()._resolve_flush(entry.taken, entry.planes,
+                                       ack=entry.ack and q,
+                                       ack_reads=entry.ack_reads and q)
 
     def flush(self) -> int:
         served = super().flush()
@@ -2259,8 +2973,7 @@ class ReplicatedService(BatchedEnsembleService):
         if not applied:
             return results
         lead = self._install_lead(int(ens))
-        crc = zlib.crc32(repr([(a[1], a[2], a[3], a[4])
-                               for a in applied]).encode())
+        crc = record_digest((a[1], a[2], a[3], a[4]) for a in applied)
         seq = self._grp_seq + 1
         self._grp_seq = seq
         self.core.applied_ge = self._ge
@@ -2290,7 +3003,8 @@ class ReplicatedService(BatchedEnsembleService):
             "peers_connected": sum(l.connected for l in self._links),
             "peers_synced": sum(not l.needs_sync for l in self._links),
             "repl_window": self.repl_window,
-            "pipeline_pending": len(self._pending_flushes),
+            "pipeline_pending": self._outstanding(),
+            "repl_delta": self._repl_delta and self._delta_shape_ok,
             "trust_host_lease": self.trust_host_lease,
             "host_lease_valid": bool(
                 self._host_lease_until
@@ -2351,6 +3065,7 @@ class ReplicaServer:
                 trust_host_lease=trust_host_lease)
         self.core = self.svc.core
         warmup_kernels(self.svc)
+        warm_delta_apply(self.svc)
         self.tick = tick
         self._lock = threading.RLock()
         self._stop = False
@@ -2447,7 +3162,7 @@ class ReplicaServer:
 
     def _handle_repl(self, frame: Tuple) -> Tuple:
         op = frame[0]
-        if op in ("hello", "apply", "install", "lcl", "cfg",
+        if op in ("hello", "apply", "abatch", "install", "lcl", "cfg",
                   "tpatch", "inst"):
             # leader-originated traffic: the failover monitor's
             # liveness signal
@@ -2469,7 +3184,7 @@ class ReplicaServer:
                 # become leader — don't campaign over it
                 self._last_leader_contact = time.monotonic()
             return self.core.handle_promise(ge)
-        if op == "apply":
+        if op in ("apply", "abatch"):
             if self._campaign:
                 # a campaign is installing/pulling state concurrently;
                 # the leader treats this like any missed ack (re-sync)
@@ -2480,6 +3195,8 @@ class ReplicaServer:
                 # at an older epoch it is nacked by the core
                 if int(frame[1]) > self.core.promised:
                     self._step_down()
+            if op == "abatch":
+                return self.core.handle_abatch(frame)
             return self.core.handle_apply(frame)
         if op == "lcl":
             if self._campaign:
@@ -2697,6 +3414,7 @@ class ReplicaServer:
             try:
                 with self._lock:
                     if self.svc._active or self.svc._pending_flushes \
+                            or self.svc._ship_buf \
                             or self.svc._election_inputs()[0].any():
                         self.svc.flush()
                         last_beat = time.monotonic()

@@ -18,22 +18,60 @@ in declaration order.
 Anything not encodable (actor refs, futures, closures) raises
 :class:`WireError` at *encode* time — the transport drops such frames
 as local-only, exactly as it did for unpicklable values.
+
+Raw-buffer section (the zero-copy bulk lane): a frame whose value
+contains :class:`Raw` wrappers encodes via :func:`encode_parts` into a
+``'B'``-tagged payload — a buffer-length table, the term section (in
+which each Raw leaf is a 1-byte-tagged index reference), then the raw
+bytes themselves, UNCOPIED: ``encode_parts`` returns the header plus
+the callers' own buffers for a scatter-gather write, so a bulk numpy
+plane rides after the term codec without ever being concatenated into
+an intermediate bytes.  :func:`decode` resolves the references to
+memoryview slices of the received payload (no second copy on the
+receive side either).  The allowlist property is unchanged — a buffer
+decodes to plain read-only memory, never code.
 """
 
 from __future__ import annotations
 
 import struct
-from typing import Any, Callable, Dict, List, Tuple
+from typing import Any, Callable, Dict, List, Sequence, Tuple
 
 from riak_ensemble_tpu.state import ClusterState
 from riak_ensemble_tpu.types import (EnsembleInfo, Fact, NOTFOUND, Obj,
                                      PeerId)
 
-__all__ = ["encode", "decode", "WireError"]
+__all__ = ["encode", "decode", "encode_parts", "Raw", "WireError"]
 
 
 class WireError(Exception):
     """Value outside the wire allowlist, or a malformed frame."""
+
+
+class Raw:
+    """Zero-copy bulk-buffer wrapper for :func:`encode_parts`.
+
+    Wraps anything exposing the buffer protocol (bytes, memoryview, a
+    C-contiguous numpy array's ``.data``).  Inside a frame value a Raw
+    encodes as a small index reference; the buffer itself rides after
+    the term section, handed back verbatim by ``encode_parts`` so the
+    sender can scatter-gather it onto the socket without a copy.  On
+    decode the reference resolves to a read-only memoryview slice of
+    the received payload."""
+
+    __slots__ = ("buf",)
+
+    def __init__(self, buf) -> None:
+        m = memoryview(buf)
+        if not m.contiguous:
+            raise WireError("Raw buffer must be contiguous")
+        if m.nbytes == 0:
+            # cast("B") rejects zero-in-shape views; an empty buffer
+            # is just the empty byte run
+            self.buf = memoryview(b"")
+        else:
+            self.buf = (m.cast("B") if m.format != "B" or m.ndim != 1
+                        else m)
 
 
 _F64 = struct.Struct(">d")
@@ -72,10 +110,19 @@ def _put_uvarint(out: List[bytes], n: int) -> None:
             return
 
 
-def _encode(out: List[bytes], v: Any, depth: int = 0) -> None:
+def _encode(out: List[bytes], v: Any, depth: int = 0,
+            bufs: "List[memoryview]" = None) -> None:
     if depth > _MAX_DEPTH:
         raise WireError("value too deeply nested")
     t = type(v)
+    if t is Raw:
+        if bufs is None:
+            raise WireError(
+                "Raw buffers need encode_parts (raw-frame encoding)")
+        out.append(b"r")
+        _put_uvarint(out, len(bufs))
+        bufs.append(v.buf)
+        return
     if v is None:
         out.append(b"N")
     elif t is bool:
@@ -100,13 +147,13 @@ def _encode(out: List[bytes], v: Any, depth: int = 0) -> None:
         out.append({tuple: b"t", list: b"l", set: b"e", frozenset: b"z"}[t])
         _put_uvarint(out, len(v))
         for item in v:
-            _encode(out, item, depth + 1)
+            _encode(out, item, depth + 1, bufs)
     elif t is dict:
         out.append(b"d")
         _put_uvarint(out, len(v))
         for k, val in v.items():
-            _encode(out, k, depth + 1)
-            _encode(out, val, depth + 1)
+            _encode(out, k, depth + 1, bufs)
+            _encode(out, val, depth + 1, bufs)
     elif v is NOTFOUND:
         out.append(b"0")
     elif t in _RECORD_BY_CLS:
@@ -114,7 +161,7 @@ def _encode(out: List[bytes], v: Any, depth: int = 0) -> None:
         out.append(b"R")
         _put_uvarint(out, code)
         for name in fields:
-            _encode(out, getattr(v, name), depth + 1)
+            _encode(out, getattr(v, name), depth + 1, bufs)
     else:
         raise WireError(f"type {t.__name__} is not wire-encodable")
 
@@ -139,6 +186,30 @@ def encode(v: Any) -> bytes:
     if native is not None:
         return native.encode(v)
     return encode_py(v)
+
+
+def encode_parts(v: Any) -> List[Any]:
+    """Serialize a value that may contain :class:`Raw` buffers into a
+    raw frame, WITHOUT copying the buffers.
+
+    Returns ``[header, buf0, buf1, ...]``: the header bytes (the
+    ``'B'`` tag, the buffer-length table and the term section) followed
+    by the wrapped buffers in reference order.  The frame payload on
+    the wire is the plain concatenation of the parts — hand the list to
+    a scatter-gather send (``socket.sendmsg``) and the bulk planes go
+    from their owning arrays straight to the kernel.  A value with no
+    Raw leaves still encodes as a (bufferless) raw frame, so callers
+    need not special-case.  The term walk runs in Python — it is the
+    SMALL section by design; the native codec's role on this path is
+    the decode side."""
+    out: List[bytes] = []
+    bufs: List[memoryview] = []
+    _encode(out, v, 0, bufs)
+    head: List[bytes] = [b"B"]
+    _put_uvarint(head, len(bufs))
+    for b in bufs:
+        _put_uvarint(head, b.nbytes)
+    return [b"".join(head + out)] + bufs
 
 
 class _Reader:
@@ -169,10 +240,16 @@ class _Reader:
                 raise WireError("varint too long")
 
 
-def _decode(r: _Reader, depth: int) -> Any:
+def _decode(r: _Reader, depth: int,
+            bufs: "List[memoryview]" = None) -> Any:
     if depth > _MAX_DEPTH:
         raise WireError("frame too deep")
     tag = r.take(1)
+    if tag == b"r":
+        idx = r.uvarint()
+        if bufs is None or idx >= len(bufs):
+            raise WireError(f"buffer ref {idx} outside raw frame")
+        return bufs[idx]
     if tag == b"N":
         return None
     if tag == b"T":
@@ -192,7 +269,7 @@ def _decode(r: _Reader, depth: int) -> Any:
         return r.take(r.uvarint())
     if tag in (b"t", b"l", b"e", b"z"):
         n = r.uvarint()
-        items = [_decode(r, depth + 1) for _ in range(n)]
+        items = [_decode(r, depth + 1, bufs) for _ in range(n)]
         if tag == b"t":
             return tuple(items)
         if tag == b"l":
@@ -206,7 +283,8 @@ def _decode(r: _Reader, depth: int) -> Any:
     if tag == b"d":
         n = r.uvarint()
         try:
-            return {_decode(r, depth + 1): _decode(r, depth + 1)
+            return {_decode(r, depth + 1, bufs):
+                    _decode(r, depth + 1, bufs)
                     for _ in range(n)}
         except TypeError as exc:
             raise WireError(f"unhashable dict key: {exc}") from None
@@ -217,13 +295,39 @@ def _decode(r: _Reader, depth: int) -> Any:
         if code >= len(_RECORDS):
             raise WireError(f"unknown record code {code}")
         cls, fields = _RECORDS[code]
-        vals = [_decode(r, depth + 1) for _ in fields]
+        vals = [_decode(r, depth + 1, bufs) for _ in fields]
         return cls(**dict(zip(fields, vals)))
     raise WireError(f"unknown tag {tag!r}")
 
 
+def _decode_raw_frame(payload: bytes) -> Any:
+    """Decode a ``'B'``-tagged raw frame: length table, term section,
+    then the raw bytes — resolved as read-only memoryview slices of
+    ``payload`` (the receive-side zero-copy half)."""
+    mv = memoryview(payload)
+    r = _Reader(payload)
+    r.take(1)  # the 'B' tag
+    nbufs = r.uvarint()
+    lens = [r.uvarint() for _ in range(nbufs)]
+    total = sum(lens)
+    data_start = len(payload) - total
+    if data_start < r.pos:
+        raise WireError("raw-buffer table exceeds frame")
+    bufs: List[memoryview] = []
+    off = data_start
+    for n in lens:
+        bufs.append(mv[off:off + n])
+        off += n
+    v = _decode(r, 0, bufs)
+    if r.pos != data_start:
+        raise WireError("trailing bytes in frame")
+    return v
+
+
 def decode_py(payload: bytes) -> Any:
     """Pure-Python decoder (fallback + differential-test oracle)."""
+    if payload[:1] == b"B":
+        return _decode_raw_frame(payload)
     r = _Reader(payload)
     v = _decode(r, 0)
     if r.pos != len(payload):

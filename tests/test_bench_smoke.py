@@ -85,3 +85,21 @@ def test_mixed_tail_attribution_smoke():
         assert out["mixed_tail_top_cause"] in causes
     else:
         assert out["mixed_tail_top_cause"] is None
+
+
+def test_repgroup_rung_smoke():
+    """The delta-replication regression tripwire (ARCHITECTURE §10):
+    at the smoke shape (in-process replica hosts, skewed write set)
+    the apply stream must (a) leave every replica lane bit-equal to
+    the leader's — delta/full equivalence — and (b) ship under 25% of
+    the full-plane figure per entry, so a change that silently
+    re-inflates the stream (delta path bypassed, sections widened,
+    fallback over-triggering) fails tier-1 here.  baseline off: the
+    smoke pins the contract, not the speedup (that's round time's
+    RETPU_REPL_DELTA=0 A/B arm)."""
+    out = bench.run_repgroup(1.0, smoke=True, baseline=False)
+    assert out["repgroup_ops_per_sec"] > 0
+    assert out["repl_equivalence_ok"] is True, out
+    assert out["repl_delta_entries"] > 0
+    assert (out["repl_bytes_per_entry"]
+            < 0.25 * out["repl_bytes_per_entry_full_plane"]), out

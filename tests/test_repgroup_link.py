@@ -196,3 +196,52 @@ def test_handle_tpatch_rejects_mode_mismatch_before_patches():
     assert (core.applied_ge, core.applied_seq) == (0, 0)
     leader.stop()
     replica.stop()
+
+
+def test_ticket_on_done_fires_on_response_and_on_drop():
+    """Round 7's shared-condition ack gather hangs off _Ticket.on_done
+    — it must fire BOTH when a response pairs and when a connection
+    drop fails the outstanding tickets (result None), or a batch
+    settle could sleep to its deadline waiting on a dead link."""
+    link = _make_link()
+    gen = link._gen
+    fired = []
+    t_ok = repgroup._Ticket(on_done=lambda: fired.append("ok"))
+    t_drop = repgroup._Ticket(on_done=lambda: fired.append("drop"))
+    with link._alock:
+        link._awaiting.append(t_ok)
+        link._awaiting.append(t_drop)
+    sock = _FakeSock([
+        _frame_bytes(("applied", 1, 7, 123)),
+        ConnectionError("closed"),
+    ])
+    link._recv_loop(sock, gen)
+    assert t_ok.event.is_set() and t_ok.result == ("applied", 1, 7, 123)
+    assert t_drop.event.is_set() and t_drop.result is None
+    assert fired == ["ok", "drop"]
+    link.close()
+
+
+def test_ticket_on_done_exception_does_not_break_pairing():
+    """A hook that raises must not tear the receive loop (later
+    tickets still pair) — _fire swallows it."""
+    link = _make_link()
+    gen = link._gen
+
+    def boom():
+        raise RuntimeError("hook bug")
+
+    t1 = repgroup._Ticket(on_done=boom)
+    t2 = repgroup._Ticket()
+    with link._alock:
+        link._awaiting.append(t1)
+        link._awaiting.append(t2)
+    sock = _FakeSock([
+        _frame_bytes(("applied", 1, 1, 1)),
+        _frame_bytes(("applied", 1, 2, 2)),
+        ConnectionError("closed"),
+    ])
+    link._recv_loop(sock, gen)
+    assert t1.result == ("applied", 1, 1, 1)
+    assert t2.result == ("applied", 1, 2, 2)
+    link.close()
