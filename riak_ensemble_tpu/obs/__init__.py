@@ -1,0 +1,73 @@
+"""Unified observability plane (round 6).
+
+SURVEY §5 marks real tracing as the reference's gap to fill —
+riak_ensemble ships only compiled-out ``?OUT`` macros and the
+get_info/count_quorum introspection calls.  Before this round the
+scale path answered that gap piecemeal: ``stats()`` dicts hand-built
+per service, ``perf_counter()`` pairs scattered through the flush
+path, and bench-side one-off attribution that could not be asked
+anything after the run.  This package is the single plane the whole
+stack reports into:
+
+- :mod:`.registry` — a low-overhead metrics registry (counters,
+  gauges, fixed-bucket histograms with O(log B) record; a label
+  dimension for per-tenant attribution), exported as plain JSON and
+  Prometheus text format (svcnode's ``metrics`` verb).
+- :mod:`.spans` — the monotonic per-process ``flush_id`` allocator
+  and a bounded store of per-flush span timelines.  Every launch is
+  stamped at enqueue; the id rides the replication wire (a
+  trailing field of each ``abatch`` entry), so leader-side
+  enqueue/step/d2h/unpack/WAL/delta-build spans and replica-side
+  validate/scatter/rebuild/WAL spans join into ONE causal timeline
+  per flush (the Dapper propagation model, scoped to the flush).
+- :mod:`.flightrec` — a flight recorder: bounded ring of complete
+  per-flush records (marks, batch shape, active-set occupancy,
+  payload bytes, queue depths) with an anomaly trigger — any flush
+  slower than ``trigger_ratio`` × the rolling p50 snapshots the ring
+  plus a box fingerprint to a dump file, so the next mixed-rung
+  anomaly is diagnosable instead of a shrug.
+- :mod:`.fingerprint` — the box fingerprint (cpu count, loadavg,
+  jax/jaxlib versions, ``RETPU_*`` knobs) every flight dump and every
+  bench JSON embeds, so cross-round comparisons stop being faith.
+
+Knobs: ``RETPU_OBS=0`` disables hot-path recording (instruments stay
+constructed; record calls short-circuit — the bench's A/B arm);
+``RETPU_OBS_DUMP_DIR`` directs flight-recorder dumps (unset keeps
+them in memory only).  Stores are PER PROCESS: in-process replica
+servers share the span store with their leader, subprocess replicas
+export their half through their own ``metrics``/dump surface and the
+join happens on ``flush_id``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from riak_ensemble_tpu.obs.fingerprint import box_fingerprint
+from riak_ensemble_tpu.obs.flightrec import FlightRecorder
+from riak_ensemble_tpu.obs.registry import (Counter, Gauge, Histogram,
+                                            MetricsRegistry,
+                                            MS_BUCKETS)
+from riak_ensemble_tpu.obs.spans import (SPANS, SpanStore,
+                                         next_flush_id, timeline)
+
+__all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "MS_BUCKETS", "FlightRecorder", "SpanStore", "SPANS",
+           "next_flush_id", "timeline", "box_fingerprint", "enabled",
+           "dump_dir"]
+
+
+def enabled() -> bool:
+    """Whether hot-path recording is on (``RETPU_OBS=0`` opts out).
+
+    Read the environment each call — services CACHE the answer at
+    construction (one attribute test per flush beats an environ
+    lookup), so an A/B arm flips the knob and builds a fresh
+    service."""
+    return os.environ.get("RETPU_OBS", "1") != "0"
+
+
+def dump_dir():
+    """Flight-recorder dump directory (``RETPU_OBS_DUMP_DIR``); None
+    keeps anomaly snapshots in memory only."""
+    return os.environ.get("RETPU_OBS_DUMP_DIR") or None

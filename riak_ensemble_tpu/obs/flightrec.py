@@ -1,0 +1,158 @@
+"""Flight recorder: a bounded ring of complete per-flush records with
+an anomaly trigger.
+
+Every settled launch appends one record — its latency marks, flush
+id, batch shape, active-set occupancy, payload bytes and queue
+depths.  The ring answers "what were the last N flushes doing" at any
+moment; the TRIGGER makes it useful after the fact: any flush slower
+than ``trigger_ratio`` × the rolling p50 (default 5×, over the last
+``window`` records, armed only past ``min_samples``) snapshots the
+whole ring plus a box fingerprint.  With ``RETPU_OBS_DUMP_DIR`` set
+the snapshot is also written to a JSON dump file (atomic rename);
+either way it is retained in memory (``dumps``, bounded).
+
+This is what turns the next mixed-rung anomaly (r4→r5: −32% ops/s,
+p99 11×, cause never established) from a shrug into a diagnosis: the
+dump names the slow flush's dominating mark, shows the flushes
+around it, and pins the box state it happened on.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from riak_ensemble_tpu.obs.fingerprint import box_fingerprint
+
+__all__ = ["FlightRecorder", "DUMP_SCHEMA", "META_FIELDS"]
+
+DUMP_SCHEMA = "retpu-flight-dump-v1"
+
+#: per-flush record fields that are shape/identity metadata, not
+#: latency marks — shared with bench's tail attribution so the two
+#: dominant-mark argmaxes can never drift apart
+META_FIELDS = ("k", "total", "enqueue", "flush_id", "t", "a_width",
+               "payload_bytes", "queued_rounds", "in_flight")
+
+
+class FlightRecorder:
+    """Per-service flush ring + anomaly dumps.
+
+    ``record`` cost: one deque append, one p50-cache check (the p50
+    itself is recomputed every ``refresh_every`` records over a
+    bounded window — never per record), one comparison.
+    """
+
+    def __init__(self, capacity: int = 256, window: int = 128,
+                 trigger_ratio: float = 5.0, min_samples: int = 32,
+                 refresh_every: int = 16,
+                 min_dump_interval_s: float = 5.0,
+                 max_dumps: int = 8,
+                 dump_dir: Optional[str] = None,
+                 name: str = "svc") -> None:
+        self.records: "deque[Dict[str, Any]]" = deque(maxlen=capacity)
+        self.trigger_ratio = float(trigger_ratio)
+        self.min_samples = int(min_samples)
+        self.refresh_every = int(refresh_every)
+        self.min_dump_interval_s = float(min_dump_interval_s)
+        self.name = name
+        self._dump_dir = dump_dir
+        self._totals: "deque[float]" = deque(maxlen=window)
+        self._p50 = 0.0
+        self._since_refresh = 0
+        #: anomaly observability: trigger count and the retained
+        #: snapshots (bounded; a pathological box must not hoard
+        #: rings), newest last
+        self.anomalies = 0
+        self.dumps: "deque[Dict[str, Any]]" = deque(maxlen=max_dumps)
+        self._last_dump_t = -1e9
+
+    def dump_dir(self) -> Optional[str]:
+        if self._dump_dir is not None:
+            return self._dump_dir
+        return os.environ.get("RETPU_OBS_DUMP_DIR") or None
+
+    def record(self, rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Append one per-flush record (must carry ``total`` seconds;
+        ``flush_id`` and the marks ride along verbatim).  Returns the
+        anomaly snapshot if this flush tripped the trigger, else
+        None."""
+        total = float(rec.get("total", 0.0))
+        self.records.append(rec)
+        armed = (len(self._totals) >= self.min_samples
+                 and self._p50 > 0.0
+                 and total > self.trigger_ratio * self._p50)
+        # the slow flush itself joins the window AFTER the check, so
+        # a burst of slow flushes keeps triggering against the
+        # healthy baseline instead of normalizing itself away within
+        # one refresh period
+        self._totals.append(total)
+        self._since_refresh += 1
+        if self._since_refresh >= self.refresh_every or not self._p50:
+            self._since_refresh = 0
+            s = sorted(self._totals)
+            self._p50 = s[len(s) // 2] if s else 0.0
+        if not armed:
+            return None
+        # count EVERY trigger firing (the anomaly metric's contract);
+        # the rate limit below only bounds how often a firing also
+        # snapshots the ring — during a sustained incident the
+        # counter keeps telling the truth while dumps stay bounded
+        self.anomalies += 1
+        now = time.monotonic()
+        if now - self._last_dump_t < self.min_dump_interval_s:
+            return None
+        self._last_dump_t = now
+        return self._dump(rec, total)
+
+    def _dump(self, rec: Dict[str, Any],
+              total: float) -> Dict[str, Any]:
+        marks = {k: v for k, v in rec.items()
+                 if isinstance(v, (int, float))}
+        cause = max((k for k in marks if k not in META_FIELDS),
+                    key=lambda k: marks[k], default=None)
+        snap = {
+            "schema": DUMP_SCHEMA,
+            "name": self.name,
+            "t_unix": time.time(),
+            "trigger": {
+                "flush_id": rec.get("flush_id"),
+                "total_s": total,
+                "rolling_p50_s": self._p50,
+                "ratio": round(total / self._p50, 2),
+                "threshold": self.trigger_ratio,
+                "dominant_mark": cause,
+            },
+            "ring": [dict(r) for r in self.records],
+            "box": box_fingerprint(),
+        }
+        self.dumps.append(snap)
+        d = self.dump_dir()
+        if d:
+            try:
+                os.makedirs(d, exist_ok=True)
+                # pid in the name: leader and subprocess-replica
+                # services share dump dirs and restart their
+                # flush-id/anomaly ordinals, and a colliding name
+                # would os.replace the very evidence a dump preserves
+                path = os.path.join(
+                    d, f"flight_{self.name}_{os.getpid()}_"
+                       f"{rec.get('flush_id', 0)}_{self.anomalies}"
+                       ".json")
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(snap, f)
+                os.replace(tmp, path)  # atomic: a killed process
+                snap["path"] = path    # never leaves a torn dump
+            except OSError:
+                pass  # a full/readonly disk must not fail the flush
+        return snap
+
+    def marks_tail(self, n: int) -> List[Dict[str, Any]]:
+        """The newest ``n`` records (oldest first) — the bench's
+        tail-attribution source."""
+        recs = list(self.records)
+        return recs[-n:] if n else []

@@ -1,0 +1,100 @@
+"""Cross-process flush tracing: flush ids + the span store.
+
+Every device launch is stamped with a process-monotonic ``flush_id``
+at enqueue.  The id propagates through the two-phase launch pipeline
+(enqueue half → resolve half ride the same ``_InFlightLaunch``) and
+over the replication wire (a trailing field of every ``abatch``
+entry), so one id names the SAME flush on the leader and on every
+replica — the Dapper trace-id discipline, scoped to the flush (the
+unit of causality in this system: one flush = one device round = one
+replicated entry).
+
+The store is append-cheap and bounded: per flush id, a dict of
+``role -> [(span_name, seconds), ...]`` plus whatever shape metadata
+the recorder attached.  Roles are ``"leader"`` and ``"replica"``
+(replica spans carry the recording service's lane tag when several
+share the process).  :func:`timeline` answers the joined record —
+the obs API a test or bench asks "where did flush N's time go,
+end to end?".
+
+Per-process scope: in-process replica servers (tests, the bench
+smoke shape) share this store with their leader, so the join is
+immediate.  Subprocess replicas record into their own process's
+store; the leader's id still names their spans, and the join happens
+wherever both exports land.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["next_flush_id", "SpanStore", "SPANS", "timeline"]
+
+#: process-wide monotonic flush ids — shared by every service in the
+#: process so leader and in-process replica launches never collide
+_flush_ids = itertools.count(1)
+
+
+def next_flush_id() -> int:
+    return next(_flush_ids)
+
+
+class SpanStore:
+    """Bounded per-process store of per-flush span timelines."""
+
+    def __init__(self, max_flushes: int = 4096) -> None:
+        self.max_flushes = max_flushes
+        self._lock = threading.Lock()
+        self._flushes: "OrderedDict[int, Dict[str, Any]]" = OrderedDict()
+
+    def record(self, flush_id: int, role: str,
+               spans: List[Tuple[str, float]],
+               **info: Any) -> None:
+        """Append one side's spans for a flush.  ``spans`` is a list
+        of ``(name, seconds)``; ``info`` (batch shape, seq, lane, ...)
+        merges into the role's metadata.  Thread-safe: replica server
+        threads and the leader's flush loop share the store."""
+        if not flush_id:
+            return
+        with self._lock:
+            rec = self._flushes.get(flush_id)
+            if rec is None:
+                rec = self._flushes[flush_id] = {}
+                while len(self._flushes) > self.max_flushes:
+                    self._flushes.popitem(last=False)
+            side = rec.setdefault(role, {"spans": []})
+            side["spans"].extend(
+                (str(n), float(d)) for n, d in spans)
+            for k, v in info.items():
+                side[k] = v
+
+    def timeline(self, flush_id: int) -> Optional[Dict[str, Any]]:
+        """The joined per-flush record: ``{"flush_id": N, "leader":
+        {...}, "replica": {...}}`` with per-role span lists, or None
+        if the flush aged out of the ring (or never recorded)."""
+        with self._lock:
+            rec = self._flushes.get(flush_id)
+            if rec is None:
+                return None
+            out: Dict[str, Any] = {"flush_id": flush_id}
+            for role, side in rec.items():
+                out[role] = {"spans": list(side["spans"]),
+                             **{k: v for k, v in side.items()
+                                if k != "spans"}}
+            return out
+
+    def flush_ids(self) -> List[int]:
+        with self._lock:
+            return list(self._flushes)
+
+
+#: the process-global store every service records into
+SPANS = SpanStore()
+
+
+def timeline(flush_id: int) -> Optional[Dict[str, Any]]:
+    """Module-level convenience over the global store."""
+    return SPANS.timeline(flush_id)

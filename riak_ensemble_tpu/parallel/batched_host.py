@@ -86,6 +86,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from riak_ensemble_tpu import obs
 from riak_ensemble_tpu.config import Config
 from riak_ensemble_tpu.ops import engine as eng
 from riak_ensemble_tpu.runtime import Future, Runtime, Timer
@@ -510,6 +511,12 @@ class _InFlightLaunch:
     #: the round's quorum confirmations [E], stashed by the resolve
     #: half for subclass hooks (delta frames ship them)
     quorum_np: Any = None
+    #: observability plane: the launch's process-monotonic flush id
+    #: (stamped at enqueue, joins leader and replica spans — rides
+    #: the replication wire as each entry's trailing field) and the
+    #: packed d2h byte count the resolve half measured
+    flush_id: int = 0
+    payload_nbytes: int = 0
 
 
 class BatchedEnsembleService:
@@ -817,6 +824,34 @@ class BatchedEnsembleService:
                      "hash_format": hashk.HASH_FORMAT}, protocol=4))
             self._wal = ServiceWAL.open_gen(
                 data_dir, self._current_ckpt(data_dir), wal_sync)
+        #: unified observability plane (riak_ensemble_tpu.obs): a
+        #: per-service metrics registry + flight recorder, plus
+        #: per-tenant accounting vectorized over ensemble rows.
+        #: ``RETPU_OBS=0`` short-circuits every hot-path record; the
+        #: answer is cached here so the gate is one attribute test.
+        self._obs = obs.enabled()
+        self.obs_registry = obs.MetricsRegistry()
+        self.flight = obs.FlightRecorder(name="svc")
+        self._h_flush = self.obs_registry.histogram(
+            "retpu_flush_total_ms",
+            "settled launch wall time (all marks summed)")
+        #: per-tenant attribution planes [E] (a tenant is an ensemble
+        #: row; named tenants via _row_name / set_tenant_label):
+        #: keyed+fast-read ops, committed rounds, put payload bytes,
+        #: device launches the row was active in, and a fixed-bucket
+        #: latency histogram [E, B] (enqueue → resolve, ms).  All
+        #: updates are numpy fancy-index adds over the flush's active
+        #: rows — never per-op Python dicts.
+        self.tenant_ops = np.zeros((n_ens,), np.int64)
+        self.tenant_commits = np.zeros((n_ens,), np.int64)
+        self.tenant_bytes = np.zeros((n_ens,), np.int64)
+        self.tenant_rounds = np.zeros((n_ens,), np.int64)
+        self._tenant_lat = np.zeros(
+            (n_ens, len(obs.MS_BUCKETS) + 1), np.int64)
+        self._lat_edges = np.asarray(obs.MS_BUCKETS)
+        self._tenant_labels: Dict[int, Any] = {}
+        self._launches_total = 0
+        self._register_obs_metrics()
         self._schedule()
 
     # -- dynamic ensemble lifecycle ----------------------------------------
@@ -936,6 +971,14 @@ class BatchedEnsembleService:
         self._pending_mask[row] = False
         self.up[row] = True
         self._up_dev = None
+        # per-tenant attribution must not leak across row recycles —
+        # the new tenant starts with a clean ledger
+        self.tenant_ops[row] = 0
+        self.tenant_commits[row] = 0
+        self.tenant_bytes[row] = 0
+        self.tenant_rounds[row] = 0
+        self._tenant_lat[row] = 0
+        self._tenant_labels.pop(row, None)
 
     # -- client API --------------------------------------------------------
 
@@ -1176,7 +1219,7 @@ class BatchedEnsembleService:
                 reason, res = self._fast_read_result(ens, s, want_vsn)
             else:
                 reason, res = ens_reason, None
-            if self._count_fast(reason):
+            if self._count_fast(ens, reason):
                 fast_pos.append(i)
                 fast_res.append(res)
             else:
@@ -1782,7 +1825,7 @@ class BatchedEnsembleService:
             reason, res = self._fast_read_result(ens, slot, want_vsn)
         else:
             res = None
-        return self._count_fast(reason), res
+        return self._count_fast(ens, reason), res
 
     def _fast_read_result(self, ens: int, slot: int, want_vsn: bool
                           ) -> Tuple[Optional[str], Any]:
@@ -1814,7 +1857,7 @@ class BatchedEnsembleService:
             out = NOTFOUND
         return None, (("ok", out, vsn) if want_vsn else ("ok", out))
 
-    def _count_fast(self, reason: Optional[str]) -> bool:
+    def _count_fast(self, ens: int, reason: Optional[str]) -> bool:
         """Account one fast-path attempt; True = hit (serve now)."""
         if reason is None:
             self.read_fastpath_hits += 1
@@ -1822,6 +1865,15 @@ class BatchedEnsembleService:
             # throughput counter honest when 90% of traffic never
             # reaches a resolve path
             self.ops_served += 1
+            if self._obs:
+                # mirror-served reads are tenant ops too — without
+                # them a read-heavy tenant would look idle — and they
+                # contribute a lowest-bucket latency sample (a mirror
+                # hit is microseconds, far under the ladder's 50 µs
+                # floor), so a read-heavy tenant's p50/p99 reflects
+                # its real service time instead of reporting 0
+                self.tenant_ops[ens] += 1
+                self._tenant_lat[ens, 0] += 1
             return True
         self.read_fastpath_misses += 1
         r = self.read_fastpath_miss_reasons
@@ -2576,6 +2628,10 @@ class BatchedEnsembleService:
                     self._note_write(ens, s)
             else:
                 self._note_write(ens, op.slot)
+            if self._obs and op.kind in (eng.OP_PUT, eng.OP_CAS):
+                self._obs_note_put_bytes(
+                    ens, op.handle if isinstance(op, _PendingBatch)
+                    else (op.handle,))
         op.t_enq = time.perf_counter()
         self.queues[ens].append(op)
         self._queue_rounds[ens] += op.n
@@ -2666,7 +2722,13 @@ class BatchedEnsembleService:
         """
         fl = self._launch_enqueue(kind, slot, val, k, want_vsn, exp_e,
                                   exp_s, entries, elect, cand, lease_ok)
-        return self._launch_resolve(fl)
+        out = self._launch_resolve(fl)
+        if self._obs:
+            # synchronous launches (bulk execute, replica applies,
+            # heartbeats) settle here — their obs record must not
+            # depend on the pipelined settle path running
+            self._obs_flush_settled(fl)
+        return out
 
     def _step_fns(self) -> Tuple[Any, Any, Any, Any]:
         """The (full_step, full_step_wide, full_step_sliced,
@@ -2935,7 +2997,8 @@ class BatchedEnsembleService:
             state_snapshot=state_snapshot,
             leader_snapshot=leader_snapshot,
             lease_snapshot=lease_snapshot, donated=donated,
-            active=active, a_width=a_width, sliced=sliced)
+            active=active, a_width=a_width, sliced=sliced,
+            flush_id=obs.next_flush_id() if self._obs else 0)
 
     def _fetch_packed(self, fl: _InFlightLaunch) -> np.ndarray:
         """Block until the launch's packed result is on the host (the
@@ -3003,6 +3066,16 @@ class BatchedEnsembleService:
             self._occ_sum += (fl.a_width / e if fl.active is not None
                               else 1.0)
             self._occ_launches += 1
+            if self._obs:
+                fl.payload_nbytes = int(flat.nbytes)
+                self._launches_total += 1
+                # device-round share: the rows this launch actually
+                # carried (the compacted active set, or every live
+                # row for a full-width launch)
+                if fl.active is not None:
+                    self.tenant_rounds[fl.active] += 1
+                else:
+                    self.tenant_rounds[self._live] += 1
             corrupt = corrupt_np if fl.k else None
             if fl.plan is not None:
                 # Route the [G*W, E] results back to the caller's
@@ -3273,6 +3346,13 @@ class BatchedEnsembleService:
                 "last_ms": round(self.wal_compaction_ms_last, 3),
                 "total_ms": round(self.wal_compaction_ms_total, 3),
             },
+            # observability plane (docs/ARCHITECTURE.md §11): the
+            # full registry exports via the svcnode `metrics` verb;
+            # stats() carries the headline plus per-tenant
+            # attribution so existing stats consumers see both
+            "obs_enabled": self._obs,
+            "flight_anomalies": self.flight.anomalies,
+            "tenants": self.tenant_stats(top=8),
         }
 
     def _lease_valid_fraction(self) -> float:
@@ -3284,6 +3364,261 @@ class BatchedEnsembleService:
             return 0.0
         horizon = self.runtime.now + self._read_margin
         return float((self.lease_until[live] > horizon).mean())
+
+    # -- observability plane (docs/ARCHITECTURE.md §11) ---------------------
+
+    def _register_obs_metrics(self) -> None:
+        """Hook this service's counters into its metrics registry.
+
+        Everything the hot path already maintains as a plain
+        attribute exports through a COLLECTOR (read at export time —
+        no double-writing on the flush path); only genuinely new
+        instruments (the flush histogram, per-tenant planes) record
+        directly."""
+        self.obs_registry.collect(self._obs_service_collect)
+        self.obs_registry.collect(self._obs_tenant_collect)
+
+    def _obs_service_collect(self) -> Dict[str, Any]:
+        def fam(typ, help, val):
+            # the collector-family shape lives in obs.registry.family
+            return obs.registry.family(typ, help, {None: val})
+
+        occ = (self._occ_sum / self._occ_launches
+               if self._occ_launches else 1.0)
+        return {
+            "retpu_flushes_total": fam(
+                "counter", "settled device launches", self.flushes),
+            "retpu_ops_served_total": fam(
+                "counter", "client ops resolved (fast reads included)",
+                self.ops_served),
+            "retpu_corruptions_total": fam(
+                "counter", "integrity-gate detections",
+                self.corruptions),
+            "retpu_repairs_total": fam(
+                "counter", "replicas the exchange healed",
+                self.repairs),
+            "retpu_read_fastpath_hits_total": fam(
+                "counter", "mirror-served leased reads",
+                self.read_fastpath_hits),
+            "retpu_read_fastpath_misses_total": fam(
+                "counter", "fast-path fallbacks to the device round",
+                self.read_fastpath_misses),
+            "retpu_rmw_conflicts_total": fam(
+                "counter", "host-path kmodify CAS retries",
+                self.rmw_conflicts),
+            "retpu_rmw_device_fastpath_total": fam(
+                "counter", "single-round device RMW commits",
+                self.rmw_device_fastpath),
+            "retpu_payload_bytes_total": fam(
+                "counter", "packed d2h bytes actually fetched",
+                self.payload_bytes),
+            "retpu_payload_bytes_full_width_total": fam(
+                "counter", "what the full-width [K, E] layout would "
+                "have moved", self.payload_bytes_full_width),
+            "retpu_wal_compactions_total": fam(
+                "counter", "WAL folds into a fresh checkpoint",
+                self.wal_compactions),
+            "retpu_wide_launches_total": fam(
+                "counter", "launches through the wide scheduler",
+                self.wide_launches),
+            "retpu_flight_anomalies_total": fam(
+                "counter", "flight-recorder trigger firings (flush "
+                "> 5x rolling p50)", self.flight.anomalies),
+            "retpu_queued_ops": fam(
+                "gauge", "device rounds currently queued",
+                sum(self._queue_rounds[e] for e in self._active)),
+            "retpu_launches_in_flight": fam(
+                "gauge", "dispatched-but-unresolved launches",
+                len(self._inflight_launches)),
+            "retpu_lease_valid_fraction": fam(
+                "gauge", "live rows holding a margin-valid lease",
+                round(self._lease_valid_fraction(), 4)),
+            "retpu_grid_occupancy": fam(
+                "gauge", "mean packed-grid occupancy (a_width / E)",
+                round(occ, 4)),
+            "retpu_live_payloads": fam(
+                "gauge", "host payload-store entries",
+                len(self.values)),
+            "retpu_ensembles_with_leader": fam(
+                "gauge", "rows with a live leader",
+                int((self.leader_np >= 0).sum())),
+        }
+
+    def set_tenant_label(self, ens: int, label: Any) -> None:
+        """Name a row for per-tenant attribution (dynamic rows are
+        already labeled by their create_ensemble name)."""
+        self._tenant_labels[int(ens)] = label
+
+    def tenant_label(self, ens: int) -> str:
+        lbl = self._tenant_labels.get(ens)
+        if lbl is None:
+            lbl = self._row_name.get(ens)
+        return str(lbl) if lbl is not None else f"ens{ens}"
+
+    def _tenant_groups(self, top: int = 16
+                       ) -> "List[Tuple[str, List[int]]]":
+        """Label -> rows worth exporting: every NAMED tenant plus the
+        top-N rows by op count, capped at 64 LABELS ranked by ops (a
+        10k-row service exports dozens of tenants, not 10k — and the
+        cap keeps the noisy ones, not the lowest row indices).  Rows
+        sharing a label group together: a tenant spanning several
+        ensemble rows is ONE tenant in every export."""
+        rows = set(self._tenant_labels) | set(self._row_name)
+        if top and self.tenant_ops.any():
+            hot = np.argsort(self.tenant_ops)[-top:]
+            rows.update(int(e) for e in hot if self.tenant_ops[e] > 0)
+        groups: Dict[str, List[int]] = {}
+        for e in rows:
+            if 0 <= e < self.n_ens:
+                groups.setdefault(self.tenant_label(e), []).append(e)
+        ranked = sorted(
+            groups.items(),
+            key=lambda kv: (-int(self.tenant_ops[kv[1]].sum()),
+                            kv[0]))
+        return [(lbl, sorted(rr)) for lbl, rr in ranked[:64]]
+
+    def _tenant_pctl(self, rows: List[int], q: float) -> float:
+        """Bucket-resolution quantile (ms) over a tenant's (possibly
+        multi-row) op-latency histogram — obs.Histogram's estimator."""
+        counts = self._tenant_lat[rows].sum(axis=0)
+        return obs.registry.percentile_from_counts(
+            counts.tolist(), self._lat_edges, q)
+
+    def tenant_stats(self, top: int = 16) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant attribution snapshot: ops, committed rounds,
+        put payload bytes, device-round share (fraction of this
+        service's launches the tenant's rows were active in), and
+        p50/p99 op latency — the noisy-neighbor evidence surface."""
+        out: Dict[str, Dict[str, Any]] = {}
+        launches = max(self._launches_total, 1)
+        for lbl, rows in self._tenant_groups(top):
+            out[lbl] = {
+                "rows": rows,
+                "ops": int(self.tenant_ops[rows].sum()),
+                "commits": int(self.tenant_commits[rows].sum()),
+                "put_bytes": int(self.tenant_bytes[rows].sum()),
+                "device_rounds": int(self.tenant_rounds[rows].sum()),
+                "device_round_share": round(
+                    float(self.tenant_rounds[rows].sum()) / launches,
+                    4),
+                "p50_ms": round(self._tenant_pctl(rows, 0.5), 3),
+                "p99_ms": round(self._tenant_pctl(rows, 0.99), 3),
+            }
+        return out
+
+    def _obs_tenant_collect(self) -> Dict[str, Any]:
+        groups = self._tenant_groups()
+        launches = max(self._launches_total, 1)
+
+        def fam(typ, help, per_group):
+            return obs.registry.family(
+                typ, help, {lbl: per_group(rows)
+                            for lbl, rows in groups})
+
+        return {
+            "retpu_tenant_ops_total": fam(
+                "counter", "keyed + fast-read ops per tenant",
+                lambda rr: int(self.tenant_ops[rr].sum())),
+            "retpu_tenant_commits_total": fam(
+                "counter", "committed device rounds per tenant",
+                lambda rr: int(self.tenant_commits[rr].sum())),
+            "retpu_tenant_put_bytes_total": fam(
+                "counter", "put payload bytes per tenant",
+                lambda rr: int(self.tenant_bytes[rr].sum())),
+            "retpu_tenant_device_rounds_total": fam(
+                "counter", "launches the tenant's rows were active in",
+                lambda rr: int(self.tenant_rounds[rr].sum())),
+            "retpu_tenant_device_round_share": fam(
+                "gauge", "fraction of this service's launches",
+                lambda rr: round(
+                    float(self.tenant_rounds[rr].sum()) / launches,
+                    4)),
+            "retpu_tenant_op_p50_ms": fam(
+                "gauge", "tenant op latency p50 upper bound (each op "
+                "charged its flush's oldest enqueue-to-resolve time)",
+                lambda rr: round(self._tenant_pctl(rr, 0.5), 3)),
+            "retpu_tenant_op_p99_ms": fam(
+                "gauge", "tenant op latency p99 upper bound (each op "
+                "charged its flush's oldest enqueue-to-resolve time)",
+                lambda rr: round(self._tenant_pctl(rr, 0.99), 3)),
+        }
+
+    def _obs_account_taken(self, taken, committed) -> None:
+        """Per-tenant attribution for one resolved flush: vectorized
+        adds over the flush's active rows (O(|taken|), not O(E) and
+        not per-op).
+
+        Latency estimator: each OP is charged its flush's
+        oldest-enqueue→resolve time (the batch's worst op) — a
+        conservative upper bound recorded at batch granularity, the
+        price of staying off the per-op Python path.  Weighting by
+        the op count (not one sample per flush) keeps a 64-op batch
+        from counting like a 1-op batch, so cross-tenant p99
+        comparisons compare the same estimator; leased fast reads
+        contribute lowest-bucket samples from their own hook."""
+        now = time.perf_counter()
+        rows: List[int] = []
+        nops: List[int] = []
+        lats: List[float] = []
+        for e, ops in taken:
+            rows.append(e)
+            nops.append(sum(op.n for op in ops))
+            t0 = min((op.t_enq for op in ops if op.t_enq),
+                     default=now)
+            lats.append((now - t0) * 1e3)
+        if not rows:
+            return
+        rr = np.asarray(rows, np.int64)
+        nn = np.asarray(nops, np.int64)
+        np.add.at(self.tenant_ops, rr, nn)
+        bidx = np.searchsorted(self._lat_edges,
+                               np.asarray(lats)).astype(np.int64)
+        np.add.at(self._tenant_lat, (rr, bidx), nn)
+        if committed is not None:
+            np.add.at(self.tenant_commits, rr,
+                      committed[:, rr].sum(axis=0).astype(np.int64))
+
+    def _obs_note_put_bytes(self, ens: int, handles) -> None:
+        """Attribute queued put payload bytes to the row's tenant
+        (handles may include 0 = tombstone and -1-style sentinels —
+        both length-less)."""
+        values = self.values
+        total = 0
+        for h in handles:
+            if h and h > 0:
+                v = values.get(h)
+                try:
+                    total += len(v)
+                except TypeError:
+                    pass
+        if total:
+            self.tenant_bytes[ens] += total
+
+    def _obs_flush_settled(self, fl: _InFlightLaunch) -> None:
+        """Feed one settled launch into the obs plane: the flush
+        histogram, the leader span record (joined with replica spans
+        by flush_id), and the flight-recorder ring + anomaly
+        trigger."""
+        rec = fl.rec
+        total = rec.get("total", 0.0)
+        self._h_flush.record(total * 1e3)
+        obs.SPANS.record(
+            fl.flush_id, "leader",
+            # META_FIELDS (incl. the derived 'enqueue' = h2d +
+            # dispatch) are identity/derived, not spans — including
+            # them would double-count a summed timeline
+            [(c, v) for c, v in rec.items()
+             if c not in obs.flightrec.META_FIELDS],
+            k=fl.k, a_width=fl.a_width, total_s=total,
+            payload_bytes=fl.payload_nbytes)
+        self.flight.record({
+            "flush_id": fl.flush_id, "t": time.time(),
+            "k": fl.k, "a_width": fl.a_width,
+            "payload_bytes": fl.payload_nbytes,
+            "queued_rounds": sum(self._queue_rounds[e]
+                                 for e in self._active),
+            "in_flight": len(self._inflight_launches),
+            **rec})
 
     # -- (K, A)-grid pre-compile --------------------------------------------
 
@@ -3911,6 +4246,8 @@ class BatchedEnsembleService:
         rec["resolve"] = t_end - t_res
         rec["total"] = sum(v for c, v in rec.items()
                            if c not in ("k", "total", "enqueue"))
+        if self._obs:
+            self._obs_flush_settled(fl)
         return served, wal_err
 
     def _settle_execute(self, fl: _InFlightLaunch, planes
@@ -3934,6 +4271,8 @@ class BatchedEnsembleService:
         self.ops_served += fl.exec_ops
         self._safe_resolve(fl.exec_fut,
                            (committed, get_ok, found, value))
+        if self._obs:
+            self._obs_flush_settled(fl)
         return fl.exec_ops, None
 
     def _wal_extra_records(self) -> List[Tuple[Any, Any]]:
@@ -4298,5 +4637,7 @@ class BatchedEnsembleService:
                     else:
                         self._fail_op(e, op)
         self.ops_served += served
+        if self._obs and taken:
+            self._obs_account_taken(taken, committed)
         self._drain_recycles()
         return served

@@ -82,12 +82,18 @@ Wire protocol (length-prefixed frames, :mod:`riak_ensemble_tpu.wire`):
                                         DELTA ("d", seq, k, nc, cols,
                                         counts, js, slots, vals,
                                         rmw_bits, quorum_bits, crc,
-                                        meta) or a FULL-plane fallback
-                                        ("f", seq, k, want_vsn, elect,
-                                        lease, kind, slot, val, exp_e,
-                                        exp_s, meta); meta = put-lane
-                                        (round, ens, key, handle,
-                                        payload) records
+                                        meta, fid) or a FULL-plane
+                                        fallback ("f", seq, k,
+                                        want_vsn, elect, lease, kind,
+                                        slot, val, exp_e, exp_s, meta,
+                                        fid); meta = put-lane (round,
+                                        ens, key, handle, payload)
+                                        records; fid = the leader's
+                                        obs flush_id (trailing term-
+                                        header field, 0 when tracing
+                                        is off) — replica apply spans
+                                        record under it so one id
+                                        names the flush end to end
       ("apply", ge, seq, k, want_vsn, elect, lease, kind, slot, val,
        exp_e, exp_s, meta)              legacy single full-plane
                                         launch (still served)
@@ -138,7 +144,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from riak_ensemble_tpu import wire
+from riak_ensemble_tpu import obs, wire
 from riak_ensemble_tpu.config import Config
 from riak_ensemble_tpu.ops import engine as eng
 from riak_ensemble_tpu.parallel.batched_host import (
@@ -431,12 +437,17 @@ def _delta_fns():
 
 def _delta_scatter_cells(svc: BatchedEnsembleService,
                          cells: np.ndarray, ctr_np: np.ndarray,
-                         rows: np.ndarray) -> None:
+                         rows: np.ndarray,
+                         marks: Optional[Dict[str, float]] = None
+                         ) -> None:
     """Land committed cells ``[n, (e, s, epoch, seq, val)]`` in the
     service's object planes through the capped bucket ladder, then
-    swap the counters and rebuild the touched rows' trees."""
+    swap the counters and rebuild the touched rows' trees.  ``marks``
+    (obs tracing) gets the blocked scatter/rebuild split in
+    seconds."""
     import jax.numpy as jnp
 
+    t0 = time.perf_counter()
     scatter, finish = _delta_fns()
     st = svc.state
     for off in range(0, cells.shape[0], _DELTA_SCATTER_CAP):
@@ -456,7 +467,19 @@ def _delta_scatter_cells(svc: BatchedEnsembleService,
                      jnp.asarray(chunk[:, 2]),
                      jnp.asarray(chunk[:, 3]),
                      jnp.asarray(chunk[:, 4]))
+    # obs marks are DISPATCH times (no block_until_ready): forcing a
+    # device sync here would serialize the replica's scatter/rebuild
+    # with its WAL+ack path on every delta run — the async dispatch
+    # chain must keep overlapping exactly as without tracing.  The
+    # device-side completion cost shows up in whichever later span
+    # first consumes the arrays (the same d2h-blind discipline as the
+    # leader's 'dispatch' mark).
+    if marks is not None:
+        t1 = time.perf_counter()
+        marks["scatter"] = t1 - t0
     svc.state = finish(st, jnp.asarray(ctr_np), jnp.asarray(rows))
+    if marks is not None:
+        marks["rebuild"] = time.perf_counter() - t1
 
 
 def warm_delta_apply(svc: BatchedEnsembleService) -> None:
@@ -652,7 +675,8 @@ def build_delta_entry(seq: int, k: int, committed: Optional[np.ndarray],
                       kind: np.ndarray, slot: np.ndarray,
                       val: np.ndarray, quorum_ok: np.ndarray,
                       meta: List[Tuple],
-                      n_slots: int = 65536) -> Tuple[Tuple, int, int]:
+                      n_slots: int = 65536,
+                      fid: int = 0) -> Tuple[Tuple, int, int]:
     """Build one delta entry from the leader's resolved planes.
 
     Returns ``(entry, crc, delta_bytes)`` — the wire entry tuple, the
@@ -660,7 +684,11 @@ def build_delta_entry(seq: int, k: int, committed: Optional[np.ndarray],
     section byte count (the shipped-bytes meter).  Index sections use
     the narrowest width that fits (round index by K, slot by S,
     column/count by E/K as uint16) — at a dense write batch the entry
-    runs ~6-7 bytes per committed cell against the full planes' 20."""
+    runs ~6-7 bytes per committed cell against the full planes' 20.
+    ``fid`` is the leader's obs flush id, a trailing header field the
+    replica tags its apply spans with (cross-process flush tracing);
+    it rides outside the section CRC — tracing identity, not
+    replicated state."""
     j_dt = _idx_dtype(max(k, 1))
     s_dt = _idx_dtype(n_slots)
     if committed is not None and committed.any():
@@ -700,7 +728,8 @@ def build_delta_entry(seq: int, k: int, committed: Optional[np.ndarray],
              wire.Raw(np.ascontiguousarray(slots)),
              wire.Raw(np.ascontiguousarray(vals)),
              wire.Raw(np.ascontiguousarray(rmw_b)),
-             wire.Raw(np.ascontiguousarray(q_b)), crc, meta)
+             wire.Raw(np.ascontiguousarray(q_b)), crc, meta,
+             int(fid))
     return entry, crc, nbytes
 
 
@@ -709,11 +738,13 @@ def build_full_entry(seq: int, k: int, want_vsn: bool,
                      kind: np.ndarray, slot: np.ndarray,
                      val: np.ndarray, exp_e: Optional[np.ndarray],
                      exp_s: Optional[np.ndarray],
-                     meta: List[Tuple]) -> Tuple[Tuple, int]:
+                     meta: List[Tuple],
+                     fid: int = 0) -> Tuple[Tuple, int]:
     """Full-plane fallback entry (re-executed by the replica through
     the plain launch halves — elections, corruption/exchange rounds
     and delta-ineligible shapes).  Planes ride as Raw buffers so even
     the fallback never concatenates them into an intermediate bytes.
+    ``fid`` = the leader's obs flush id (see build_delta_entry).
     Returns ``(entry, plane_bytes)``."""
 
     def raw_i32(p):
@@ -729,7 +760,7 @@ def build_full_entry(seq: int, k: int, want_vsn: bool,
                     if p is not None))
     entry = ("f", int(seq), int(k), bool(want_vsn), wire.Raw(eb),
              wire.Raw(lb), raw_i32(kind), raw_i32(slot), raw_i32(val),
-             raw_i32(exp_e), raw_i32(exp_s), meta)
+             raw_i32(exp_e), raw_i32(exp_s), meta, int(fid))
     return entry, nbytes
 
 
@@ -765,6 +796,17 @@ class ReplicaCore:
         #: failover peer list (set by ReplicaServer)
         self.on_cfg = None
 
+    def _obs_role(self) -> str:
+        """This lane's span-store role: "replica" plus the lane tag
+        (the address peers dial it by) when one exists — in-process
+        multi-lane groups share the process-global store, and
+        untagged roles would merge three lanes' spans into one
+        indistinguishable record."""
+        addr = getattr(self.svc, "self_addr", None)
+        if addr:
+            return f"replica@{addr[0]}:{addr[1]}"
+        return "replica"
+
     def handle_promise(self, ge: int) -> Tuple:
         """Grant iff strictly newer; the grant persists BEFORE it is
         answered (a granted promise that didn't survive a crash would
@@ -790,7 +832,10 @@ class ReplicaCore:
         bad = self._check_stream(ge, seq)
         if bad is not None:
             return bad
-        crc = self._apply_full_entry(ge, ("f",) + tuple(frame[2:]))
+        # legacy frames predate the trailing flush-id field: no
+        # leader-side trace to join, record under fid 0 (dropped)
+        crc = self._apply_full_entry(
+            ge, ("f",) + tuple(frame[2:]) + (0,))
         return ("applied", ge, seq, crc)
 
     def _check_stream(self, ge: int, seq: int) -> Optional[Tuple]:
@@ -891,11 +936,13 @@ class ReplicaCore:
         # were never scattered or WAL-logged — a would-be promoter
         # could adopt a state that silently lost acked writes.  All-
         # or-nothing keeps the advertised position truthful.
+        t_start = time.perf_counter()
         decoded = []
         for ent in run:
             try:
                 (_, seq, _k, nc, jw, sw, cols_b, counts_b, jj_b,
-                 slots_b, vals_b, rmw_b, q_b, crc_ship, meta) = ent
+                 slots_b, vals_b, rmw_b, q_b, crc_ship, meta,
+                 fid) = ent
             except ValueError:
                 return None
             if int(jw) not in (1, 2) or int(sw) not in (1, 2):
@@ -937,12 +984,14 @@ class ReplicaCore:
             if any(e < 0 or e >= e_n for _, e, _k2, _h, _p in meta):
                 return None
             decoded.append((int(seq), int(crc_ship), cols, counts,
-                            jj, slots, vals, rmwb, qb, meta))
+                            jj, slots, vals, rmwb, qb, meta,
+                            int(fid)))
+        t_validated = time.perf_counter()
 
         # Apply pass: nothing below can fail validation — mutations
         # land for the whole run or not at all.
         for (seq, crc_ship, cols, counts, jj, slots, vals, rmwb, qb,
-             meta) in decoded:
+             meta, _fid) in decoded:
             # committed cells, column-grouped in round order: derive
             # each cell's (epoch, seq) exactly as the kernel assigns
             # them (obj_sequence: consecutive per column)
@@ -986,17 +1035,21 @@ class ReplicaCore:
             self.applied_ge, self.applied_seq = int(ge), int(seq)
             self.last_crc = int(crc_ship)
             crcs.append(int(crc_ship))
+        t_applied = time.perf_counter()
+        marks: Dict[str, float] = {}
         if final:
             cells = np.asarray(
                 [(e, s, ep, sq, vl)
                  for (e, s), (ep, sq, vl) in final.items()], np.int32)
             rows = np.zeros((e_n, svc.n_peers), bool)
             rows[touched] = True
-            _delta_scatter_cells(svc, cells, ctr_np, rows)
+            _delta_scatter_cells(svc, cells, ctr_np, rows,
+                                 marks=marks if svc._obs else None)
         # Durability barrier: one log()/sync covers every entry of the
         # run + the advanced group meta, BEFORE the cumulative ack.
         recs.append((_GRP_KEY, (self.promised, self.applied_ge,
                                 self.applied_seq, self.cfg)))
+        t_scattered = time.perf_counter()
         if svc._wal is not None:
             svc._wal.log(recs)
             if svc._wal.count >= svc.wal_compact_records:
@@ -1004,11 +1057,32 @@ class ReplicaCore:
                 svc.save()
                 save_group_meta(svc, self.promised, self.applied_ge,
                                 self.applied_seq, self.cfg)
+        if svc._obs:
+            # replica half of the cross-process flush trace: every
+            # entry's spans record under the LEADER's flush id (the
+            # wire's trailing field), so obs.timeline(fid) joins this
+            # lane's validate/scatter/rebuild/WAL time with the
+            # leader's enqueue/build/ship spans.  Run-shared passes
+            # (validate, the one coalesced scatter + WAL sync) are
+            # charged to the run and marked with its size.
+            t_wal = time.perf_counter() - t_scattered
+            n_run = len(decoded)
+            for (seq, _c, _cols, _cnt, _jj, _s, _v, _r, _q, _m,
+                 fid) in decoded:
+                obs.SPANS.record(
+                    fid, self._obs_role(),
+                    [("validate", t_validated - t_start),
+                     ("apply", t_applied - t_validated),
+                     ("scatter", marks.get("scatter", 0.0)),
+                     ("rebuild", marks.get("rebuild", 0.0)),
+                     ("wal_sync", t_wal)],
+                    seq=seq, run_entries=n_run, kind="delta")
         return crcs
 
     def _apply_full_entry(self, ge: int, ent: Tuple) -> int:
         (_, seq, k, want_vsn, elect_b, lease_b, kind_b, slot_b,
-         val_b, exp_e_b, exp_s_b, meta) = ent
+         val_b, exp_e_b, exp_s_b, meta, fid) = ent
+        t_start = time.perf_counter()
         svc = self.svc
         e_n = svc.n_ens
         elect = _unpack_bool(elect_b, e_n)
@@ -1036,6 +1110,7 @@ class ReplicaCore:
         committed, _get_ok, _found, value, vsn = \
             BatchedEnsembleService._launch_resolve(svc, fl)
         crc = result_crc(committed, vsn)
+        t_applied = time.perf_counter()
 
         # Durability barrier: this host's WAL carries every committed
         # record of the batch BEFORE the ack that lets the leader
@@ -1078,6 +1153,15 @@ class ReplicaCore:
                 # into a quorum while the new-epoch leader commits
                 # elsewhere (review r4: split-brain via compaction).
                 save_group_meta(svc, self.promised, ge, seq, self.cfg)
+        if svc._obs:
+            # the full-plane fallback's replica trace: one re-executed
+            # launch, so "apply" covers the whole device round + local
+            # resolve this lane ran under the leader's flush id
+            obs.SPANS.record(
+                fid, self._obs_role(),
+                [("apply", t_applied - t_start),
+                 ("wal_sync", time.perf_counter() - t_applied)],
+                seq=int(seq), kind="full")
         return crc
 
     def _mirror_write(self, e: int, key: Any, slot: int, handle: int,
@@ -1445,13 +1529,16 @@ class _PendingEntry:
     outcome is known."""
 
     __slots__ = ("seq", "crc", "entry", "taken", "planes", "ack",
-                 "ack_reads", "shipped_at")
+                 "ack_reads", "shipped_at", "fid")
 
     def __init__(self, seq: int, crc: int, entry: Tuple,
-                 shipped_at: float = 0.0) -> None:
+                 shipped_at: float = 0.0, fid: int = 0) -> None:
         self.seq = seq
         self.crc = crc
         self.entry = entry
+        #: obs flush id (cross-process tracing): the settle records
+        #: the batch's ack span under it
+        self.fid = fid
         self.taken: Optional[list] = None
         self.planes: Any = None
         self.ack = True
@@ -1888,6 +1975,42 @@ class ReplicatedService(BatchedEnsembleService):
                             "repl_build_s": 0.0,
                             "repl_ack_s": 0.0,
                             "repl_acked_batches": 0}
+        # group-level metrics join the service's registry (the
+        # svcnode `metrics` verb and the docs ratchet see one plane)
+        self.obs_registry.collect(self._obs_group_collect)
+
+    def _obs_group_collect(self) -> Dict[str, Any]:
+        def fam(typ, help, val):
+            # the collector-family shape lives in obs.registry.family
+            return obs.registry.family(typ, help, {None: val})
+
+        out = {
+            "retpu_group_is_leader": fam(
+                "gauge", "1 while this lane leads its group",
+                int(self.is_leader)),
+            "retpu_group_epoch": fam(
+                "gauge", "group epoch", self._ge),
+            "retpu_group_seq": fam(
+                "gauge", "applied stream position", self._grp_seq),
+            "retpu_group_peers_connected": fam(
+                "gauge", "links currently connected",
+                sum(l.connected for l in self._links)),
+            "retpu_group_peers_synced": fam(
+                "gauge", "links not needing re-sync",
+                sum(not l.needs_sync for l in self._links)),
+            "retpu_group_pipeline_pending": fam(
+                "gauge", "resolved-but-unsettled flush entries",
+                self._outstanding()),
+        }
+        for key, val in self.group_stats.items():
+            # every group_stats entry is cumulative-monotone (the
+            # *_s entries are summed seconds) — counters all, so
+            # PromQL rate()/increase() semantics apply uniformly
+            out[f"retpu_group_{key}"] = fam(
+                "counter",
+                "replication group stat (see stats()['group'])",
+                round(val, 6) if isinstance(val, float) else val)
+        return out
 
     # -- leadership ---------------------------------------------------------
 
@@ -2389,12 +2512,13 @@ class ReplicatedService(BatchedEnsembleService):
         if delta_ok:
             entry_t, crc, nbytes = build_delta_entry(
                 seq, fl.k, committed, value, kind, slot, val,
-                fl.quorum_np, meta, n_slots=self.n_slots)
+                fl.quorum_np, meta, n_slots=self.n_slots,
+                fid=fl.flush_id)
             self.group_stats["repl_delta_entries"] += 1
         else:
             entry_t, nbytes = build_full_entry(
                 seq, fl.k, fl.want_vsn, elect, lease_ok, kind, slot,
-                val, exp_e, exp_s, meta)
+                val, exp_e, exp_s, meta, fid=fl.flush_id)
             crc = result_crc(committed, vsn)
             self.group_stats["repl_full_entries"] += 1
         self.group_stats["repl_bytes_sections"] += nbytes
@@ -2405,7 +2529,8 @@ class ReplicatedService(BatchedEnsembleService):
         self.core.applied_ge = self._ge
         self.core.applied_seq = seq
         self.core.last_crc = crc
-        entry = _PendingEntry(seq, crc, entry_t, shipped_at=fl.now)
+        entry = _PendingEntry(seq, crc, entry_t, shipped_at=fl.now,
+                              fid=fl.flush_id)
         self._ship_buf.append(entry)
         self._unclaimed = entry
         self.group_stats["applies"] += 1
@@ -2828,6 +2953,15 @@ class ReplicatedService(BatchedEnsembleService):
         else:
             self._host_lease_until = 0.0
             self.group_stats["quorum_failures"] += 1
+        if self._obs:
+            # leader half of the replication trace: one ack span per
+            # member flush (ship → host-quorum decision), joined with
+            # the replica apply spans by flush id
+            ack_s = time.monotonic() - batch.ship_t
+            for entry in batch.entries:
+                obs.SPANS.record(entry.fid, "leader",
+                                 [("repl_ack", ack_s)],
+                                 quorum_ok=q, seq=entry.seq)
         for entry in batch.entries:
             if entry.taken is not None:
                 super()._resolve_flush(entry.taken, entry.planes,

@@ -28,6 +28,14 @@ requests and correlate out-of-order completions:
     ("kupdate_many", ens, keys, vsns, vals) / ("kdelete_many",
     ens, keys)                       -> [per-key results, in order]
     ("stats",)                       -> dict
+    ("metrics",)                     -> dict: the service's full obs
+                                       registry snapshot (counters,
+                                       gauges, histograms, per-tenant
+                                       attribution; docs/
+                                       ARCHITECTURE.md §11)
+    ("metrics", "prometheus")        -> str: the same registry in
+                                       Prometheus text exposition
+                                       format (scrape-ready)
 
 Reads (``kget``/``kget_vsn``/``kget_many``) are served through the
 service's lease-protected fast path when its conditions hold — the
@@ -218,6 +226,16 @@ class ServiceServer:
                 if op == "stats":
                     send(req_id, self.svc.stats())
                     continue
+                if op == "metrics":
+                    # the obs-plane export verb: the whole registry
+                    # as plain JSON-able containers, or Prometheus
+                    # text when asked (both wire-encodable)
+                    if args and args[0] == "prometheus":
+                        send(req_id,
+                             self.svc.obs_registry.render_prometheus())
+                    else:
+                        send(req_id, self.svc.obs_registry.snapshot())
+                    continue
                 if op in ("create_ensemble", "destroy_ensemble",
                           "resolve_ensemble"):
                     send(req_id, self._lifecycle(op, args))
@@ -381,6 +399,13 @@ class ServiceClient:
 
     async def stats(self, **kw):
         return await self.call("stats", **kw)
+
+    async def metrics(self, fmt: Optional[str] = None, **kw):
+        """Obs-registry export: dict snapshot by default,
+        ``fmt="prometheus"`` for the text exposition format."""
+        if fmt is None:
+            return await self.call("metrics", **kw)
+        return await self.call("metrics", fmt, **kw)
 
     async def create_ensemble(self, name, view=None, **kw):
         return await self.call("create_ensemble", name, view, **kw)

@@ -41,14 +41,33 @@ class Span:
 
 @dataclass
 class Tracer:
-    """Attach with ``Tracer(runtime).install()``."""
+    """Attach with ``Tracer(runtime).install()``.
+
+    Both rings are BOUNDED: ``events`` at ``max_events`` and
+    ``finished`` spans at ``max_finished`` (the finished list used to
+    grow forever on long runs — a tracer left installed on a serving
+    node leaked a Span per op).  Counters are exact over the whole
+    run either way; percentile reports cover the retained window.
+
+    Pass ``registry`` (an :class:`riak_ensemble_tpu.obs.registry.
+    MetricsRegistry`) to fold the tracer into the unified obs plane:
+    event counts mirror into ``retpu_trace_events_total`` (labeled by
+    kind) and finished span durations feed the
+    ``retpu_trace_span_ms`` histogram, so `metrics` consumers see
+    tracer activity without touching this object."""
 
     runtime: Any
     max_events: int = 100_000
     events: Deque[Tuple[float, str, Any]] = field(default_factory=collections.deque)
     counters: Dict[str, int] = field(default_factory=dict)
     spans: Dict[int, Span] = field(default_factory=dict)
-    finished: List[Span] = field(default_factory=list)
+    finished: Deque[Span] = field(default_factory=collections.deque)
+    max_finished: int = 10_000
+    registry: Any = None
+
+    def __post_init__(self) -> None:
+        self.finished = collections.deque(self.finished,
+                                          maxlen=self.max_finished)
 
     def install(self) -> "Tracer":
         self.runtime.trace = self._on_event
@@ -65,6 +84,11 @@ class Tracer:
         self.events.append((self.runtime.now, kind, payload))
         while len(self.events) > self.max_events:
             self.events.popleft()
+        if self.registry is not None:
+            self.registry.counter(
+                "retpu_trace_events_total",
+                "runtime trace events by kind",
+                label_name="kind").labels(kind).inc()
 
     # -- spans -------------------------------------------------------------
 
@@ -83,6 +107,12 @@ class Tracer:
         self.finished.append(span)
         self.counters[f"span:{span.kind}"] = \
             self.counters.get(f"span:{span.kind}", 0) + 1
+        if self.registry is not None and span.duration is not None:
+            self.registry.histogram(
+                "retpu_trace_span_ms",
+                "tracer span durations by kind",
+                label_name="kind").labels(
+                    span.kind).record(span.duration * 1e3)
         return span
 
     # -- reports -----------------------------------------------------------

@@ -87,6 +87,68 @@ def test_mixed_tail_attribution_smoke():
         assert out["mixed_tail_top_cause"] is None
 
 
+def test_obs_overhead_smoke():
+    """The obs-plane overhead tripwire: the headline pipelined loop
+    with recording ON must stay within shouting distance of the
+    RETPU_OBS=0 arm even at smoke shapes.  The tier-1 bound is
+    deliberately loose (smoke samples are tiny batches on a noisy
+    CI box — the measured per-batch delta is ~0); the 3% acceptance
+    bound is pinned at round time on the real shape via the
+    batch-granular interleaved-median A/B this same runner
+    performs."""
+    out = bench.run_obs_overhead(16, 3, 8, 4, seconds=0.4)
+    assert out["obs_on_ops_per_sec"] > 0
+    assert out["obs_off_ops_per_sec"] > 0
+    assert (out["obs_on_ops_per_sec"]
+            > 0.4 * out["obs_off_ops_per_sec"]), out
+
+
+def test_obs_metric_names_documented():
+    """The stats-schema ratchet (the test_env_knobs pattern applied
+    to metric names): every metric a service registry can export must
+    be listed in docs/ARCHITECTURE.md §11, and every `retpu_*` name
+    the §11 tables document must still exist — so a new metric can't
+    ship undocumented and a renamed one can't haunt the docs."""
+    import os
+    import re
+
+    from riak_ensemble_tpu import obs
+    from riak_ensemble_tpu.parallel.batched_host import (
+        BatchedEnsembleService, WallRuntime)
+    from riak_ensemble_tpu.parallel.repgroup import ReplicatedService
+    from riak_ensemble_tpu.utils.trace import Tracer
+
+    svc = BatchedEnsembleService(WallRuntime(), 2, 1, 4, tick=None,
+                                 max_ops_per_tick=2)
+    grp = ReplicatedService(WallRuntime(), 2, 1, 4, group_size=1)
+    # the tracer's registry-fold names register on first use
+    class _RT:
+        now = 0.0
+        trace = None
+    tr = Tracer(_RT(), registry=svc.obs_registry).install()
+    tr._on_event("probe", {})
+    tr.finish(tr.begin("probe", 0), "ok")
+    code_names = set(svc.obs_registry.names()) \
+        | set(grp.obs_registry.names())
+    svc.stop()
+    grp.stop()
+    assert code_names, "metric-name scan found nothing"
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "docs", "ARCHITECTURE.md"),
+              encoding="utf-8") as fh:
+        arch = fh.read()
+    documented = set(re.findall(r"`(retpu_[a-z0-9_]+)`", arch))
+    missing = code_names - documented
+    assert not missing, (
+        f"undocumented metric name(s) {sorted(missing)}: add them to "
+        "docs/ARCHITECTURE.md §11 'Observability plane'")
+    stale = documented - code_names
+    assert not stale, (
+        f"ARCHITECTURE.md documents removed metric(s) "
+        f"{sorted(stale)}: drop the row or restore the metric")
+
+
 def test_repgroup_rung_smoke():
     """The delta-replication regression tripwire (ARCHITECTURE §10):
     at the smoke shape (in-process replica hosts, skewed write set)

@@ -1,0 +1,440 @@
+"""The unified observability plane (docs/ARCHITECTURE.md §11).
+
+Covers the four pieces end to end: registry/histogram correctness
+against a numpy oracle, flight-recorder trigger + ring bound + dump
+schema round-trip, leader→replica flush_id correlation on a LIVE
+replication group (every replica apply span names a leader flush
+span), and per-tenant counter attribution under a two-tenant
+workload — plus the satellite contracts (Tracer's bounded finished
+ring folding into a registry, the RETPU_OBS=0 short-circuit, and the
+svcnode ``metrics`` verb)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from riak_ensemble_tpu import obs, wire  # noqa: E402
+from riak_ensemble_tpu.config import fast_test_config  # noqa: E402
+from riak_ensemble_tpu.obs.flightrec import DUMP_SCHEMA  # noqa: E402
+from riak_ensemble_tpu.parallel.batched_host import (  # noqa: E402
+    BatchedEnsembleService, WallRuntime)
+
+
+# -- registry ---------------------------------------------------------------
+
+def test_histogram_matches_numpy_oracle():
+    """Fixed-bucket counts must agree exactly with a searchsorted
+    oracle over the same edges, and the quantile estimate must land
+    inside the true quantile's bucket."""
+    h = obs.Histogram("retpu_test_ms")
+    rng = np.random.default_rng(7)
+    vals = rng.lognormal(1.0, 1.5, 4000)
+    for v in vals:
+        h.record(float(v))
+    edges = np.asarray(h.buckets)
+    oracle = np.bincount(np.searchsorted(edges, vals, side="left"),
+                         minlength=len(edges) + 1)
+    assert oracle.tolist() == h.counts
+    assert h.count == len(vals)
+    assert np.isclose(h.sum, vals.sum())
+    for q in (0.5, 0.9, 0.99):
+        est = h.percentile(q)
+        true = float(np.percentile(vals, q * 100))
+        i = int(np.searchsorted(edges, true, side="left"))
+        lo = 0.0 if i == 0 else float(edges[i - 1])
+        hi = float(edges[i]) if i < len(edges) else float("inf")
+        assert lo <= est <= min(hi, float(edges[-1])), (q, est, true)
+
+
+def test_histogram_empty_and_overflow():
+    h = obs.Histogram("retpu_test_ms", buckets=(1.0, 10.0))
+    assert h.percentile(0.5) == 0.0
+    h.record(5000.0)  # overflow bucket
+    assert h.counts == [0, 0, 1]
+    # the overflow bucket has no honest upper edge: report its floor
+    assert h.percentile(0.99) == 10.0
+
+
+def test_registry_counters_gauges_labels_and_export():
+    r = obs.MetricsRegistry()
+    c = r.counter("retpu_x_total", "a counter")
+    c.inc()
+    c.labels("hot").inc(3)
+    r.gauge("retpu_g", "a gauge", fn=lambda: 42)
+    r.histogram("retpu_h_ms").record(2.0)
+    r.collect(lambda: {"retpu_fam": {
+        "type": "counter", "help": "fam",
+        "values": {"a": 1, "b": 2}}})
+    snap = r.snapshot()
+    assert snap["retpu_x_total"]["hot"] == 3
+    assert snap["retpu_g"] == 42
+    assert snap["retpu_h_ms"]["count"] == 1
+    assert snap["retpu_fam"] == {"a": 1, "b": 2}
+    # the snapshot is wire-encodable (the svcnode metrics verb ships
+    # it through the restricted codec)
+    assert wire.decode(wire.encode(snap)) == snap
+    txt = r.render_prometheus()
+    assert '# TYPE retpu_x_total counter' in txt
+    assert 'retpu_x_total{tenant="hot"} 3' in txt
+    assert 'retpu_h_ms_bucket' in txt and 'retpu_h_ms_count 1' in txt
+    assert 'retpu_fam{tenant="a"} 1' in txt
+    assert sorted(r.names()) == ["retpu_fam", "retpu_g", "retpu_h_ms",
+                                 "retpu_x_total"]
+    # the unlabeled sample of a labeled family exports under "" (not
+    # a forged tenant named "None")
+    assert snap["retpu_x_total"][""] == 1
+    assert "None" not in snap["retpu_x_total"]
+
+
+def test_prometheus_label_escaping():
+    """Tenant labels are arbitrary user strings; one unescaped quote
+    would make Prometheus reject the entire scrape."""
+    r = obs.MetricsRegistry()
+    r.counter("retpu_x_total").labels('a"b\\c\nd').inc()
+    txt = r.render_prometheus()
+    assert 'tenant="a\\"b\\\\c\\nd"' in txt
+    assert '\n' not in txt.split("retpu_x_total{")[1].split("}")[0]
+
+
+# -- flight recorder --------------------------------------------------------
+
+def _feed(fr, n, total=0.01, start=0):
+    for i in range(n):
+        out = fr.record({"flush_id": start + i, "total": total,
+                         "unpack": total / 2})
+        assert out is None, "healthy flush must not trigger"
+
+
+def test_flight_trigger_ring_bound_and_dump_roundtrip(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setenv("RETPU_OBS_DUMP_DIR", str(tmp_path))
+    fr = obs.FlightRecorder(capacity=64, min_samples=16,
+                            refresh_every=4, min_dump_interval_s=0.0,
+                            name="t")
+    _feed(fr, 32)
+    snap = fr.record({"flush_id": 999, "total": 0.2,
+                      "device_d2h": 0.19, "unpack": 0.01})
+    assert snap is not None and fr.anomalies == 1
+    trig = snap["trigger"]
+    assert trig["flush_id"] == 999
+    assert trig["ratio"] >= trig["threshold"] == 5.0
+    assert trig["dominant_mark"] == "device_d2h"
+    # ring bound holds under sustained load
+    _feed(fr, 300, start=1000)
+    assert len(fr.records) == 64
+    # the dump file round-trips: schema, the ring (trigger flush
+    # included), and the box fingerprint
+    with open(snap["path"]) as f:
+        data = json.load(f)
+    assert data["schema"] == DUMP_SCHEMA
+    assert data["trigger"]["flush_id"] == 999
+    assert any(r.get("flush_id") == 999 for r in data["ring"])
+    box = data["box"]
+    assert box["schema"] == "retpu-box-fingerprint-v1"
+    assert box["cpu_count"] == os.cpu_count()
+    assert "jax" in box and "retpu_knobs" in box
+    assert "loadavg" in box
+
+
+def test_flight_trigger_unarmed_before_min_samples():
+    fr = obs.FlightRecorder(min_samples=32, refresh_every=4,
+                            min_dump_interval_s=0.0)
+    _feed(fr, 8)
+    assert fr.record({"flush_id": 9, "total": 5.0}) is None
+    assert fr.anomalies == 0
+
+
+def test_flight_trigger_rate_limited():
+    """The rate limit bounds DUMPS, not the anomaly counter: during
+    a sustained incident every trigger firing still counts."""
+    fr = obs.FlightRecorder(min_samples=8, refresh_every=2,
+                            min_dump_interval_s=3600.0)
+    _feed(fr, 16)
+    assert fr.record({"flush_id": 1, "total": 1.0}) is not None
+    assert fr.record({"flush_id": 2, "total": 1.0}) is None
+    assert fr.anomalies == 2
+    assert len(fr.dumps) == 1
+
+
+def test_injected_slow_flush_dumps_on_live_service(tmp_path,
+                                                   monkeypatch):
+    """Acceptance: an injected >5x-p50 flush on a REAL service
+    produces a flight dump with the per-flush ring and the box
+    fingerprint."""
+    monkeypatch.setenv("RETPU_OBS_DUMP_DIR", str(tmp_path))
+    svc = BatchedEnsembleService(WallRuntime(), 4, 3, 8, tick=None,
+                                 max_ops_per_tick=2)
+    svc.flight = obs.FlightRecorder(min_samples=8, refresh_every=2,
+                                    min_dump_interval_s=0.0,
+                                    name="svc")
+    for i in range(12):
+        fut = svc.kput(i % 4, "k", b"v%d" % i)
+        while not fut.done:
+            svc.flush()
+    assert svc.flight.anomalies == 0, \
+        "healthy flushes must not trigger"
+    # inject the stall at the d2h seam (the deterministic injection
+    # point the pipeline tests use) — 6x the recorder's own rolling
+    # p50 guarantees the trigger fires regardless of box speed
+    stall = max(6.0 * svc.flight._p50, 0.05)
+    orig = svc._fetch_packed
+
+    def slow_fetch(fl):
+        time.sleep(stall)
+        return orig(fl)
+
+    monkeypatch.setattr(svc, "_fetch_packed", slow_fetch)
+    fut = svc.kput(0, "k", b"slow")
+    while not fut.done:
+        svc.flush()
+    assert svc.flight.anomalies >= 1
+    snap = svc.flight.dumps[-1]
+    assert snap["schema"] == DUMP_SCHEMA
+    assert snap["box"]["cpu_count"] == os.cpu_count()
+    assert len(snap["ring"]) >= 8
+    assert os.path.exists(snap["path"])
+    # the anomalous flush is queryable through the obs span API too
+    tl = obs.timeline(snap["trigger"]["flush_id"])
+    assert tl is not None and "leader" in tl
+    svc.stop()
+
+
+# -- cross-process flush tracing (live repgroup) ----------------------------
+
+def test_flush_id_correlation_on_live_repgroup(tmp_path):
+    """Acceptance: given a flush_id, the obs API returns the JOINED
+    leader + replica timeline — and every replica apply span recorded
+    during the run names a leader flush span."""
+    from riak_ensemble_tpu.parallel import repgroup
+
+    before = set(obs.SPANS.flush_ids())
+    servers = [repgroup.ReplicaServer(4, 3, 8,
+                                      data_dir=str(tmp_path / f"r{i}"),
+                                      config=fast_test_config())
+               for i in (1, 2)]
+    svc = repgroup.ReplicatedService(
+        WallRuntime(), 4, 1, 8, group_size=3,
+        peers=[("127.0.0.1", s.repl_port) for s in servers],
+        ack_timeout=30.0, max_ops_per_tick=4,
+        config=fast_test_config(),
+        data_dir=str(tmp_path / "leader"))
+    repgroup.warmup_kernels(svc)
+    assert svc.takeover()
+    futs = [svc.kput_many(e, ["a", "b"], [b"1", b"2"])
+            for e in range(4)]
+    while any(svc.queues):
+        svc.flush()
+    assert svc.heartbeat()
+    assert all(f.done for f in futs)
+    # acks settle at MAJORITY time — wait until BOTH lanes actually
+    # reached the leader's applied position before reading their
+    # span records (the straggler lane records when it lands)
+    svc._drain_pending(block_all=True)
+    want = (svc.core.applied_ge, svc.core.applied_seq)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        with servers[0]._lock, servers[1]._lock:
+            if all((s.core.applied_ge, s.core.applied_seq) >= want
+                   for s in servers):
+                break
+        time.sleep(0.02)
+
+    def replica_sides(tl):
+        # replica roles carry the lane tag ("replica@host:port") so
+        # in-process lanes don't merge; match by prefix
+        return {k: v for k, v in tl.items()
+                if isinstance(k, str) and k.startswith("replica")}
+
+    new = [fid for fid in obs.SPANS.flush_ids() if fid not in before]
+    assert new, "the run recorded no flush timelines"
+    joined = 0
+    for fid in new:
+        tl = obs.timeline(fid)
+        reps = replica_sides(tl) if tl else {}
+        if not reps:
+            continue
+        # every replica apply span names a leader flush span: the
+        # SAME id carries both halves of the timeline
+        assert "leader" in tl, f"replica-only timeline for {fid}"
+        joined += 1
+        for side in reps.values():
+            r_spans = dict(side["spans"])
+            assert "apply" in r_spans, tl
+            if side.get("kind") == "delta":
+                assert "validate" in r_spans, tl
+        assert dict(tl["leader"]["spans"]), tl
+    assert joined >= 1, "no flush joined leader and replica spans"
+    # at least one data-bearing delta flush shows the full causal
+    # chain on BOTH lanes: leader enqueue/build/ack + per-lane
+    # replica scatter/rebuild/WAL (the lane tags keep the 2
+    # in-process replicas' spans separate)
+    full = []
+    for fid in new:
+        t = obs.timeline(fid)
+        if not t:
+            continue
+        reps = {k: v for k, v in replica_sides(t).items()
+                if v.get("kind") == "delta"}
+        if reps and "repl_ack" in dict(t["leader"]["spans"]):
+            full.append((t, reps))
+    assert full, "no delta flush carries the end-to-end timeline"
+    # both lanes drained above, so some delta flush must carry BOTH
+    # lane-tagged replica records (distinct roles, not merged)
+    both = [(t, r) for t, r in full if len(r) == 2]
+    assert both, f"no flush tagged both lanes: {[list(r) for _, r in full]}"
+    _some, reps = both[-1]
+    for side in reps.values():
+        for name in ("validate", "scatter", "rebuild", "wal_sync"):
+            assert name in dict(side["spans"])
+    svc.stop()
+    for s in servers:
+        s.stop()
+
+
+# -- per-tenant attribution -------------------------------------------------
+
+def test_two_tenant_attribution():
+    """Acceptance: a hot and a quiet tenant are separable in the
+    per-tenant ledger — ops, bytes, device-round share, p50/p99."""
+    svc = BatchedEnsembleService(WallRuntime(), 8, 3, 8, tick=None,
+                                 max_ops_per_tick=4)
+    svc.set_tenant_label(0, "hot")
+    svc.set_tenant_label(1, "quiet")
+    futs = []
+    for i in range(40):
+        futs.append(svc.kput(0, f"k{i % 4}", b"x" * 32))
+    for i in range(4):
+        futs.append(svc.kput(1, "q", b"y"))
+    while any(svc.queues):
+        svc.flush()
+    assert all(f.done and f.value[0] == "ok" for f in futs)
+    ts = svc.tenant_stats()
+    hot, quiet = ts["hot"], ts["quiet"]
+    assert hot["ops"] == 40 and quiet["ops"] == 4
+    assert hot["commits"] == 40 and quiet["commits"] == 4
+    assert hot["put_bytes"] == 40 * 32 and quiet["put_bytes"] == 4
+    assert hot["device_rounds"] >= quiet["device_rounds"] > 0
+    assert 0 < hot["device_round_share"] <= 1.0
+    assert hot["p99_ms"] >= hot["p50_ms"] >= 0
+    # leased fast reads count into the tenant ledger without a flush
+    f = svc.kget(0, "k0")
+    assert f.done and f.value[0] == "ok"
+    assert svc.read_fastpath_hits >= 1
+    assert svc.tenant_stats()["hot"]["ops"] == 41
+    # the labels surface in every export: stats(), the registry
+    # snapshot, and the Prometheus text
+    assert "hot" in svc.stats()["tenants"]
+    snap = svc.obs_registry.snapshot()
+    assert snap["retpu_tenant_ops_total"]["hot"] == 41
+    assert 'retpu_tenant_ops_total{tenant="hot"} 41' in \
+        svc.obs_registry.render_prometheus()
+    # a tenant spanning several rows is ONE tenant: rows sharing a
+    # label aggregate instead of overwriting each other
+    svc.set_tenant_label(2, "hot")
+    f = svc.kput(2, "x", b"zz")
+    while not f.done:
+        svc.flush()
+    agg = svc.tenant_stats()["hot"]
+    assert agg["rows"] == [0, 2]
+    assert agg["ops"] == 42 and agg["put_bytes"] == 40 * 32 + 2
+    svc.stop()
+
+
+def test_tenant_ledger_resets_on_row_recycle():
+    svc = BatchedEnsembleService(WallRuntime(), 4, 3, 8, tick=None,
+                                 max_ops_per_tick=2, dynamic=True)
+    row = svc.create_ensemble("t1")
+    fut = svc.kput(row, "k", b"v")
+    while not fut.done:
+        svc.flush()
+    assert svc.tenant_stats()["t1"]["ops"] == 1
+    assert svc.destroy_ensemble("t1")
+    row2 = svc.create_ensemble("t2")
+    assert row2 == row  # recycled
+    assert svc.tenant_ops[row] == 0, \
+        "a recycled row must start with a clean tenant ledger"
+    assert "t1" not in svc.tenant_stats()
+    svc.stop()
+
+
+# -- RETPU_OBS=0 short-circuit ---------------------------------------------
+
+def test_obs_disabled_records_nothing(monkeypatch):
+    monkeypatch.setenv("RETPU_OBS", "0")
+    svc = BatchedEnsembleService(WallRuntime(), 4, 3, 8, tick=None,
+                                 max_ops_per_tick=2)
+    fut = svc.kput(0, "k", b"v")
+    while not fut.done:
+        svc.flush()
+    assert fut.value[0] == "ok"
+    assert svc.stats()["obs_enabled"] is False
+    assert not svc.flight.records
+    assert int(svc.tenant_ops.sum()) == 0
+    assert int(svc._tenant_lat.sum()) == 0
+    svc.stop()
+
+
+# -- Tracer: bounded finished ring + registry fold --------------------------
+
+def test_tracer_finished_ring_bounded_and_registry_fold():
+    from riak_ensemble_tpu.utils.trace import Tracer
+
+    class _RT:
+        now = 0.0
+        trace = None
+
+    rt = _RT()
+    reg = obs.MetricsRegistry()
+    tr = Tracer(rt, max_finished=16, registry=reg).install()
+    for i in range(100):
+        rt.now = float(i)
+        sid = tr.begin("op", 0)
+        rt.now = float(i) + 0.5
+        tr.finish(sid, "ok")
+        tr._on_event("tick", {})
+    # the finished ring is bounded; the counters stay exact
+    assert len(tr.finished) == 16
+    assert tr.counters["span:op"] == 100
+    assert tr.counters["tick"] == 100
+    # the registry mirror: event counts + span duration histogram
+    snap = reg.snapshot()
+    assert snap["retpu_trace_events_total"]["tick"] == 100
+    h = reg.histogram("retpu_trace_span_ms").labels("op")
+    assert h.count == 100
+    assert tr.percentiles("op")[0.5] == 0.5
+    tr.uninstall()
+
+
+# -- svcnode metrics verb ---------------------------------------------------
+
+def test_svcnode_metrics_verb():
+    import asyncio
+
+    from riak_ensemble_tpu import svcnode
+
+    async def run():
+        server = await svcnode.serve(4, 3, 8, port=0, tick=0.002,
+                                     config=fast_test_config())
+        client = svcnode.ServiceClient(server.host, server.port)
+        await client.connect()
+        try:
+            r = await client.kput(0, "k", b"v")
+            assert r[0] == "ok"
+            snap = await client.metrics()
+            assert isinstance(snap, dict)
+            assert snap["retpu_flushes_total"] >= 1
+            assert snap["retpu_ops_served_total"] >= 1
+            assert "retpu_flush_total_ms" in snap
+            txt = await client.metrics("prometheus")
+            assert isinstance(txt, str)
+            assert "# TYPE retpu_flushes_total counter" in txt
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(run())
