@@ -285,6 +285,37 @@ int retpu_store_put(void* h, const uint8_t* key, uint32_t klen,
   return 0;
 }
 
+// Arena batch put: `idx` holds n rows of (key_off, key_len, val_off,
+// val_len) into `arena`; rows with key_len <= 0 are skipped (the
+// resolve kernel emits those for uncommitted lanes).  One ctypes call
+// and one lock acquisition appends a whole flush's WAL records with
+// byte-identical framing to per-record retpu_store_put calls.
+int retpu_store_put_many(void* h, const uint8_t* arena,
+                         const int64_t* idx, int64_t n) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  for (int64_t i = 0; i < n; i++) {
+    const int64_t klen = idx[i * 4 + 1];
+    if (klen <= 0) {
+      continue;
+    }
+    std::string k(reinterpret_cast<const char*>(arena + idx[i * 4]),
+                  static_cast<size_t>(klen));
+    std::string v(
+        reinterpret_cast<const char*>(arena + idx[i * 4 + 2]),
+        static_cast<size_t>(idx[i * 4 + 3]));
+    s->data[k] = v;
+    s->append_record(1, k, v);
+    // per-record threshold check, matching retpu_store_put — a batch
+    // crossing the bound must compact at the same record a sequence
+    // of single puts would (the byte-identical-framing contract)
+    if (s->log_records >= kCompactThreshold) {
+      s->compact();
+    }
+  }
+  return 0;
+}
+
 // Returns value length, or -1 if absent.  Caller provides the buffer;
 // call with buf=null to size first (value may not change between the
 // two calls from one Python thread holding the store).

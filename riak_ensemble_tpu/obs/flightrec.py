@@ -27,15 +27,27 @@ from typing import Any, Dict, List, Optional
 
 from riak_ensemble_tpu.obs.fingerprint import box_fingerprint
 
-__all__ = ["FlightRecorder", "DUMP_SCHEMA", "META_FIELDS"]
+__all__ = ["FlightRecorder", "DUMP_SCHEMA", "META_FIELDS",
+           "DERIVED_MARKS"]
 
 DUMP_SCHEMA = "retpu-flight-dump-v1"
 
-#: per-flush record fields that are shape/identity metadata, not
-#: latency marks — shared with bench's tail attribution so the two
-#: dominant-mark argmaxes can never drift apart
-META_FIELDS = ("k", "total", "enqueue", "flush_id", "t", "a_width",
-               "payload_bytes", "queued_rounds", "in_flight")
+#: DERIVED latency marks — sums/subdivisions of other marks
+#: ('enqueue' = h2d + dispatch; resolve_native/resolve_fallback =
+#: the resolve half's per-arm share).  THE canonical list: the
+#: service's total sums (batched_host.DERIVED_MARKS) and the flight
+#: recorder's dominant-mark argmax both derive from it, so a new
+#: derived mark can never be additive in one place and excluded in
+#: the other (it would dominate every tail attribution).
+DERIVED_MARKS = ("enqueue", "resolve_native", "resolve_fallback")
+
+#: per-flush record fields that are shape/identity metadata or
+#: derived marks, not additive latency components — shared with
+#: bench's tail attribution so the two dominant-mark argmaxes can
+#: never drift apart
+META_FIELDS = ("k", "total") + DERIVED_MARKS + (
+    "flush_id", "t", "a_width", "payload_bytes", "queued_rounds",
+    "in_flight")
 
 
 class FlightRecorder:

@@ -89,8 +89,18 @@ import numpy as np
 from riak_ensemble_tpu import obs
 from riak_ensemble_tpu.config import Config
 from riak_ensemble_tpu.ops import engine as eng
+from riak_ensemble_tpu.parallel import resolve_native
 from riak_ensemble_tpu.runtime import Future, Runtime, Timer
 from riak_ensemble_tpu.types import NOTFOUND
+
+#: latency-record fields excluded from every ``total`` sum so the
+#: breakdown stays additive: the metadata fields plus the canonical
+#: derived-mark list (obs.flightrec.DERIVED_MARKS — 'enqueue' and the
+#: resolve_native/resolve_fallback arm attribution, which subdivide
+#: the resolve half by which arm ran without double-counting it).
+#: Single-sourced from flightrec so the flight recorder's
+#: dominant-mark argmax and these sums can never drift apart.
+DERIVED_MARKS = ("k", "total") + obs.flightrec.DERIVED_MARKS
 
 
 
@@ -474,7 +484,9 @@ class _InFlightLaunch:
     want_vsn: bool
     plan: Any               # WidePlan (wide launches) or None
     w_b: int                # wide plane width (plan only)
-    kind_np: Any            # host kind plane for wide routing masks
+    kind_np: Any            # host kind plane (wide routing masks +
+    #                         the native mirror scatter); None for
+    #                         device-resident execute() planes
     elect: Any              # [E] bool — this launch's election vector
     cand: Any               # [E] int32 — its candidates
     now: float              # runtime.now at enqueue (lease renewal)
@@ -491,6 +503,9 @@ class _InFlightLaunch:
     active: Any = None
     a_width: int = 0
     sliced: bool = False
+    #: host slot plane in op order (the native mirror scatter's
+    #: companion to ``kind_np``); None for device-resident planes
+    op_slot_np: Any = None
     #: flush path: the (ensemble, taken ops) pairs this launch serves
     taken: Any = None
     #: execute_async path: the client future + WAL planes + op count
@@ -643,16 +658,27 @@ class BatchedEnsembleService:
         #: kget_vsn serves.  Invalidated per-row on won elections
         #: (the epoch bump re-versions objects lazily on next device
         #: access); repopulated by every committed write's resolve and
-        #: refreshed by device reads.
-        self._slot_vsn: List[Dict[int, Tuple[int, int]]] = [
-            dict() for _ in range(n_ens)]
+        #: refreshed by device reads.  SLAB layout ([E, S, 2] int32 +
+        #: an [E, S] validity plane) rather than per-row dicts: the
+        #: native resolve kernel scatters a whole flush's committed
+        #: versions into it in one C pass, and the Python fallback
+        #: writes the same cells per-op (byte-identical slabs either
+        #: way — the tests' equivalence contract).
+        self._slot_vsn_np = np.zeros((n_ens, n_slots, 2), np.int32)
+        self._slot_vsn_ok = np.zeros((n_ens, n_slots), bool)
         #: committed device-native int32 per inline (RMW) slot — the
         #: value a fast read of a device-native key serves (the engine
         #: arrays hold it; slot_handle only carries the -1 sentinel).
-        #: Absent entries (fresh restore) miss to the device round,
-        #: which refreshes the mirror.
-        self._inline_value: List[Dict[int, int]] = [
-            dict() for _ in range(n_ens)]
+        #: Invalid entries (fresh restore) miss to the device round,
+        #: which refreshes the mirror.  Same slab layout as the vsn
+        #: mirror, same native/fallback write discipline.
+        self._inline_value_np = np.zeros((n_ens, n_slots), np.int32)
+        self._inline_value_ok = np.zeros((n_ens, n_slots), bool)
+        #: [E, S] storage-class slab kept in lockstep with
+        #: ``_inline_slots`` (every add/discard writes both): the
+        #: native kernel reads it to route leased-GET refreshes to the
+        #: right mirror without touching Python sets mid-pass.
+        self._inline_np = np.zeros((n_ens, n_slots), bool)
         #: per-slot count of QUEUED + IN-FLIGHT writes (put/CAS/RMW/
         #: tombstone): a fast read of a slot with any pending write
         #: falls back to the device round — the round orders it after
@@ -830,6 +856,14 @@ class BatchedEnsembleService:
         #: ``RETPU_OBS=0`` short-circuits every hot-path record; the
         #: answer is cached here so the gate is one attribute test.
         self._obs = obs.enabled()
+        #: native single-pass resolve kernel (RETPU_NATIVE_RESOLVE=0
+        #: or a missing toolchain pins the pure-Python fallback — the
+        #: oracle arm; docs/ARCHITECTURE.md §12).  Resolved at
+        #: construction like RETPU_OBS so the bench A/B can hold one
+        #: arm per live service.
+        self._native_resolve = resolve_native.get()
+        self.native_resolve_flushes = 0
+        self.fallback_resolve_flushes = 0
         self.obs_registry = obs.MetricsRegistry()
         self.flight = obs.FlightRecorder(name="svc")
         self._h_flush = self.obs_registry.histogram(
@@ -957,10 +991,11 @@ class BatchedEnsembleService:
         self.slot_gen[row] = {}
         self.slot_handle[row] = {}
         self._inline_slots[row] = set()
+        self._inline_np[row] = False
         self._queued_handle_writes[row] = {}
         self._recycle_pending[row] = []
-        self._slot_vsn[row] = {}
-        self._inline_value[row] = {}
+        self._slot_vsn_ok[row] = False
+        self._inline_value_ok[row] = False
         self._pending_writes[row] = {}
         self._corrupt_rows[row] = False
         # a recycled row starts with no watchers (the reference cleans
@@ -1476,11 +1511,13 @@ class BatchedEnsembleService:
         self.state = self.engine.rebuild_trees(st, jnp.asarray(mask))
         for key, slot, handle, ve, vs, payload in applied:
             self._inline_slots[ens].discard(slot)
-            self._inline_value[ens].pop(slot, None)
+            self._inline_np[ens, slot] = False
+            self._inline_value_ok[ens, slot] = False
             # installs carry their committed versions: the fast
             # path's vsn mirror adopts them (CAS-token continuity
             # extends to leased reads)
-            self._slot_vsn[ens][slot] = (ve, vs)
+            self._slot_vsn_np[ens, slot] = (ve, vs)
+            self._slot_vsn_ok[ens, slot] = True
             old = self.slot_handle[ens].pop(slot, 0)
             if old and old != handle:
                 # values-only drop, NEVER the handle pool: the handle
@@ -1733,6 +1770,7 @@ class BatchedEnsembleService:
             g = sg.get(s, 0) + 1
             sg[s] = g
             inline.add(s)
+            self._inline_np[ens, s] = True
             slot_l.append(s)
             pos_l.append(i)
             gen_l.append(g)
@@ -1836,18 +1874,18 @@ class BatchedEnsembleService:
             return "pending_write", None
         vsn: Any = None
         if want_vsn:
-            vsn = self._slot_vsn[ens].get(slot)
-            if vsn is None:
+            if not self._slot_vsn_ok[ens, slot]:
                 # unmirrored version (fresh restore / post-election
                 # invalidation): the device round re-versions and its
                 # resolve refreshes the mirror
                 return "vsn_unmirrored", None
+            ve, vs = self._slot_vsn_np[ens, slot]
+            vsn = (int(ve), int(vs))
         h = self.slot_handle[ens].get(slot, 0)
         if h == -1:
-            v = self._inline_value[ens].get(slot)
-            if v is None:
+            if not self._inline_value_ok[ens, slot]:
                 return "inline_unmirrored", None
-            out: Any = v
+            out: Any = int(self._inline_value_np[ens, slot])
         elif h:
             out = self.values.get(h, NOTFOUND)
         else:
@@ -1924,6 +1962,7 @@ class BatchedEnsembleService:
         # optimistic inline marking: a second kmodify racing this
         # one's commit must still see the slot as device-native
         self._inline_slots[ens].add(slot)
+        self._inline_np[ens, slot] = True
         self.rmw_device_fastpath += 1
         self._push(ens, _PendingOp(eng.OP_RMW, slot, operand, fut,
                                    key, gen, exp=(code, 0),
@@ -2348,6 +2387,9 @@ class BatchedEnsembleService:
         svc.slot_handle = host["slot_handle"]
         svc._inline_slots = [set(s) for s in host.get(
             "inline_slots", [[] for _ in range(n_ens)])]
+        for row, slots_ in enumerate(svc._inline_slots):
+            if slots_:  # keep the kernel's storage-class slab in step
+                svc._inline_np[row, list(slots_)] = True
         svc._recycle_pending = host["recycle_pending"]
         # restored pending recycles must re-enter the dirty set or
         # the sparse drain would never revisit them (leaked slots)
@@ -2483,21 +2525,27 @@ class BatchedEnsembleService:
                     # tenant.  Keyless records are bulk-array writes.
                     if key_obj is not None and handle:
                         self._inline_slots[ens].add(slot)
-                        self._inline_value[ens][slot] = handle
-                        self._slot_vsn[ens][slot] = (oe, os_)
+                        self._inline_np[ens, slot] = True
+                        self._inline_value_np[ens, slot] = handle
+                        self._inline_value_ok[ens, slot] = True
+                        self._slot_vsn_np[ens, slot] = (oe, os_)
+                        self._slot_vsn_ok[ens, slot] = True
                         self.slot_handle[ens][slot] = -1
                         self.key_slot[ens][key_obj] = slot
                         owners.setdefault(ens, {})[slot] = key_obj
                     else:
                         if key_obj is not None:
                             self._inline_slots[ens].discard(slot)
-                            self._inline_value[ens].pop(slot, None)
+                            self._inline_np[ens, slot] = False
+                            self._inline_value_ok[ens, slot] = False
                             self.slot_handle[ens].pop(slot, None)
                         owners.setdefault(ens, {})[slot] = None
                     continue
                 self._inline_slots[ens].discard(slot)
-                self._inline_value[ens].pop(slot, None)
-                self._slot_vsn[ens][slot] = (oe, os_)
+                self._inline_np[ens, slot] = False
+                self._inline_value_ok[ens, slot] = False
+                self._slot_vsn_np[ens, slot] = (oe, os_)
+                self._slot_vsn_ok[ens, slot] = True
                 if handle:
                     self.values[handle] = payload
                     self._next_handle = max(self._next_handle,
@@ -2608,6 +2656,7 @@ class BatchedEnsembleService:
                     # live values never reach this branch)
                     del self.key_slot[e][key]
                     self._inline_slots[e].discard(slot)
+                    self._inline_np[e, slot] = False
                     self.free_slots[e].append(slot)
                 # else: the slot was re-used meanwhile — drop the stale
                 # recycle request
@@ -2989,15 +3038,17 @@ class BatchedEnsembleService:
                                   lease_snapshot, donated)
             raise
         t2 = time.perf_counter()
+        host_planes = not isinstance(kind, jax.Array)
         return _InFlightLaunch(
             flat=flat, rec={"h2d": t1 - t0, "dispatch": t2 - t1},
             k=k, k_eff=k_eff, want_vsn=want_vsn, plan=plan, w_b=w_b,
-            kind_np=None if plan is None else np.asarray(kind),
+            kind_np=np.asarray(kind) if host_planes else None,
             elect=elect, cand=cand, now=now,
             state_snapshot=state_snapshot,
             leader_snapshot=leader_snapshot,
             lease_snapshot=lease_snapshot, donated=donated,
             active=active, a_width=a_width, sliced=sliced,
+            op_slot_np=np.asarray(slot) if host_planes else None,
             flush_id=obs.next_flush_id() if self._obs else 0)
 
     def _fetch_packed(self, fl: _InFlightLaunch) -> np.ndarray:
@@ -3052,11 +3103,36 @@ class BatchedEnsembleService:
             rec[wait_key] = time.perf_counter() - t2
             t3 = time.perf_counter()
             e, m = self.n_ens, self.n_peers
+            # Native single-pass unpack (docs/ARCHITECTURE.md §12):
+            # one C traversal scatters the packed payload straight
+            # into full-width planes; election-only launches (k == 0)
+            # and layout surprises fall back to the Python oracle.
+            planes8 = None
+            if self._native_resolve is not None and fl.k_eff:
+                planes8 = self._native_resolve.unpack(
+                    flat, e, m, fl.k_eff, fl.want_vsn, fl.active,
+                    fl.a_width, fl.sliced)
+            native_arm = planes8 is not None
+            if not native_arm:
+                planes8 = unpack_results(flat, e, m, fl.k_eff,
+                                         fl.want_vsn, active=fl.active,
+                                         a_width=fl.a_width,
+                                         sliced=fl.sliced)
             (won_np, quorum_ok, corrupt_np, committed, get_ok, found,
-             value, vsn) = unpack_results(flat, e, m, fl.k_eff,
-                                          fl.want_vsn, active=fl.active,
-                                          a_width=fl.a_width,
-                                          sliced=fl.sliced)
+             value, vsn) = planes8
+            # per-flush attribution of the resolve half's arm: the
+            # derived resolve_native/resolve_fallback marks accumulate
+            # every native-eligible stage (unpack here; the mirror
+            # scatter and WAL encode add theirs), excluded from the
+            # additive total like 'enqueue'
+            arm_key = ("resolve_native" if native_arm
+                       else "resolve_fallback")
+            rec[arm_key] = (rec.get(arm_key, 0.0)
+                            + (time.perf_counter() - t3))
+            if native_arm:
+                self.native_resolve_flushes += 1
+            else:
+                self.fallback_resolve_flushes += 1
             # Compaction observability: the actual d2h bytes vs the
             # full-width [K, E] layout's, and the packed-grid
             # occupancy (skewed/partial load drives this toward 0).
@@ -3152,8 +3228,7 @@ class BatchedEnsembleService:
         # values).  Only on a SUCCESSFUL launch: the except path
         # rolled the election back.
         if won_np.any():
-            for e2 in np.nonzero(won_np)[0].tolist():
-                self._slot_vsn[e2].clear()
+            self._slot_vsn_ok[won_np] = False
         # Leader changes (won elections) notify watchers only on a
         # SUCCESSFUL launch — the except path above rolled the mirror
         # back, and a watcher told of a rolled-back leader would act
@@ -3173,7 +3248,7 @@ class BatchedEnsembleService:
         rec["k"] = fl.k
         rec["enqueue"] = rec.get("h2d", 0.0) + rec.get("dispatch", 0.0)
         rec["total"] = sum(v for c, v in rec.items()
-                           if c not in ("k", "total", "enqueue"))
+                           if c not in DERIVED_MARKS)
         self.lat_records.append(rec)
         return committed, get_ok, found, value, vsn
 
@@ -3275,7 +3350,9 @@ class BatchedEnsembleService:
         exchange (corruption-triggered), wal (durability barrier),
         resolve (future fan-out).  'enqueue' is a derived mark
         (h2d + dispatch — the whole enqueue half) excluded from the
-        'total' sum.  ``svc_compaction`` (the deferred WAL fold, a
+        'total' sum, as are 'resolve_native'/'resolve_fallback' (the
+        resolve half's per-arm share — unpack + mirror scatter + WAL
+        encode attributed to whichever arm ran, ARCHITECTURE §12).  ``svc_compaction`` (the deferred WAL fold, a
         rare EVENT rather than a per-launch component) is reported
         over its own occurrences only — averaging it into 1000+
         launch records would both hide the pause (p99 = 0) and
@@ -3353,6 +3430,15 @@ class BatchedEnsembleService:
             "obs_enabled": self._obs,
             "flight_anomalies": self.flight.anomalies,
             "tenants": self.tenant_stats(top=8),
+            # native single-pass resolve kernel (ARCHITECTURE §12):
+            # which arm each settled flush's resolve half ran on —
+            # the per-flush split rides the resolve_native /
+            # resolve_fallback latency marks
+            "native_resolve": {
+                "enabled": self._native_resolve is not None,
+                "flushes": self.native_resolve_flushes,
+                "fallback_flushes": self.fallback_resolve_flushes,
+            },
         }
 
     def _lease_valid_fraction(self) -> float:
@@ -4227,12 +4313,15 @@ class BatchedEnsembleService:
         t_wal = time.perf_counter()
         if self._wal is not None:
             try:
-                self._log_wal(taken, planes)
+                self._log_wal(taken, planes, rec=rec)
             except Exception as exc:
                 wal_err = exc
         t_res = time.perf_counter()
         served = self._resolve_flush(taken, planes,
-                                     ack=wal_err is None)
+                                     ack=wal_err is None,
+                                     op_planes=(fl.kind_np,
+                                                fl.op_slot_np),
+                                     rec=rec)
         t_end = time.perf_counter()
         # Finish the breakdown the launch recorded: oldest-op queue
         # wait, WAL append+sync, per-future resolve.  Per-component
@@ -4245,7 +4334,7 @@ class BatchedEnsembleService:
         rec["wal"] = t_res - t_wal
         rec["resolve"] = t_end - t_res
         rec["total"] = sum(v for c, v in rec.items()
-                           if c not in ("k", "total", "enqueue"))
+                           if c not in DERIVED_MARKS)
         if self._obs:
             self._obs_flush_settled(fl)
         return served, wal_err
@@ -4268,9 +4357,16 @@ class BatchedEnsembleService:
             except Exception as exc:
                 self._safe_resolve(fl.exec_fut, "failed")
                 return 0, exc
+        t_res = time.perf_counter()
         self.ops_served += fl.exec_ops
         self._safe_resolve(fl.exec_fut,
                            (committed, get_ok, found, value))
+        # the future fan-out is the execute path's whole resolve
+        # stage; recording it here gives the pipelined bench loop's
+        # latency_breakdown a `resolve` entry like the flush path's
+        fl.rec["resolve"] = time.perf_counter() - t_res
+        fl.rec["total"] = sum(v for c, v in fl.rec.items()
+                              if c not in DERIVED_MARKS)
         if self._obs:
             self._obs_flush_settled(fl)
         return fl.exec_ops, None
@@ -4283,12 +4379,23 @@ class BatchedEnsembleService:
         bearing position for an older one."""
         return []
 
-    def _log_wal(self, taken, planes) -> None:
+    def _log_wal(self, taken, planes, rec=None) -> None:
         """Append this flush's committed client writes to the WAL
         (latest record per (ens, slot)); called BEFORE any future
-        resolves."""
+        resolves.
+
+        Native arm: batch-only flushes whose keys/payloads fit the
+        kernel's pickle subset (str keys, bytes/None payloads) encode
+        every record into one byte arena in a single C pass and the
+        WAL appends it verbatim (:meth:`ServiceWAL.log_arena`) —
+        byte-identical store contents to the Python path below, which
+        remains the oracle and the fallback (scalar write ops, exotic
+        key/payload types, RETPU_NATIVE_RESOLVE=0)."""
         committed, _get_ok, _found, value, vsn = planes
         if committed is None:
+            return
+        if (self._native_resolve is not None and vsn is not None
+                and self._log_wal_native(taken, planes, rec)):
             return
         committed_l = committed.tolist()
         vsn_l = vsn.tolist()
@@ -4341,6 +4448,104 @@ class BatchedEnsembleService:
                                   None, True)))
         if recs:
             self._wal.log(recs + self._wal_extra_records())
+
+    def _log_wal_native(self, taken, planes, rec=None) -> bool:
+        """Single-pass WAL encode (docs/ARCHITECTURE.md §12): gather
+        the flush's write lanes as flat arrays + joined key/payload
+        arenas (bulk C-level string ops, no per-record pickle), hand
+        them to the kernel, and append the returned byte arena
+        verbatim.  Returns False when any lane is outside the native
+        subset — the caller's Python path then logs EVERY record, so
+        record order (latest-per-key within the flush) is preserved
+        exactly."""
+        committed, _get_ok, _found, value, vsn = planes
+        t0 = time.perf_counter()
+        lane_j: List[int] = []
+        lane_e: List[int] = []
+        lane_slot: List[int] = []
+        lane_f2: List[int] = []
+        lane_inl: List[int] = []
+        keys: List[str] = []
+        pays: List[Any] = []
+        values = self.values
+        for e, ops in taken:
+            j = -1
+            for op in ops:
+                if not isinstance(op, _PendingBatch):
+                    j += 1
+                    if op.kind != eng.OP_GET:
+                        # scalar write lanes interleave with batch
+                        # records on the same (ens, slot): only the
+                        # Python walk preserves that order
+                        return False
+                    continue
+                if op.kind in (eng.OP_PUT, eng.OP_CAS, eng.OP_RMW):
+                    ks = op.keys
+                    if ks is None or not all(
+                            type(kk) is str for kk in ks):
+                        return False
+                    if op.kind == eng.OP_RMW:
+                        pays.extend([None] * op.n)
+                        lane_f2.extend([0] * op.n)
+                        lane_inl.extend([1] * op.n)
+                    else:
+                        for h in op.handle:
+                            p = values.get(h) if h else None
+                            if p is not None and type(p) is not bytes:
+                                return False
+                            pays.append(p)
+                        lane_f2.extend(op.handle)
+                        lane_inl.extend([0] * op.n)
+                    keys.extend(ks)
+                    lane_j.extend(range(j + 1, j + 1 + op.n))
+                    lane_e.extend([e] * op.n)
+                    lane_slot.extend(op.slot)
+                j += op.n
+        if not lane_j:
+            return True  # read-only flush: nothing to log
+        joined = "".join(keys)
+        key_arena = joined.encode("utf-8")
+        if len(key_arena) != len(joined):
+            return False  # non-ascii keys: char lens != byte lens
+        n = len(lane_j)
+        key_len = np.fromiter(map(len, keys), np.int64, n)
+        key_off = np.zeros((n,), np.int64)
+        np.cumsum(key_len[:-1], out=key_off[1:])
+        pay_len = np.fromiter(
+            (-1 if p is None else len(p) for p in pays), np.int64, n)
+        if int((key_len + np.maximum(pay_len, 0)).max()) >= 65500:
+            # CPython's pickler frames in ~64 KiB units: once a
+            # record's body reaches FRAME_SIZE_TARGET it splits
+            # frames at opcode boundaries (and writes >= 64 KiB
+            # str/bytes out-of-frame entirely).  The kernel emits ONE
+            # frame per record body, so oversized records would
+            # diverge from the oracle byte-for-byte — route the flush
+            # to Python.  65500 = the target minus the record's
+            # worst-case non-payload opcode overhead.
+            return False
+        pay_arena = b"".join(p for p in pays if p is not None)
+        pay_off = np.zeros((n,), np.int64)
+        np.cumsum(np.maximum(pay_len, 0)[:-1], out=pay_off[1:])
+        out = self._native_resolve.wal_encode(
+            self.n_ens, np.asarray(lane_j, np.int32),
+            np.asarray(lane_e, np.int32),
+            np.asarray(lane_slot, np.int32),
+            np.asarray(lane_f2, np.int32),
+            np.asarray(lane_inl, np.uint8),
+            np.zeros((n,), np.uint8), key_off, key_len, key_arena,
+            pay_off, pay_len, pay_arena, committed, value, vsn)
+        if out is None:
+            return False
+        arena, idx = out
+        idx = idx[idx[:, 1] > 0]  # drop uncommitted lanes
+        if rec is not None:
+            dt = time.perf_counter() - t0
+            rec["resolve_native"] = rec.get("resolve_native",
+                                            0.0) + dt
+        if len(idx):
+            self._wal.log_arena(arena, idx,
+                                self._wal_extra_records())
+        return True
 
     def _safe_resolve(self, fut: Future, result: Any) -> None:
         """Resolve a client future, containing waiter exceptions:
@@ -4406,9 +4611,15 @@ class BatchedEnsembleService:
         self._safe_resolve(op.fut, "failed")
 
     def _resolve_batch(self, e: int, j: int, op: _PendingBatch,
-                       planes, ack: bool, ack_reads: bool = True) -> None:
+                       planes, ack: bool, ack_reads: bool = True,
+                       native_mirrors: bool = False) -> None:
         """Resolve one batch entry from result-plane column slices —
-        the vectorized counterpart of the per-op resolve loop."""
+        the vectorized counterpart of the per-op resolve loop.  With
+        ``native_mirrors`` the kernel already scattered this flush's
+        ``_slot_vsn``/``_inline_value`` slab updates, so the loop
+        keeps only the Python-owned bookkeeping (handles, recycles,
+        the pending-write index, the storage-class set, the client
+        results)."""
         committed, get_ok, found, value, vsn = planes
         n = op.n
         results: List[Any] = []
@@ -4427,8 +4638,11 @@ class BatchedEnsembleService:
             self._recycle_dirty.add(e)
             release = self._release_handle
             inline = self._inline_slots[e]
-            inline_val = self._inline_value[e]
-            slot_vsn = self._slot_vsn[e]
+            inline_row = self._inline_np[e]
+            inline_val_np = self._inline_value_np[e]
+            inline_val_ok = self._inline_value_ok[e]
+            vsn_row = self._slot_vsn_np[e]
+            vsn_ok_row = self._slot_vsn_ok[e]
             unnote_w = self._unnote_write
             for comm, s, h, g, key, vs in zip(comm_l, slot_l,
                                               handle_l, gen_l, keys,
@@ -4448,8 +4662,11 @@ class BatchedEnsembleService:
                 if h:
                     slot_handle[s] = h
                 inline.discard(s)
-                inline_val.pop(s, None)
-                slot_vsn[s] = tuple(vs)  # mirror before the ack
+                inline_row[s] = False
+                if not native_mirrors:
+                    inline_val_ok[s] = False
+                    vsn_row[s] = vs  # mirror before the ack
+                    vsn_ok_row[s] = True
                 append(("ok", tuple(vs)) if ack else "failed")
         elif op.kind == eng.OP_RMW:
             comm_l = committed[j:j + n, e].tolist()
@@ -4457,8 +4674,11 @@ class BatchedEnsembleService:
             val_l = value[j:j + n, e].tolist()
             slot_handle = self.slot_handle[e]
             inline = self._inline_slots[e]
-            inline_val = self._inline_value[e]
-            slot_vsn = self._slot_vsn[e]
+            inline_row = self._inline_np[e]
+            inline_val_np = self._inline_value_np[e]
+            inline_val_ok = self._inline_value_ok[e]
+            vsn_row = self._slot_vsn_np[e]
+            vsn_ok_row = self._slot_vsn_ok[e]
             release = self._release_handle
             recycle = self._recycle_pending[e].append
             self._recycle_dirty.add(e)
@@ -4477,13 +4697,19 @@ class BatchedEnsembleService:
                     release(old)
                 if v:  # live value; a computed 0 is the tombstone
                     slot_handle[s] = -1
-                    inline_val[s] = v  # mirror before the ack
+                    if not native_mirrors:  # mirror before the ack
+                        inline_val_np[s] = v
+                        inline_val_ok[s] = True
                 else:
-                    inline_val.pop(s, None)
+                    if not native_mirrors:
+                        inline_val_ok[s] = False
                     if key is not None:  # tombstone: recycle the slot
                         recycle((key, s, g))
                 inline.add(s)
-                slot_vsn[s] = tuple(vs)
+                inline_row[s] = True
+                if not native_mirrors:
+                    vsn_row[s] = vs
+                    vsn_ok_row[s] = True
                 append(("ok", tuple(vs)) if ack else "failed")
         else:  # OP_GET batch
             ok_l = get_ok[j:j + n, e].tolist()
@@ -4493,8 +4719,10 @@ class BatchedEnsembleService:
                     else [None] * n)
             values = self.values
             inline = self._inline_slots[e]
-            inline_val = self._inline_value[e]
-            slot_vsn = self._slot_vsn[e]
+            inline_val_np = self._inline_value_np[e]
+            inline_val_ok = self._inline_value_ok[e]
+            vsn_row = self._slot_vsn_np[e]
+            vsn_ok_row = self._slot_vsn_ok[e]
             want_vsn = op.want_vsn
             for ok, fnd, v, vs, s in zip(ok_l, found_l, val_l, vs_l,
                                          op.slot):
@@ -4502,13 +4730,16 @@ class BatchedEnsembleService:
                     if fnd and v != 0:
                         if s in inline:
                             out = v
-                            inline_val[s] = v  # refresh fast mirror
+                            if not native_mirrors:  # refresh mirror
+                                inline_val_np[s] = v
+                                inline_val_ok[s] = True
                         else:
                             out = values.get(v, NOTFOUND)
                     else:
                         out = NOTFOUND
-                    if vs is not None:
-                        slot_vsn[s] = tuple(vs)  # refresh fast mirror
+                    if vs is not None and not native_mirrors:
+                        vsn_row[s] = vs  # refresh fast mirror
+                        vsn_ok_row[s] = True
                     append(("ok", out, tuple(vs)) if want_vsn
                            else ("ok", out))
                 else:
@@ -4517,7 +4748,8 @@ class BatchedEnsembleService:
                       self._safe_resolve)
 
     def _resolve_flush(self, taken, planes, ack: bool = True,
-                       ack_reads: bool = True) -> int:
+                       ack_reads: bool = True, op_planes=None,
+                       rec=None) -> int:
         """Resolve every taken op from the result planes.  With
         ``ack=False`` (the WAL write failed) committed writes keep
         their device-side bookkeeping — the commit is real — but
@@ -4525,22 +4757,60 @@ class BatchedEnsembleService:
         don't need the disk, so they survive ``ack=False``;
         ``ack_reads=False`` fails them too — the replication group
         uses it when the HOST quorum was lost, where serving a read
-        would mean a minority/deposed leader answering clients."""
+        would mean a minority/deposed leader answering clients.
+
+        ``op_planes`` is the launch's host (kind, slot) op-plane pair:
+        when present and the native resolve kernel is loaded, one C
+        pass scatters every committed mirror update
+        (``_slot_vsn``/``_inline_value`` slabs, leased-GET refreshes)
+        in the loop's exact per-column round order, and the per-op
+        loops below skip their mirror writes — byte-identical slabs
+        either way."""
         committed, get_ok, found, value, vsn = planes
 
-        # Per-op resolve loop: convert the result planes to plain
-        # Python lists ONCE (C-speed bulk conversion) — per-op numpy
-        # scalar indexing costs ~5x more than list indexing at
-        # thousands of ops per flush.
         if committed is None:  # k == 0: election-only launch, no ops
             assert not taken, "ops taken but no result planes"
             self._drain_recycles()
             return 0
-        committed_l = committed.tolist()
-        get_ok_l = get_ok.tolist()
-        found_l = found.tolist()
-        value_l = value.tolist()
-        vsn_l = vsn.tolist()
+        native_mirrors = False
+        if (self._native_resolve is not None and taken
+                and op_planes is not None
+                and op_planes[0] is not None
+                and op_planes[1] is not None):
+            t0 = time.perf_counter()
+            n_cols = len(taken)
+            cols = np.fromiter((e for e, _ops in taken), np.int32,
+                               n_cols)
+            kcounts = np.fromiter(
+                (sum(op.n for op in ops) for _e, ops in taken),
+                np.int32, n_cols)
+            native_mirrors = self._native_resolve.scatter_mirrors(
+                self.n_ens, self.n_slots, op_planes[0], op_planes[1],
+                committed, get_ok, found, value, vsn, cols, kcounts,
+                ack_reads,
+                (eng.OP_PUT, eng.OP_CAS, eng.OP_GET, eng.OP_RMW),
+                self._slot_vsn_np, self._slot_vsn_ok,
+                self._inline_value_np, self._inline_value_ok,
+                self._inline_np)
+            if native_mirrors and rec is not None:
+                dt = time.perf_counter() - t0
+                rec["resolve_native"] = rec.get("resolve_native",
+                                                0.0) + dt
+
+        # Per-op resolve loop: convert the result planes to plain
+        # Python lists ONCE (C-speed bulk conversion) — per-op numpy
+        # scalar indexing costs ~5x more than list indexing at
+        # thousands of ops per flush.  Batch-only flushes (the keyed
+        # vectorized surface) never touch the full planes per op, so
+        # the conversion is LAZY: built only when a scalar op exists.
+        committed_l = get_ok_l = found_l = value_l = vsn_l = None
+        if any(not isinstance(op, _PendingBatch)
+               for _e, ops in taken for op in ops):
+            committed_l = committed.tolist()
+            get_ok_l = get_ok.tolist()
+            found_l = found.tolist()
+            value_l = value.tolist()
+            vsn_l = vsn.tolist()
         served = 0
         puts = (eng.OP_PUT, eng.OP_CAS)
         for e, ops in taken:
@@ -4549,7 +4819,7 @@ class BatchedEnsembleService:
             for op in ops:
                 if isinstance(op, _PendingBatch):
                     self._resolve_batch(e, j + 1, op, planes, ack,
-                                        ack_reads)
+                                        ack_reads, native_mirrors)
                     served += op.n
                     j += op.n
                     continue
@@ -4571,10 +4841,15 @@ class BatchedEnsembleService:
                         # a committed put/CAS flips a device-native
                         # slot back to handle storage
                         self._inline_slots[e].discard(op.slot)
-                        self._inline_value[e].pop(op.slot, None)
+                        self._inline_np[e, op.slot] = False
                         # mirror-before-ack: a fast read issued after
                         # this future resolves must see the write
-                        self._slot_vsn[e][op.slot] = tuple(vsn_l[j][e])
+                        # (the native pass already scattered it)
+                        if not native_mirrors:
+                            self._inline_value_ok[e, op.slot] = False
+                            self._slot_vsn_np[e, op.slot] = \
+                                vsn_l[j][e]
+                            self._slot_vsn_ok[e, op.slot] = True
                         self._safe_resolve(
                             op.fut, ("ok", tuple(vsn_l[j][e]))
                             if ack else "failed")
@@ -4595,15 +4870,24 @@ class BatchedEnsembleService:
                         # arm recycles; the device arm must match).
                         if value_l[j][e]:
                             slot_handle[op.slot] = -1
-                            self._inline_value[e][op.slot] = \
-                                value_l[j][e]
+                            if not native_mirrors:
+                                self._inline_value_np[e, op.slot] = \
+                                    value_l[j][e]
+                                self._inline_value_ok[e, op.slot] = \
+                                    True
                         else:
-                            self._inline_value[e].pop(op.slot, None)
+                            if not native_mirrors:
+                                self._inline_value_ok[e, op.slot] = \
+                                    False
                             if op.key is not None:
                                 self._queue_recycle(
                                     e, (op.key, op.slot, op.gen))
                         self._inline_slots[e].add(op.slot)
-                        self._slot_vsn[e][op.slot] = tuple(vsn_l[j][e])
+                        self._inline_np[e, op.slot] = True
+                        if not native_mirrors:
+                            self._slot_vsn_np[e, op.slot] = \
+                                vsn_l[j][e]
+                            self._slot_vsn_ok[e, op.slot] = True
                         self._safe_resolve(
                             op.fut, ("ok", tuple(vsn_l[j][e]))
                             if ack else "failed")
@@ -4619,7 +4903,11 @@ class BatchedEnsembleService:
                                 out = v
                                 # refresh the fast path's inline
                                 # mirror from the device read
-                                self._inline_value[e][op.slot] = v
+                                if not native_mirrors:
+                                    self._inline_value_np[
+                                        e, op.slot] = v
+                                    self._inline_value_ok[
+                                        e, op.slot] = True
                             else:
                                 out = self.values.get(v, NOTFOUND)
                         else:
@@ -4630,7 +4918,10 @@ class BatchedEnsembleService:
                         # device read also refreshes the fast path's
                         # vsn mirror (repopulating it after the
                         # post-election invalidation).
-                        self._slot_vsn[e][op.slot] = tuple(vsn_l[j][e])
+                        if not native_mirrors:
+                            self._slot_vsn_np[e, op.slot] = \
+                                vsn_l[j][e]
+                            self._slot_vsn_ok[e, op.slot] = True
                         self._safe_resolve(
                             op.fut, ("ok", out, tuple(vsn_l[j][e]))
                             if op.want_vsn else ("ok", out))

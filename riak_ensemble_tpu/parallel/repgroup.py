@@ -274,6 +274,10 @@ def install_state(svc: BatchedEnsembleService, dump: Tuple) -> None:
     inline = (rest[0] if rest
               else [[] for _ in range(svc.n_ens)])
     svc._inline_slots = [set(int(s) for s in row) for row in inline]
+    svc._inline_np[:] = False
+    for row, slots_ in enumerate(svc._inline_slots):
+        if slots_:  # storage-class slab rides with the sets
+            svc._inline_np[row, list(slots_)] = True
     svc.key_slot = [dict(pairs) for pairs in key_slot]
     svc.slot_handle = [{int(s): int(h) for s, h in pairs}
                        for pairs in slot_handle]
@@ -308,8 +312,8 @@ def rebuild_derived(svc: BatchedEnsembleService) -> None:
                              if s not in used]
         svc.slot_gen[e] = {}
         svc._recycle_pending[e] = []
-        svc._slot_vsn[e] = {}
-        svc._inline_value[e] = {}
+        svc._slot_vsn_ok[e] = False
+        svc._inline_value_ok[e] = False
 
 
 # -- incremental (Merkle) catch-up -------------------------------------------
@@ -676,7 +680,8 @@ def build_delta_entry(seq: int, k: int, committed: Optional[np.ndarray],
                       val: np.ndarray, quorum_ok: np.ndarray,
                       meta: List[Tuple],
                       n_slots: int = 65536,
-                      fid: int = 0) -> Tuple[Tuple, int, int]:
+                      fid: int = 0,
+                      native: Any = None) -> Tuple[Tuple, int, int]:
     """Build one delta entry from the leader's resolved planes.
 
     Returns ``(entry, crc, delta_bytes)`` — the wire entry tuple, the
@@ -688,9 +693,33 @@ def build_delta_entry(seq: int, k: int, committed: Optional[np.ndarray],
     ``fid`` is the leader's obs flush id, a trailing header field the
     replica tags its apply spans with (cross-process flush tracing);
     it rides outside the section CRC — tracing identity, not
-    replicated state."""
+    replicated state.
+
+    ``native`` is the loaded resolve kernel
+    (:mod:`riak_ensemble_tpu.parallel.resolve_native`): one C pass
+    then emits the committed-cell sections + CRC instead of the
+    nonzero/lexsort/unique/packbits numpy pipeline — byte-identical
+    output (the tests' contract), same wire entry either way."""
     j_dt = _idx_dtype(max(k, 1))
     s_dt = _idx_dtype(n_slots)
+    nat = None
+    if (native is not None and committed is not None
+            and committed.any()):
+        nat = native.delta_sections(
+            k, committed.shape[1], committed, value, kind, slot, val,
+            np.asarray(quorum_ok, bool),
+            (eng.OP_PUT, eng.OP_CAS, eng.OP_RMW), j_dt, s_dt)
+    if nat is not None:
+        cols, counts, jj, slots, vals, rmw_b, q_b, crc = nat
+        nbytes = sum(int(s.nbytes)
+                     for s in (cols, counts, jj, slots, vals, rmw_b,
+                               q_b))
+        entry = ("d", int(seq), int(k), int(jj.size),
+                 int(j_dt().nbytes), int(s_dt().nbytes),
+                 wire.Raw(cols), wire.Raw(counts), wire.Raw(jj),
+                 wire.Raw(slots), wire.Raw(vals), wire.Raw(rmw_b),
+                 wire.Raw(q_b), crc, meta, int(fid))
+        return entry, crc, nbytes
     if committed is not None and committed.any():
         jj, ee = np.nonzero(committed)
         order = np.lexsort((jj, ee))  # column-major, round order within
@@ -1172,8 +1201,10 @@ class ReplicaCore:
         rescan."""
         svc = self.svc
         svc._inline_slots[e].discard(slot)
-        svc._inline_value[e].pop(slot, None)
-        svc._slot_vsn[e][slot] = (int(ve), int(vs))
+        svc._inline_np[e, slot] = False
+        svc._inline_value_ok[e, slot] = False
+        svc._slot_vsn_np[e, slot] = (int(ve), int(vs))
+        svc._slot_vsn_ok[e, slot] = True
         old = svc.slot_handle[e].pop(slot, 0)
         if old > 0 and old != handle:
             svc.values.pop(old, None)
@@ -1201,16 +1232,20 @@ class ReplicaCore:
         old = svc.slot_handle[e].pop(slot, 0)
         if old > 0:
             svc.values.pop(old, None)
-        svc._slot_vsn[e][slot] = (int(ve), int(vs))
+        svc._slot_vsn_np[e, slot] = (int(ve), int(vs))
+        svc._slot_vsn_ok[e, slot] = True
         if value:
             svc._inline_slots[e].add(slot)
-            svc._inline_value[e][slot] = int(value)
+            svc._inline_np[e, slot] = True
+            svc._inline_value_np[e, slot] = int(value)
+            svc._inline_value_ok[e, slot] = True
             svc.slot_handle[e][slot] = -1
             if key is not None:
                 svc.key_slot[e][key] = slot
         else:
             svc._inline_slots[e].discard(slot)
-            svc._inline_value[e].pop(slot, None)
+            svc._inline_np[e, slot] = False
+            svc._inline_value_ok[e, slot] = False
             if key is not None:
                 svc.key_slot[e].pop(key, None)
 
@@ -1433,16 +1468,20 @@ class ReplicaCore:
                  if sl == s and k != key]
         for k in stale:
             svc.key_slot[e].pop(k, None)
-        svc._slot_vsn[e][s] = (int(ep), int(sq))
+        svc._slot_vsn_np[e, s] = (int(ep), int(sq))
+        svc._slot_vsn_ok[e, s] = True
         if handle == -1:
             svc._inline_slots[e].add(s)
-            svc._inline_value[e][s] = int(vl)
+            svc._inline_np[e, s] = True
+            svc._inline_value_np[e, s] = int(vl)
+            svc._inline_value_ok[e, s] = True
             svc.slot_handle[e][s] = -1
             if key is not None:
                 svc.key_slot[e][key] = s
             return
         svc._inline_slots[e].discard(s)
-        svc._inline_value[e].pop(s, None)
+        svc._inline_np[e, s] = False
+        svc._inline_value_ok[e, s] = False
         if handle:
             svc.values[handle] = payload
             svc.slot_handle[e][s] = handle
@@ -1529,7 +1568,7 @@ class _PendingEntry:
     outcome is known."""
 
     __slots__ = ("seq", "crc", "entry", "taken", "planes", "ack",
-                 "ack_reads", "shipped_at", "fid")
+                 "ack_reads", "shipped_at", "fid", "op_planes")
 
     def __init__(self, seq: int, crc: int, entry: Tuple,
                  shipped_at: float = 0.0, fid: int = 0) -> None:
@@ -1541,6 +1580,9 @@ class _PendingEntry:
         self.fid = fid
         self.taken: Optional[list] = None
         self.planes: Any = None
+        #: host (kind, slot) op planes — the native mirror scatter's
+        #: inputs, claimed with taken/planes and replayed at settle
+        self.op_planes: Any = None
         self.ack = True
         self.ack_reads = True
         #: runtime.now when the flush was enqueued — the base of any
@@ -2513,7 +2555,7 @@ class ReplicatedService(BatchedEnsembleService):
             entry_t, crc, nbytes = build_delta_entry(
                 seq, fl.k, committed, value, kind, slot, val,
                 fl.quorum_np, meta, n_slots=self.n_slots,
-                fid=fl.flush_id)
+                fid=fl.flush_id, native=self._native_resolve)
             self.group_stats["repl_delta_entries"] += 1
         else:
             entry_t, nbytes = build_full_entry(
@@ -2801,7 +2843,8 @@ class ReplicatedService(BatchedEnsembleService):
     # -- pipelined ack settlement -------------------------------------------
 
     def _resolve_flush(self, taken, planes, ack: bool = True,
-                       ack_reads: bool = True) -> int:
+                       ack_reads: bool = True, op_planes=None,
+                       rec=None) -> int:
         """Defer resolution until the flush's host-quorum outcome is
         in (an ack may never outrun the host quorum — READS INCLUDED:
         a minority/deposed leader serving reads would break
@@ -2813,9 +2856,12 @@ class ReplicatedService(BatchedEnsembleService):
         if entry is None:
             # single-lane mode / replica role: the plain barrier
             return super()._resolve_flush(taken, planes, ack=ack,
-                                          ack_reads=ack_reads)
+                                          ack_reads=ack_reads,
+                                          op_planes=op_planes,
+                                          rec=rec)
         self._unclaimed = None
         entry.taken, entry.planes = taken, planes
+        entry.op_planes = op_planes
         entry.ack, entry.ack_reads = ack, ack_reads
         self._drain_pending(down_to=self.repl_window)
         return 0
@@ -2966,7 +3012,8 @@ class ReplicatedService(BatchedEnsembleService):
             if entry.taken is not None:
                 super()._resolve_flush(entry.taken, entry.planes,
                                        ack=entry.ack and q,
-                                       ack_reads=entry.ack_reads and q)
+                                       ack_reads=entry.ack_reads and q,
+                                       op_planes=entry.op_planes)
 
     def flush(self) -> int:
         served = super().flush()

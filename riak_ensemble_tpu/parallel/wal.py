@@ -122,6 +122,13 @@ class PyLogStore:
         self._map[k] = v
         self._append(1, k, v)
 
+    def store_raw(self, k: bytes, v: bytes) -> None:
+        """Append a pre-pickled record verbatim (the native resolve
+        kernel's arena path) — byte-identical log framing to
+        :meth:`store` of the decoded terms."""
+        self._map[k] = v
+        self._append(1, k, v)
+
     def delete(self, key: Any) -> None:
         k = pickle.dumps(key, protocol=4)
         self._map.pop(k, None)
@@ -207,6 +214,30 @@ class ServiceWAL:
             flush()
         else:  # pragma: no cover - older store without flush-only
             self._store.sync()
+
+    def log_arena(self, arena, index, extra_records=()) -> None:
+        """Append pre-encoded (protocol-4 pickled) record pairs — the
+        native resolve kernel's byte arena — VERBATIM, plus ordinary
+        ``extra_records``, under the same single lock + sync barrier
+        as :meth:`log`.  ``index`` rows are (key_off, key_len,
+        val_off, val_len) into ``arena``; the resulting store contents
+        are byte-identical to ``log()`` of the decoded records (the
+        native/fallback equivalence contract)."""
+        with self._lock:
+            st = self._store
+            put_many = getattr(st, "put_many_raw", None)
+            if put_many is not None:
+                put_many(arena, index)
+            else:
+                for koff, klen, voff, vlen in index.tolist():
+                    st.store_raw(bytes(arena[koff:koff + klen]),
+                                 bytes(arena[voff:voff + vlen]))
+            for key, value in extra_records:
+                st.store(key, value)
+            if self.sync_mode == "fsync":
+                self._store.sync()
+            else:
+                self._flush_store()
 
     def delete(self, keys: List[Any]) -> None:
         """Remove records (e.g. a destroyed ensemble's kv entries)

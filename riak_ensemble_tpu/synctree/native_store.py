@@ -69,6 +69,31 @@ class NativeBackend:
         k, v = _enc(key), _enc(value)
         self._lib.retpu_store_put(self._handle, k, len(k), v, len(v))
 
+    def store_raw(self, k: bytes, v: bytes) -> None:
+        """Pre-pickled record append (the resolve kernel's arena
+        path): skips the Python-side encode, identical framing."""
+        self._lib.retpu_store_put(self._handle, k, len(k), v, len(v))
+
+    def put_many_raw(self, arena, index) -> None:
+        """One C call appends a whole arena of pre-pickled records
+        ((key_off, key_len, val_off, val_len) rows; key_len <= 0 rows
+        are skipped) — the per-flush WAL append of the native resolve
+        path.  Falls back to per-record puts on a stale .so without
+        the batch symbol."""
+        import numpy as np
+        if not hasattr(self._lib, "retpu_store_put_many"):
+            a = np.ascontiguousarray(arena, np.uint8)
+            for koff, klen, voff, vlen in np.asarray(index).tolist():
+                if klen > 0:
+                    self.store_raw(a[koff:koff + klen].tobytes(),
+                                   a[voff:voff + vlen].tobytes())
+            return
+        a = np.ascontiguousarray(arena, np.uint8)
+        idx = np.ascontiguousarray(index, np.int64)
+        self._lib.retpu_store_put_many(
+            self._handle, a.ctypes.data_as(ctypes.c_void_p),
+            idx.ctypes.data_as(ctypes.c_void_p), len(idx))
+
     def delete(self, key) -> None:
         k = _enc(key)
         self._lib.retpu_store_delete(self._handle, k, len(k))

@@ -20,10 +20,13 @@ from typing import Optional
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+_resolve_lib: Optional[ctypes.CDLL] = None
+_resolve_tried = False
 
 NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native")
 SONAME = os.path.join(NATIVE_DIR, "libretpu_native.so")
+RESOLVE_SONAME = os.path.join(NATIVE_DIR, "_retpu_resolve.so")
 
 
 def build_target(target: str, artifact: str) -> bool:
@@ -112,5 +115,66 @@ def load() -> Optional[ctypes.CDLL]:
         lib.retpu_store_sync.argtypes = [ctypes.c_void_p]
         lib.retpu_store_flush.argtypes = [ctypes.c_void_p]
         lib.retpu_store_compact.argtypes = [ctypes.c_void_p]
+        # arena batch put (the resolve kernel's WAL path) — older .so
+        # builds may predate it, so probe instead of assuming
+        if hasattr(lib, "retpu_store_put_many"):
+            lib.retpu_store_put_many.restype = ctypes.c_int
+            lib.retpu_store_put_many.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64]
         _lib = lib
         return _lib
+
+
+def load_resolve() -> Optional[ctypes.CDLL]:
+    """The native resolve kernel (``native/resolvekernel.cc``),
+    building its explicit make target on first use; None when the
+    toolchain is unavailable or the build fails — callers degrade to
+    the pure-Python resolve path (never a crash, never a test
+    failure).  A separate .so from :func:`load` on purpose: a resolve-
+    kernel build break must not take the clock/treestore library
+    down."""
+    global _resolve_lib, _resolve_tried
+    with _lock:
+        if _resolve_lib is not None or _resolve_tried:
+            return _resolve_lib
+        _resolve_tried = True
+        if not build_target("_retpu_resolve.so", RESOLVE_SONAME):
+            return None
+        try:
+            lib = ctypes.CDLL(RESOLVE_SONAME)
+        except OSError:
+            return None
+        try:
+            p = ctypes.c_void_p
+            lib.retpu_resolve_version.restype = ctypes.c_int
+            lib.retpu_resolve_unpack.restype = ctypes.c_int
+            lib.retpu_resolve_unpack.argtypes = [
+                p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, p, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, p, p, p, p, p, p,
+                p, p]
+            lib.retpu_resolve_mirrors.restype = ctypes.c_int
+            lib.retpu_resolve_mirrors.argtypes = [
+                ctypes.c_int32, ctypes.c_int32, p, p, p, p, p, p, p,
+                p, p, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, p, p, p, p, p]
+            lib.retpu_wal_encode.restype = ctypes.c_int64
+            lib.retpu_wal_encode.argtypes = [
+                ctypes.c_int64, ctypes.c_int32, p, p, p, p, p, p,
+                p, p, p, p, p, p, p, p, p, p, ctypes.c_int64, p]
+            lib.retpu_delta_sections.restype = ctypes.c_int
+            lib.retpu_delta_sections.argtypes = [
+                ctypes.c_int32, ctypes.c_int32, p, p, p, p, p, p,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, p, p, p, p, p, p,
+                p, p, p]
+            if lib.retpu_resolve_version() < 1:
+                return None
+        except AttributeError:
+            # stale .so predating a symbol: fall back rather than
+            # serving half an ABI
+            return None
+        _resolve_lib = lib
+        return _resolve_lib

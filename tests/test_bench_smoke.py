@@ -103,6 +103,35 @@ def test_obs_overhead_smoke():
             > 0.4 * out["obs_off_ops_per_sec"]), out
 
 
+def test_native_resolve_ab_smoke():
+    """The native-resolve A/B runner: both arms run, the native arm
+    really takes the kernel (or the runner says the toolchain is
+    absent), the breakdown carries the resolve components, and the
+    WAL tempdir is cleaned up.  Ratio bounds stay loose — smoke
+    shapes on a CI box measure noise; the real number is pinned at
+    round time on the 512-ens rung."""
+    out = bench.run_native_resolve_ab(16, 3, 8, 4, seconds=0.4)
+    if not out.get("resolve_native_available"):
+        pytest.skip("native resolve kernel unavailable")
+    assert out["resolve_native_ops_per_sec"] > 0
+    assert out["resolve_fallback_ops_per_sec"] > 0
+    assert out["resolve_native_speedup"] > 0.4, out
+    bd = out["resolve_native_latency_breakdown"]
+    assert "resolve" in bd and "wal" in bd, bd
+    assert "resolve_native" in bd, bd
+
+
+def test_escale_point_smoke():
+    """The E-scaling stage runner at a tiny shape: reports the
+    pipelined and keyed-batched points with sane fields (the 1k/2k
+    CPU points in the round JSON come from this exact runner)."""
+    out = bench.run_escale_point(8, 3, 8, 4, seconds=0.2)
+    assert out["n_ens"] == 8
+    assert out["ops_per_sec"] > 0
+    assert out["keyed_batched_ops_per_sec"] > 0
+    assert out["p99_ms"] >= out["p50_ms"] >= 0
+
+
 def test_obs_metric_names_documented():
     """The stats-schema ratchet (the test_env_knobs pattern applied
     to metric names): every metric a service registry can export must

@@ -139,6 +139,57 @@ def test_synctree_on_native_backend(tmp_path):
     be2.close()
 
 
+# -- resolve kernel (native/resolvekernel.cc) -------------------------------
+
+
+def test_resolve_kernel_build_smoke():
+    """The explicit $(RESOLVESO) make target builds and exports the
+    full resolve-kernel ABI; a missing toolchain degrades to None
+    (never an exception) — the graceful-degradation contract of
+    utils/native.load_resolve."""
+    lib = native.load_resolve()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    assert lib.retpu_resolve_version() >= 1
+    for sym in ("retpu_resolve_unpack", "retpu_resolve_mirrors",
+                "retpu_wal_encode", "retpu_delta_sections"):
+        assert hasattr(lib, sym), sym
+
+
+@needs_native
+def test_store_put_many_matches_per_record(tmp_path):
+    """The arena batch append (the resolve kernel's WAL path) must
+    leave byte-identical log files and store contents to per-record
+    puts."""
+    import numpy as np
+
+    recs = [(b"k%d" % i, b"v%d" % (i * 7)) for i in range(20)]
+    a = native_store.NativeBackend(str(tmp_path / "a.db"))
+    for k, v in recs:
+        a.store_raw(k, v)
+    a.sync()
+    a.close()
+    arena = b"".join(k + v for k, v in recs)
+    idx = []
+    off = 0
+    for k, v in recs:
+        idx.append((off, len(k), off + len(k), len(v)))
+        off += len(k) + len(v)
+    # interleave a skipped (uncommitted) row: key_len 0 rows drop
+    idx.insert(3, (0, 0, 0, 0))
+    b = native_store.NativeBackend(str(tmp_path / "b.db"))
+    b.put_many_raw(np.frombuffer(arena, np.uint8),
+                   np.asarray(idx, np.int64))
+    b.sync()
+    b.close()
+    la = open(str(tmp_path / "a.db") + ".log", "rb").read()
+    lb = open(str(tmp_path / "b.db") + ".log", "rb").read()
+    assert la == lb
+    b2 = native_store.NativeBackend(str(tmp_path / "b.db"))
+    assert b2.count() == len(recs)
+    b2.close()
+
+
 @needs_native
 @pytest.mark.parametrize("seed", range(3))
 def test_store_randomized_against_dict_model(tmp_path, seed):

@@ -1,0 +1,429 @@
+"""Native single-pass resolve kernel: fuzz equivalence vs the Python
+oracle (docs/ARCHITECTURE.md §12).
+
+The contract under test is BYTE-IDENTITY: with the kernel on
+(``RETPU_NATIVE_RESOLVE=1``, the default) and off, the same op stream
+must produce bit-identical unpacked result planes, mirror slabs
+(``_slot_vsn``/``_inline_value``), WAL store bytes, and delta-frame
+sections.  The Python implementations are the oracle; the kernel is
+an optimization, never a semantic.
+"""
+
+import os
+import pickle
+import zlib
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jax.config.update("jax_platforms", "cpu")
+
+from riak_ensemble_tpu import funref
+from riak_ensemble_tpu.ops import engine as eng
+from riak_ensemble_tpu.parallel import repgroup, resolve_native
+from riak_ensemble_tpu.parallel.batched_host import (
+    BatchedEnsembleService, WallRuntime, unpack_results,
+)
+
+needs_kernel = pytest.mark.skipif(
+    resolve_native.get() is None,
+    reason="native resolve kernel unavailable (no toolchain)")
+
+
+def _pack_reference(won, quorum, corrupt, committed, get_ok, found,
+                    value, vsn, want_vsn):
+    """Host-side replica of _pack_results_body's layout (the d2h
+    payload the kernel unpacks)."""
+    flags = np.concatenate(
+        [won.ravel(), quorum.ravel(), corrupt.ravel(),
+         committed.ravel(), get_ok.ravel(),
+         found.ravel()]).astype(bool)
+    ints = [value.ravel().astype(np.int32)]
+    if want_vsn:
+        ints += [vsn[..., 0].ravel().astype(np.int32),
+                 vsn[..., 1].ravel().astype(np.int32)]
+    return np.concatenate([np.packbits(flags),
+                           np.concatenate(ints).view(np.uint8)])
+
+
+# -- 1) packed-result unpack -------------------------------------------------
+
+
+@needs_kernel
+@pytest.mark.parametrize("seed", range(3))
+def test_unpack_fuzz_equivalence(seed):
+    """Random packed planes through native vs Python unpack: every
+    returned plane bit-identical across full-width, compacted
+    (pack-gather) and sliced [K, A] layouts, want_vsn on and off."""
+    nr = resolve_native.get()
+    rng = np.random.default_rng(seed)
+    for trial in range(60):
+        e = int(rng.integers(4, 48))
+        m = int(rng.integers(1, 6))
+        k = int(rng.integers(0, 10))
+        want_vsn = bool(rng.integers(0, 2))
+        mode = int(rng.integers(0, 3))  # full / pack-gather / sliced
+        if mode == 0:
+            active, aw, sliced = None, e, False
+        else:
+            na = int(rng.integers(1, e))
+            active = np.sort(
+                rng.choice(e, na, replace=False)).astype(np.int32)
+            aw = 8
+            while aw < na:
+                aw <<= 1
+            aw = max(min(aw, e), na)
+            sliced = mode == 2
+        hw = aw if (sliced and active is not None) else e
+        won = rng.integers(0, 2, hw).astype(bool)
+        quorum = rng.integers(0, 2, hw).astype(bool)
+        corrupt = rng.integers(0, 2, (hw, m)).astype(bool)
+        committed = rng.integers(0, 2, (k, aw)).astype(bool)
+        get_ok = rng.integers(0, 2, (k, aw)).astype(bool)
+        found = rng.integers(0, 2, (k, aw)).astype(bool)
+        value = rng.integers(-2**31, 2**31, (k, aw)).astype(np.int32)
+        vsn = rng.integers(0, 2**31, (k, aw, 2)).astype(np.int32)
+        flat = _pack_reference(won, quorum, corrupt, committed,
+                               get_ok, found, value, vsn, want_vsn)
+        a_width = 0 if active is None else aw
+        ref = unpack_results(flat, e, m, k, want_vsn, active=active,
+                             a_width=a_width, sliced=sliced)
+        nat = nr.unpack(flat, e, m, k, want_vsn, active, a_width,
+                        sliced)
+        assert nat is not None
+        for name, a, b in zip(
+                ("won", "quorum", "corrupt", "committed", "get_ok",
+                 "found", "value", "vsn"), ref, nat):
+            if a is None:
+                assert b is None, name
+                continue
+            assert np.array_equal(np.asarray(a), np.asarray(b)), \
+                (seed, trial, name, mode)
+
+
+@needs_kernel
+def test_unpack_rejects_short_payload():
+    """A truncated payload returns None (the caller falls back to the
+    Python unpack, which raises the honest shape error)."""
+    nr = resolve_native.get()
+    assert nr.unpack(np.zeros((3,), np.uint8), 16, 3, 4, True, None,
+                     0, False) is None
+
+
+# -- 2/3) service-level equivalence (mirrors + WAL bytes) --------------------
+
+
+def _workload(svc, rng, n_ens, k, rounds):
+    """A mixed keyed op stream: batched puts/gets/CAS/deletes, scalar
+    puts/gets (incl. want_vsn), device RMWs (inline slots) and
+    RMW-to-zero tombstones.  Returns every future's resolved value in
+    issue order (the client-visible half of the equivalence)."""
+    out = []
+    futs = []
+    add1 = funref.ref("rmw:add", 1)
+    set_zero = funref.ref("rmw:set", 0)
+    for r in range(rounds):
+        for e in range(n_ens):
+            keys = [f"k{(r + i) % 11}" for i in range(k)]
+            vals = [b"v%d.%d" % (r, i) for i in range(k)]
+            if r == 2 and e == 0:
+                # >= 64 KiB payload: CPython pickles it OUT of the
+                # frame, so the native WAL arm must route this flush
+                # to the Python encoder (byte-identity regression)
+                vals[0] = b"P" * (1 << 16)
+            pick = rng.integers(0, 7)
+            if pick == 0:
+                futs.append(svc.kput_many(e, keys, vals))
+            elif pick == 1:
+                futs.append(svc.kget_many(
+                    e, keys, want_vsn=bool(rng.integers(0, 2))))
+            elif pick == 2:
+                futs.append(svc.kupdate_many(
+                    e, keys[:2], [(0, 0), (0, 0)], vals[:2]))
+            elif pick == 3:
+                futs.append(svc.kdelete_many(e, keys[:3]))
+            elif pick == 4:
+                futs.append(svc.kmodify(e, f"ctr{r % 3}", add1, 0))
+            elif pick == 5:
+                # tombstone RMW: a computed 0 recycles the slot
+                futs.append(svc.kmodify(e, f"ctr{r % 3}", set_zero, 0))
+            else:
+                futs.append(svc.kput(e, keys[0], vals[0]))
+                futs.append(svc.kget(e, keys[1]))
+        while any(svc.queues):
+            svc.flush()
+    svc.flush()
+    for f in futs:
+        assert f.done
+        out.append(f.value)
+    return out
+
+
+def _run_arm(tmp_path, arm, seed, monkeypatch, wal=True):
+    monkeypatch.setenv("RETPU_NATIVE_RESOLVE", arm)
+    monkeypatch.setenv("RETPU_FAST_READS", "0")  # every read = round
+    rng = np.random.default_rng(seed)
+    kw = (dict(data_dir=str(tmp_path / f"arm{arm}"),
+               wal_sync="buffer") if wal else {})
+    svc = BatchedEnsembleService(WallRuntime(), 8, 3, 16, tick=None,
+                                 max_ops_per_tick=8, **kw)
+    if arm == "1" and resolve_native.get() is not None:
+        assert svc._native_resolve is not None
+    results = _workload(svc, rng, 8, 4, rounds=6)
+    state = {
+        "results": results,
+        "vsn_ok": svc._slot_vsn_ok.copy(),
+        "vsn_np": svc._slot_vsn_np.copy(),
+        "inl_ok": svc._inline_value_ok.copy(),
+        "inl_np": svc._inline_value_np.copy(),
+        "inline_np": svc._inline_np.copy(),
+        "inline_sets": [sorted(s) for s in svc._inline_slots],
+        "native_flushes": svc.native_resolve_flushes,
+        "fallback_flushes": svc.fallback_resolve_flushes,
+    }
+    if wal:
+        state["wal_records"] = sorted(
+            map(repr, svc._wal.records()))
+        wal_dir = svc._wal.dir_path
+        state["wal_files"] = {
+            name: open(os.path.join(wal_dir, name), "rb").read()
+            for name in sorted(os.listdir(wal_dir))}
+    svc.stop()
+    return state
+
+
+@needs_kernel
+@pytest.mark.parametrize("seed", range(2))
+def test_service_equivalence_native_vs_fallback(tmp_path, seed,
+                                                monkeypatch):
+    """The whole resolve half, end to end: an identical mixed op
+    stream through a native-arm and a fallback-arm service must yield
+    identical client results, BIT-IDENTICAL mirror slabs, identical
+    inline storage-class sets/slab, and byte-identical WAL files."""
+    a = _run_arm(tmp_path, "1", seed, monkeypatch)
+    b = _run_arm(tmp_path, "0", seed, monkeypatch)
+    assert a["native_flushes"] > 0, "native arm never took the kernel"
+    assert b["native_flushes"] == 0 and b["fallback_flushes"] > 0
+    assert a["results"] == b["results"]
+    assert np.array_equal(a["vsn_ok"], b["vsn_ok"])
+    assert np.array_equal(a["vsn_np"][a["vsn_ok"]],
+                          b["vsn_np"][b["vsn_ok"]])
+    assert np.array_equal(a["inl_ok"], b["inl_ok"])
+    assert np.array_equal(a["inl_np"][a["inl_ok"]],
+                          b["inl_np"][b["inl_ok"]])
+    assert np.array_equal(a["inline_np"], b["inline_np"])
+    assert a["inline_sets"] == b["inline_sets"]
+    assert a["wal_records"] == b["wal_records"]
+    # byte-identity of the store files is the strongest form of the
+    # WAL contract: the arena path appended the very same bytes
+    assert a["wal_files"].keys() == b["wal_files"].keys()
+    for name in a["wal_files"]:
+        assert a["wal_files"][name] == b["wal_files"][name], name
+
+
+@needs_kernel
+def test_inline_set_slab_coherence(tmp_path, monkeypatch):
+    """The `_inline_np` storage-class slab must mirror the
+    `_inline_slots` sets exactly after a mixed workload (the kernel
+    routes leased-GET refreshes through the slab)."""
+    monkeypatch.setenv("RETPU_NATIVE_RESOLVE", "1")
+    svc = BatchedEnsembleService(WallRuntime(), 4, 3, 16, tick=None,
+                                 max_ops_per_tick=8)
+    _workload(svc, np.random.default_rng(7), 4, 4, rounds=4)
+    for e in range(4):
+        assert set(np.flatnonzero(svc._inline_np[e]).tolist()) == \
+            svc._inline_slots[e], e
+    svc.stop()
+
+
+@needs_kernel
+def test_large_payload_falls_back_byte_identical(tmp_path,
+                                                 monkeypatch):
+    """A >= 64 KiB payload pickles out-of-frame in CPython; the
+    native WAL arm must fall back for that flush and the store bytes
+    must still match the oracle arm exactly."""
+    files = {}
+    for arm in ("1", "0"):
+        monkeypatch.setenv("RETPU_NATIVE_RESOLVE", arm)
+        d = str(tmp_path / f"big{arm}")
+        svc = BatchedEnsembleService(WallRuntime(), 2, 3, 8,
+                                     tick=None, max_ops_per_tick=4,
+                                     data_dir=d, wal_sync="buffer")
+        futs = [svc.kput_many(0, ["big", "small"],
+                              [b"B" * 70000, b"s"]),
+                svc.kput_many(1, ["x"], [b"y"])]
+        while any(svc.queues):
+            svc.flush()
+        assert all(r[0] == "ok" for f in futs for r in f.value)
+        wal_dir = svc._wal.dir_path
+        files[arm] = {
+            name: open(os.path.join(wal_dir, name), "rb").read()
+            for name in sorted(os.listdir(wal_dir))}
+        svc.stop()
+    assert files["1"].keys() == files["0"].keys()
+    for name in files["1"]:
+        assert files["1"][name] == files["0"][name], name
+
+
+def test_exotic_keys_take_python_wal_path(tmp_path, monkeypatch):
+    """Keys outside the kernel's pickle subset (tuples, non-ascii
+    strs, ints) must fall back to the Python WAL encode — and restore
+    correctly either way."""
+    monkeypatch.setenv("RETPU_NATIVE_RESOLVE", "1")
+    d = str(tmp_path / "svc")
+    svc = BatchedEnsembleService(WallRuntime(), 2, 3, 8, tick=None,
+                                 max_ops_per_tick=4, data_dir=d,
+                                 wal_sync="buffer")
+    futs = [svc.kput_many(0, [("tup", 1), "κλειδί", 7],
+                          [b"a", b"b", b"c"]),
+            svc.kput_many(1, ["plain"], [b"d"])]
+    while any(svc.queues):
+        svc.flush()
+    assert all(r[0] == "ok" for f in futs for r in f.value)
+    svc.stop()
+    svc2 = BatchedEnsembleService.restore(WallRuntime(), d, tick=None)
+    for e, key, want in ((0, ("tup", 1), b"a"), (0, "κλειδί", b"b"),
+                         (0, 7, b"c"), (1, "plain", b"d")):
+        f = svc2.kget(e, key)
+        while not f.done:
+            svc2.flush()
+        assert f.value == ("ok", want), (key, f.value)
+    svc2.stop()
+
+
+# -- 4) delta-frame sections -------------------------------------------------
+
+
+@needs_kernel
+@pytest.mark.parametrize("seed", range(3))
+def test_delta_entry_fuzz_equivalence(seed):
+    """build_delta_entry with the kernel vs the numpy pipeline:
+    identical section bytes, dtypes, CRC and byte count over random
+    committed/kind/slot/value planes (wide and narrow index dtypes,
+    empty planes included)."""
+    nr = resolve_native.get()
+    rng = np.random.default_rng(seed)
+    for trial in range(40):
+        e = int(rng.integers(2, 300))
+        k = int(rng.integers(1, 18))
+        n_slots = int(rng.choice([16, 300]))
+        committed = rng.integers(0, 2, (k, e)).astype(bool)
+        if trial % 6 == 0:
+            committed[:] = False
+        value = rng.integers(-1000, 1000, (k, e)).astype(np.int32)
+        kind = rng.choice(
+            [eng.OP_NOOP, eng.OP_PUT, eng.OP_GET, eng.OP_CAS,
+             eng.OP_RMW], (k, e)).astype(np.int32)
+        slot = rng.integers(0, n_slots, (k, e)).astype(np.int32)
+        val = rng.integers(0, 1 << 20, (k, e)).astype(np.int32)
+        quorum = rng.integers(0, 2, e).astype(bool)
+        ref_e, ref_crc, ref_n = repgroup.build_delta_entry(
+            3, k, committed, value, kind, slot, val, quorum, [],
+            n_slots=n_slots, fid=9, native=None)
+        nat_e, nat_crc, nat_n = repgroup.build_delta_entry(
+            3, k, committed, value, kind, slot, val, quorum, [],
+            n_slots=n_slots, fid=9, native=nr)
+        assert nat_crc == ref_crc and nat_n == ref_n, (seed, trial)
+        assert len(nat_e) == len(ref_e)
+        for i, (x, y) in enumerate(zip(ref_e, nat_e)):
+            if hasattr(x, "buf"):  # wire.Raw
+                xa = np.frombuffer(x.buf, np.uint8)
+                ya = np.frombuffer(y.buf, np.uint8)
+                assert np.array_equal(xa, ya), (seed, trial, i)
+            else:
+                assert x == y, (seed, trial, i)
+
+
+# -- 5) WAL pickle subset ----------------------------------------------------
+
+
+@needs_kernel
+def test_wal_encode_pickle_byte_identity():
+    """The kernel's protocol-4 pickle templates vs pickle.dumps for
+    the routed subset: short/long str keys, bytes/None payloads, the
+    K/M/J int ranges, inline True/False."""
+    nr = resolve_native.get()
+    rng = np.random.default_rng(11)
+    e_total, k = 9, 5
+    cases = [
+        ("a", b""), ("key%d" % 7, b"x" * 3), ("L" * 300, b"y" * 400),
+        ("k", None), ("m" * 255, b"z"),
+    ]
+    n = len(cases)
+    lane_j = rng.integers(0, k, n).astype(np.int32)
+    lane_e = rng.integers(0, e_total, n).astype(np.int32)
+    lane_slot = np.asarray([0, 255, 256, 65535, 65536], np.int32)
+    lane_f2 = np.asarray([0, 1, 255, 65535, 2**31 - 1], np.int32)
+    lane_inline = np.asarray([0, 1, 0, 1, 0], np.uint8)
+    committed = np.ones((k, e_total), bool)
+    value = rng.integers(-2**31, 2**31, (k, e_total)).astype(np.int32)
+    vsn = rng.integers(0, 2**31, (k, e_total, 2)).astype(np.int32)
+    keys = [c[0] for c in cases]
+    pays = [c[1] for c in cases]
+    key_len = np.asarray([len(s) for s in keys], np.int64)
+    key_off = np.zeros((n,), np.int64)
+    np.cumsum(key_len[:-1], out=key_off[1:])
+    pay_len = np.asarray([-1 if p is None else len(p)
+                          for p in pays], np.int64)
+    pay_off = np.zeros((n,), np.int64)
+    np.cumsum(np.maximum(pay_len, 0)[:-1], out=pay_off[1:])
+    arena, idx = nr.wal_encode(
+        e_total, lane_j, lane_e, lane_slot, lane_f2, lane_inline,
+        np.zeros((n,), np.uint8), key_off, key_len,
+        "".join(keys).encode(), pay_off, pay_len,
+        b"".join(p for p in pays if p is not None),
+        committed, value, vsn)
+    raw = arena.tobytes()
+    for i in range(n):
+        j, e = int(lane_j[i]), int(lane_e[i])
+        ko, kl, vo, vl = idx[i].tolist()
+        kref = pickle.dumps(("kv", e, int(lane_slot[i])), protocol=4)
+        f2 = int(value[j, e]) if lane_inline[i] else int(lane_f2[i])
+        vref = pickle.dumps(
+            (keys[i], f2, int(vsn[j, e, 0]), int(vsn[j, e, 1]),
+             pays[i], bool(lane_inline[i])), protocol=4)
+        assert raw[ko:ko + kl] == kref, i
+        assert raw[vo:vo + vl] == vref, i
+        assert pickle.loads(raw[vo:vo + vl]) == (
+            keys[i], f2, int(vsn[j, e, 0]), int(vsn[j, e, 1]),
+            pays[i], bool(lane_inline[i]))
+
+
+# -- 6) degradation ----------------------------------------------------------
+
+
+def test_knob_pins_fallback(monkeypatch):
+    """RETPU_NATIVE_RESOLVE=0 pins the Python arm at construction."""
+    monkeypatch.setenv("RETPU_NATIVE_RESOLVE", "0")
+    assert resolve_native.get() is None
+    svc = BatchedEnsembleService(WallRuntime(), 2, 3, 8, tick=None,
+                                 max_ops_per_tick=4)
+    assert svc._native_resolve is None
+    f = svc.kput(0, "k", b"v")
+    while not f.done:
+        svc.flush()
+    assert f.value[0] == "ok"
+    assert svc.fallback_resolve_flushes > 0
+    assert svc.native_resolve_flushes == 0
+    svc.stop()
+
+
+def test_missing_so_degrades_to_python(monkeypatch):
+    """A missing/unbuildable kernel .so must mean the Python fallback
+    — never a crash, never a test failure (the satellite's graceful-
+    degradation contract).  Simulated by pinning the loader's memo to
+    'tried and failed'."""
+    monkeypatch.setenv("RETPU_NATIVE_RESOLVE", "1")
+    monkeypatch.setattr(resolve_native, "_instance", None)
+    monkeypatch.setattr(resolve_native, "_instance_tried", True)
+    assert resolve_native.get() is None
+    svc = BatchedEnsembleService(WallRuntime(), 2, 3, 8, tick=None,
+                                 max_ops_per_tick=4)
+    assert svc._native_resolve is None
+    f = svc.kput(0, "k", b"v")
+    g = svc.kget(0, "k")
+    while not (f.done and g.done):
+        svc.flush()
+    assert f.value[0] == "ok" and g.value == ("ok", b"v")
+    svc.stop()

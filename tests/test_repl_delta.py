@@ -370,7 +370,7 @@ def test_delta_across_elections_full_fallback(tmp_path):
         g0 = svc.stats()["group"]
         # depose the device-lane leaders: the next flush elects
         svc.leader_np[:] = -1
-        svc._slot_vsn = [dict() for _ in range(N_ENS)]
+        svc._slot_vsn_ok[:] = False
         futs = [svc.kget(e, f"k{e}") for e in range(N_ENS)]
         _settle(svc, futs)
         g1 = svc.stats()["group"]
@@ -472,7 +472,7 @@ def test_coalesced_boundary_fuzz(tmp_path):
                 svc._repl_delta = True
             if rnd == 8:
                 svc.leader_np[:] = -1  # forced re-election
-                svc._slot_vsn = [dict() for _ in range(N_ENS)]
+                svc._slot_vsn_ok[:] = False
             _settle(svc, futs)
         g = svc.stats()["group"]
         assert g["quorum_failures"] == 0, g
