@@ -232,6 +232,11 @@ def run_service(n_ens: int, n_peers: int, n_slots: int, k: int,
     # headline pipelined loop): the round JSON records the overhead
     # as a measurement, not a claim
     out.update(run_obs_overhead(n_ens, n_peers, n_slots, k, seconds))
+    # per-op SLO tracing A/B on the keyed rung (the surface that
+    # pays the ring stamps; acceptance bound 2%)
+    out.update(run_op_trace_overhead(
+        min(n_ens, 512), n_peers, min(n_slots, 64), min(k, 16),
+        seconds))
     # native-resolve A/B (interleaved on/off batches of the keyed
     # batched rung with a live WAL — the full resolve half the C
     # kernel replaces; same batch-granular methodology as the obs A/B)
@@ -415,6 +420,72 @@ def run_keyed_batched_only(n_ens: int, n_peers: int, n_slots: int,
     return ops / (time.perf_counter() - t0)
 
 
+def _env_scoped(knob: str, value: str, ctor):
+    """Construct a service with ``knob=value`` in the environment
+    (the RETPU_* knobs bind at service construction), restoring the
+    prior value either way."""
+    old = os.environ.get(knob)
+    os.environ[knob] = value
+    try:
+        return ctor()
+    finally:
+        if old is None:
+            os.environ.pop(knob, None)
+        else:
+            os.environ[knob] = old
+
+
+def _interleaved_ab(on_svc, off_svc, batch, seconds: float,
+                    rounds: int):
+    """THE A/B methodology both overhead runners share (fixed work
+    at BATCH granularity — see run_obs_overhead's docstring for why
+    window estimators lie on a small box): one long stream of
+    settled batches alternating on/off with the pair order flipping
+    every iteration.  Returns (on_times, off_times, n_per_arm);
+    scoring is the caller's (per-arm median + p10/p90 spread via
+    :func:`_ab_scores`)."""
+    probe = batch(on_svc)
+    # sample count per arm from the time budget, clamped so the
+    # median is meaningful at the fast shapes (floor: the resolution
+    # collapses under ~40 samples on a noisy box) and the slow shapes
+    # don't blow the stage budget
+    n = int(max(seconds, 1.0) * max(rounds, 1) * 2.0
+            / max(probe, 1e-7) / 2)
+    n = max(40, min(n, 160))
+    on_t: list = []
+    off_t: list = []
+    for i in range(n):
+        # pair order flips every iteration so a monotone box drift
+        # cannot masquerade as an arm effect
+        order = ((on_svc, on_t), (off_svc, off_t))
+        for svc, sink in (order if i % 2 == 0 else order[::-1]):
+            sink.append(batch(svc))
+    return on_t, off_t, n
+
+
+def _ab_scores(prefix: str, on_t, off_t, n: int, ops: int) -> dict:
+    """Per-arm medians + overhead + p10/p90 spread, under
+    ``{prefix}_on_...``/``{prefix}_off_...`` keys."""
+    on_med = float(np.median(on_t))
+    off_med = float(np.median(off_t))
+    return {
+        f"{prefix}_on_ops_per_sec": ops / on_med,
+        f"{prefix}_off_ops_per_sec": ops / off_med,
+        f"{prefix}_on_batch_ms": round(on_med * 1e3, 3),
+        f"{prefix}_off_batch_ms": round(off_med * 1e3, 3),
+        f"{prefix}_overhead_pct": round(
+            (on_med - off_med) / off_med * 100.0, 2),
+        f"{prefix}_ab_samples_per_arm": n,
+        # p90/p10 spread per arm: how much the box wobbled while
+        # measuring — read the overhead number against this
+        f"{prefix}_ab_spread_ms": {
+            "on": [round(float(np.percentile(on_t, q)) * 1e3, 1)
+                   for q in (10, 90)],
+            "off": [round(float(np.percentile(off_t, q)) * 1e3, 1)
+                    for q in (10, 90)]},
+    }
+
+
 def run_obs_overhead(n_ens: int, n_peers: int, n_slots: int, k: int,
                      seconds: float, rounds: int = 3) -> dict:
     """The observability-plane A/B arm (acceptance bound: the obs-on
@@ -451,18 +522,13 @@ def run_obs_overhead(n_ens: int, n_peers: int, n_slots: int, k: int,
     def make(env: str) -> BatchedEnsembleService:
         """One live service per arm (the knob is read at service
         construction); warmed outside every timed window."""
-        old = os.environ.get("RETPU_OBS")
-        os.environ["RETPU_OBS"] = env
-        try:
-            svc = BatchedEnsembleService(WallRuntime(), n_ens,
-                                         n_peers, n_slots, tick=None,
-                                         max_ops_per_tick=k,
-                                         pipeline_depth=2)
-        finally:
-            if old is None:
-                os.environ.pop("RETPU_OBS", None)
-            else:
-                os.environ["RETPU_OBS"] = old
+        svc = _env_scoped(
+            "RETPU_OBS", env,
+            lambda: BatchedEnsembleService(WallRuntime(), n_ens,
+                                           n_peers, n_slots,
+                                           tick=None,
+                                           max_ops_per_tick=k,
+                                           pipeline_depth=2))
         for _ in range(3):
             svc.execute_async(kind, slot, val)
         svc.flush()
@@ -475,43 +541,74 @@ def run_obs_overhead(n_ens: int, n_peers: int, n_slots: int, k: int,
         return time.perf_counter() - t0
 
     on_svc, off_svc = make("1"), make("0")
-    probe = batch(on_svc)
-    # sample count per arm from the time budget, clamped so the
-    # median is meaningful at the fast shapes (floor: the resolution
-    # collapses under ~40 samples on a noisy box) and the slow shapes
-    # don't blow the stage budget
-    n = int(max(seconds, 1.0) * max(rounds, 1) * 2.0
-            / max(probe, 1e-7) / 2)
-    n = max(40, min(n, 160))
-    on_t: list = []
-    off_t: list = []
-    for i in range(n):
-        # pair order flips every iteration so a monotone box drift
-        # cannot masquerade as an arm effect
-        order = ((on_svc, on_t), (off_svc, off_t))
-        for svc, sink in (order if i % 2 == 0 else order[::-1]):
-            sink.append(batch(svc))
+    on_t, off_t, n = _interleaved_ab(on_svc, off_svc, batch,
+                                     seconds, rounds)
     on_svc.stop()
     off_svc.stop()
-    on_med = float(np.median(on_t))
-    off_med = float(np.median(off_t))
-    ops = k * n_ens
-    return {
-        "obs_on_ops_per_sec": ops / on_med,
-        "obs_off_ops_per_sec": ops / off_med,
-        "obs_on_batch_ms": round(on_med * 1e3, 3),
-        "obs_off_batch_ms": round(off_med * 1e3, 3),
-        "obs_overhead_pct": round((on_med - off_med) / off_med
-                                  * 100.0, 2),
-        "obs_ab_samples_per_arm": n,
-        # p90/p10 spread per arm: how much the box wobbled while
-        # measuring — read the overhead number against this
-        "obs_ab_spread_ms": {
-            "on": [round(float(np.percentile(on_t, q)) * 1e3, 1)
-                   for q in (10, 90)],
-            "off": [round(float(np.percentile(off_t, q)) * 1e3, 1)
-                    for q in (10, 90)]},
-    }
+    return _ab_scores("obs", on_t, off_t, n, k * n_ens)
+
+
+def run_op_trace_overhead(n_ens: int, n_peers: int, n_slots: int,
+                          k: int, seconds: float,
+                          rounds: int = 3) -> dict:
+    """Per-op SLO tracing A/B on the KEYED rung (acceptance bound:
+    the ring within 2% of ``RETPU_SLO_RING=0``).
+
+    The per-op ring fold lives on the kput_many/kget_many settle
+    path, which the device-resident pipelined loop of
+    ``run_obs_overhead`` never exercises — so the tracing overhead
+    needs its own arm on the surface that actually pays it.  Both
+    arms keep the FULL obs plane on (flush spans, tenant counters,
+    flight ring — whose keyed-rung cost predates this round); the
+    off arm disables the per-op ring ALONE via ``RETPU_SLO_RING=0``,
+    so the delta isolates the tracing this A/B is accountable for.
+    Same methodology as run_obs_overhead: one live service per arm,
+    one long interleaved stream of settled keyed batches with the
+    pair order flipping, per-arm MEDIAN per-batch time (window
+    estimators lie on a small box)."""
+    from riak_ensemble_tpu.parallel.batched_host import (
+        BatchedEnsembleService, WallRuntime,
+    )
+
+    keys = [f"key{j}" for j in range(k)]
+    vals = [b"v%d" % j for j in range(k // 2)]
+
+    def make(env: str) -> BatchedEnsembleService:
+        svc = _env_scoped(
+            "RETPU_SLO_RING", env,
+            lambda: BatchedEnsembleService(WallRuntime(), n_ens,
+                                           n_peers, n_slots,
+                                           tick=None,
+                                           max_ops_per_tick=k))
+        for _ in range(2):  # compile + first elections, outside timing
+            batch(svc)
+        return svc
+
+    def batch(svc: BatchedEnsembleService) -> float:
+        t0 = time.perf_counter()
+        futs = []
+        for e in range(n_ens):
+            futs.append(svc.kput_many(e, keys[:k // 2], vals))
+            futs.append(svc.kget_many(e, keys[k // 2:]))
+        while any(svc.queues):
+            svc.flush()
+        assert all(f.done for f in futs), "op-trace A/B: unsettled"
+        return time.perf_counter() - t0
+
+    on_svc, off_svc = make("4096"), make("0")
+    on_t, off_t, n = _interleaved_ab(on_svc, off_svc, batch,
+                                     seconds, rounds)
+    # sanity: the traced arm really recorded per-op samples
+    snap = on_svc.obs_registry.snapshot()
+    op_lat = snap.get("retpu_op_latency_ms", {})
+    traced = int(op_lat.get("count", 0)) + sum(
+        int(ch.get("count", 0))
+        for ch in op_lat.get("by_label", {}).values())
+    on_svc.stop()
+    off_svc.stop()
+    out = _ab_scores("op_trace", on_t, off_t, n, k * n_ens)
+    out["op_trace_samples_recorded"] = traced
+    return out
 
 
 def _non_marks():
@@ -1816,6 +1913,13 @@ def main() -> None:
 
     if args.smoke:
         _setup_jax(force_cpu=True)  # smoke = sanity check, not a measure
+        # bench-trend ratchet rides the smoke path: a malformed or
+        # headline-less BENCH round fails the smoke run LOUDLY (the
+        # TrendError propagates) instead of shipping an unreadable
+        # trajectory into the next round
+        from tools import bench_trend
+        trend = bench_trend.check(
+            os.path.dirname(os.path.abspath(__file__)))
         shapes = dict(n_ens=64, n_peers=5, n_slots=32, k=4)
         secs = min(args.seconds, 1.0)
         kernel_rounds = run(seconds=secs, **shapes)
@@ -1823,6 +1927,7 @@ def main() -> None:
         svc["kernel_rounds_per_sec"] = kernel_rounds
         svc.update(run_repgroup(secs, smoke=True))
         svc["platform"] = "smoke"
+        svc["bench_trend"] = trend
         label = "64_ens_5_peers_smoke"
     else:
         # Within a label the kernel stage runs FIRST: a d2h transfer
@@ -2040,6 +2145,15 @@ def main() -> None:
             round(svc["obs_off_ops_per_sec"], 1)
             if svc.get("obs_off_ops_per_sec") else None),
         "obs_overhead_pct": svc.get("obs_overhead_pct"),
+        # per-op SLO tracing A/B on the keyed rung (acceptance: on
+        # within 2% of off — the ring stamps live on this path)
+        "op_trace_on_ops_per_sec": (
+            round(svc["op_trace_on_ops_per_sec"], 1)
+            if svc.get("op_trace_on_ops_per_sec") else None),
+        "op_trace_off_ops_per_sec": (
+            round(svc["op_trace_off_ops_per_sec"], 1)
+            if svc.get("op_trace_off_ops_per_sec") else None),
+        "op_trace_overhead_pct": svc.get("op_trace_overhead_pct"),
         "mixed_flight_anomalies": svc.get("mixed_flight_anomalies"),
         # native single-pass resolve kernel: the interleaved on/off
         # A/B on the WAL'd keyed batched rung, plus the native arm's
@@ -2060,6 +2174,9 @@ def main() -> None:
         # E-scaling CPU datapoints (1k always, 2k when the box
         # allows) — the curve alongside the 512-ens headline rung
         "escale_cpu": svc.get("escale_cpu"),
+        # bench-trend ratchet (smoke path): the trajectory check's
+        # report — rounds folded, newest headline, same-box band
+        "bench_trend": svc.get("bench_trend"),
         **{k: round(v, 1) for k, v in svc.get("ladder", {}).items()},
         "platform": svc.get("platform", "unknown"),
         # the box this round's numbers were captured on — embedded so

@@ -29,6 +29,17 @@ stack reports into:
 - :mod:`.fingerprint` — the box fingerprint (cpu count, loadavg,
   jax/jaxlib versions, ``RETPU_*`` knobs) every flight dump and every
   bench JSON embeds, so cross-round comparisons stop being faith.
+- :mod:`.opslo` — per-op SLO tracing (round 9): every keyed op's
+  submit→enqueue→flush-join→settle→ack stamps in bounded numpy slab
+  rings keyed by ``flush_id``, feeding client-perceived latency
+  histograms per op kind and per tenant; each flush's slowest rows
+  attach to the span store so ``timeline(fid)`` resolves a tail op
+  down to its stage split.
+- :mod:`.compilewatch` — compile-event hooks around every jitted
+  step/pack/scatter variant (executable-cache-size deltas, exact, not
+  a latency heuristic): warmup coverage gaps surface as
+  ``retpu_compile_events_total{phase="serve"}`` instead of a
+  dispatch-p99 mystery.
 
 Knobs: ``RETPU_OBS=0`` disables hot-path recording (instruments stay
 constructed; record calls short-circuit — the bench's A/B arm);
@@ -43,8 +54,11 @@ from __future__ import annotations
 
 import os
 
+from riak_ensemble_tpu.obs.compilewatch import (COMPILE_EVENTS,
+                                                CompileWatch)
 from riak_ensemble_tpu.obs.fingerprint import box_fingerprint
 from riak_ensemble_tpu.obs.flightrec import FlightRecorder
+from riak_ensemble_tpu.obs.opslo import OpSloRing
 from riak_ensemble_tpu.obs.registry import (Counter, Gauge, Histogram,
                                             MetricsRegistry,
                                             MS_BUCKETS)
@@ -54,7 +68,7 @@ from riak_ensemble_tpu.obs.spans import (SPANS, SpanStore,
 __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
            "MS_BUCKETS", "FlightRecorder", "SpanStore", "SPANS",
            "next_flush_id", "timeline", "box_fingerprint", "enabled",
-           "dump_dir"]
+           "dump_dir", "OpSloRing", "CompileWatch", "COMPILE_EVENTS"]
 
 
 def enabled() -> bool:

@@ -30,7 +30,11 @@ from riak_ensemble_tpu.obs.fingerprint import box_fingerprint
 __all__ = ["FlightRecorder", "DUMP_SCHEMA", "META_FIELDS",
            "DERIVED_MARKS"]
 
-DUMP_SCHEMA = "retpu-flight-dump-v1"
+#: v2 adds the per-op SLO ring tail (``slow_ops``: the slowest acked
+#: ops with their stage splits) and the service's recent
+#: ``compile_events`` — both from the recorder's ``extras`` callback
+#: (empty lists when no extras provider is attached)
+DUMP_SCHEMA = "retpu-flight-dump-v2"
 
 #: DERIVED latency marks — sums/subdivisions of other marks
 #: ('enqueue' = h2d + dispatch; resolve_native/resolve_fallback =
@@ -64,7 +68,8 @@ class FlightRecorder:
                  min_dump_interval_s: float = 5.0,
                  max_dumps: int = 8,
                  dump_dir: Optional[str] = None,
-                 name: str = "svc") -> None:
+                 name: str = "svc",
+                 extras: Optional[Any] = None) -> None:
         self.records: "deque[Dict[str, Any]]" = deque(maxlen=capacity)
         self.trigger_ratio = float(trigger_ratio)
         self.min_samples = int(min_samples)
@@ -72,6 +77,11 @@ class FlightRecorder:
         self.min_dump_interval_s = float(min_dump_interval_s)
         self.name = name
         self._dump_dir = dump_dir
+        #: optional zero-arg callback returning extra dump sections
+        #: (the service supplies its per-op ring tail + compile-event
+        #: log); attached post-construction by the owning service so
+        #: a test-replaced recorder still gets the sections
+        self.extras = extras
         self._totals: "deque[float]" = deque(maxlen=window)
         self._p50 = 0.0
         self._since_refresh = 0
@@ -140,7 +150,16 @@ class FlightRecorder:
             },
             "ring": [dict(r) for r in self.records],
             "box": box_fingerprint(),
+            # per-op tail + compile-event sections (schema v2): empty
+            # when no extras provider is attached
+            "slow_ops": [],
+            "compile_events": [],
         }
+        if self.extras is not None:
+            try:
+                snap.update(self.extras())
+            except Exception:
+                pass  # a broken extras hook must not fail the dump
         self.dumps.append(snap)
         d = self.dump_dir()
         if d:

@@ -1452,3 +1452,39 @@ full_step_wide_sliced = jax.jit(_full_step_wide_sliced_body,
 full_step_wide_sliced_donate = jax.jit(_full_step_wide_sliced_body,
                                        static_argnames=("axis_name",),
                                        donate_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
+# Compile/cost introspection (observability plane, ARCHITECTURE §11)
+
+
+def lowered_cost_analysis(fn, *args, **kwargs):
+    """XLA cost analysis of ``fn`` lowered at these argument shapes
+    — WITHOUT a backend compile (``Lowered.cost_analysis`` runs the
+    HLO cost model on the lowering, a few ms even for the full step).
+    Returns ``{"flops": f, "bytes_accessed": b}`` with whatever keys
+    the backend reports, or None when the lowering/analysis is
+    unsupported (mesh placements, older jaxlibs) — telemetry capture
+    must degrade, never raise into a warmup.
+
+    Used by ``BatchedEnsembleService.warmup`` to record per-(K, A)-
+    bucket cost gauges next to the compile-event log, so a bucket's
+    device cost and its compile cost live on the same surface.
+    """
+    try:
+        lower = getattr(fn, "lower", None)
+        if lower is None:
+            return None
+        ca = lower(*args, **kwargs).cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not isinstance(ca, dict):
+            return None
+        out = {}
+        if "flops" in ca:
+            out["flops"] = float(ca["flops"])
+        if "bytes accessed" in ca:
+            out["bytes_accessed"] = float(ca["bytes accessed"])
+        return out or None
+    except Exception:
+        return None

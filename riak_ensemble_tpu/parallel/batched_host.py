@@ -76,9 +76,11 @@ docs/ARCHITECTURE.md §9 "Lease-protected reads".
 from __future__ import annotations
 
 import functools
+import operator
 import os
 import random
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -101,6 +103,10 @@ from riak_ensemble_tpu.types import NOTFOUND
 #: Single-sourced from flightrec so the flight recorder's
 #: dominant-mark argmax and these sums can never drift apart.
 DERIVED_MARKS = ("k", "total") + obs.flightrec.DERIVED_MARKS
+
+#: per-entry field extractor for the per-op SLO fold (C-level
+#: attrgetter: one call per taken entry beats a Python loop body)
+_OP_SLO_FIELDS = operator.attrgetter("kind", "n", "t_sub", "t_enq")
 
 
 
@@ -181,6 +187,18 @@ def _pack_results_gathered(won, res: eng.KvResult, want_vsn: bool,
                               want_vsn,
                               None if active_idx is None
                               else con(active_idx))
+
+
+def _backend_mem_bytes() -> float:
+    """Live device-memory gauge read (export time only): bytes in
+    use on the default jax device; NaN when the backend keeps no
+    allocator stats (CPU) — the registry maps NaN to None/``NaN``
+    rather than forging a 0."""
+    import jax
+    stats = jax.local_devices()[0].memory_stats()
+    if not stats:
+        return float("nan")
+    return float(stats.get("bytes_in_use", float("nan")))
 
 
 def _select_packer(engine):
@@ -387,6 +405,9 @@ class _PendingOp:
     t_enq: float = 0.0
     #: rounds this entry occupies in the [K, E] op matrix
     n: int = 1
+    #: per-op SLO ring (obs.opslo): API-entry submit timestamp
+    #: (0 = use t_enq; the settle-time record_flush reads both)
+    t_sub: float = 0.0
 
 
 @dataclass(slots=True)
@@ -422,11 +443,17 @@ class _PendingBatch:
     want_vsn: bool = False
     t_enq: float = 0.0
     n: int = 0
+    #: per-op SLO ring (obs.opslo): API-entry submit timestamp
+    #: (0 = use t_enq; the settle-time record_flush reads both)
+    t_sub: float = 0.0
 
     def split(self, head_n: int) -> Tuple["_PendingBatch", "_PendingBatch"]:
         """Split into (head, tail) when a flush's K cap lands inside
         the batch; both halves share the Future and accumulator — it
-        resolves once the whole batch's results accumulated."""
+        resolves once the whole batch's results accumulated.  Both
+        halves keep the submit/enqueue stamps: each settles as its
+        own per-op SLO entry under its OWN flush's id, weights
+        conserved."""
         def cut(x, a, b):
             return None if x is None else x[a:b]
         h = _PendingBatch(self.kind, self.slot[:head_n],
@@ -435,14 +462,16 @@ class _PendingBatch:
                           cut(self.gen, 0, head_n),
                           cut(self.exp_e, 0, head_n),
                           cut(self.exp_s, 0, head_n), self.accum,
-                          self.want_vsn, self.t_enq, head_n)
+                          self.want_vsn, self.t_enq, head_n,
+                          self.t_sub)
         t = _PendingBatch(self.kind, self.slot[head_n:],
                           self.handle[head_n:], self.fut,
                           self.pos[head_n:], cut(self.keys, head_n, None),
                           cut(self.gen, head_n, None),
                           cut(self.exp_e, head_n, None),
                           cut(self.exp_s, head_n, None), self.accum,
-                          self.want_vsn, self.t_enq, self.n - head_n)
+                          self.want_vsn, self.t_enq, self.n - head_n,
+                          self.t_sub)
         return h, t
 
 
@@ -532,6 +561,11 @@ class _InFlightLaunch:
     #: packed d2h byte count the resolve half measured
     flush_id: int = 0
     payload_nbytes: int = 0
+    #: per-op SLO plane: when this launch's enqueue half started —
+    #: the flush-JOIN stamp shared by every taken entry (queue_wait
+    #: ends here; the 'flush' stage runs from here to settle, so a
+    #: serve-time compile inside the dispatch lands in 'flush')
+    t_join: float = 0.0
 
 
 class BatchedEnsembleService:
@@ -690,6 +724,11 @@ class BatchedEnsembleService:
         #: reads bypass to the device round (its integrity gate vets
         #: the read) until the exchange/scrub reports the row synced
         self._corrupt_rows = np.zeros((n_ens,), dtype=bool)
+        #: per-row won-election count — the ensemble-health verb's
+        #: election-churn signal (a row re-electing every few flushes
+        #: is losing its leader; host-mirror-sourced, zero device
+        #: rounds).  Reset with the row on lifecycle recycle.
+        self.elections_np = np.zeros((n_ens,), dtype=np.int64)
         #: read fast-path observability
         self.read_fastpath_hits = 0
         self.read_fastpath_misses = 0
@@ -869,6 +908,43 @@ class BatchedEnsembleService:
         self._h_flush = self.obs_registry.histogram(
             "retpu_flush_total_ms",
             "settled launch wall time (all marks summed)")
+        #: per-op SLO plane (obs.opslo, docs/ARCHITECTURE.md §11):
+        #: bounded stamp ring + the client-perceived latency
+        #: histogram it feeds (labeled by op kind) — the surface that
+        #: answers "what p99 does a kput caller actually see, and was
+        #: that tail queue wait, a compile, or the device".
+        #: RETPU_SLO_RING=0 disables the ring ALONE (per-op + tenant
+        #: latency histograms freeze; counters and the rest of the
+        #: obs plane stay live) — the op-trace A/B's off arm.
+        self._slo = (obs.OpSloRing()
+                     if self._obs and obs.opslo.ring_capacity()
+                     else None)
+        self._h_op = self.obs_registry.histogram(
+            "retpu_op_latency_ms",
+            "client-perceived op latency (submit to ack; "
+            "mirror-served leased reads included)",
+            label_name="kind")
+        #: compile/device telemetry: every jitted step/pack variant
+        #: the launch path dispatches is wrapped in a CompileWatch
+        #: (executable-cache-size deltas) — a first-use compile at an
+        #: un-warmed (K, A) bucket increments the serve-phase counter
+        #: and logs the bucket shape instead of hiding in dispatch p99
+        self._compile_watch: Dict[str, Any] = {}
+        self._compile_log: "deque[Dict[str, Any]]" = deque(maxlen=64)
+        self._in_warmup = False
+        self._c_compile = self.obs_registry.counter(
+            "retpu_compile_events_total",
+            "XLA executable-cache misses in watched launch programs",
+            label_name="phase")
+        self._c_compile_ms = self.obs_registry.counter(
+            "retpu_compile_ms_total",
+            "wall ms spent inside watched calls that compiled",
+            label_name="phase")
+        #: per-(K)-bucket step cost analysis captured at warmup
+        #: (engine.lowered_cost_analysis) — flops/bytes gauges
+        self._step_costs: Dict[str, Dict[str, float]] = {}
+        if self._obs:
+            self._pack = self._watched("pack", self._pack)
         #: per-tenant attribution planes [E] (a tenant is an ensemble
         #: row; named tenants via _row_name / set_tenant_label):
         #: keyed+fast-read ops, committed rounds, put payload bytes,
@@ -998,6 +1074,7 @@ class BatchedEnsembleService:
         self._inline_value_ok[row] = False
         self._pending_writes[row] = {}
         self._corrupt_rows[row] = False
+        self.elections_np[row] = 0
         # a recycled row starts with no watchers (the reference cleans
         # up watchers with their watched peer)
         self._leader_watchers.pop(row, None)
@@ -1055,7 +1132,9 @@ class BatchedEnsembleService:
         get a slot resolve 'failed' immediately and consume no device
         round."""
         fut = Future()
-        n = len(keys)
+        t_sub = time.perf_counter()  # per-op SLO: submit stamp (the
+        n = len(keys)                # slot/handle assignment below is
+        #                              the op's 'assign' stage)
         if n != len(values):
             # trust-boundary check (this surface is network-exposed
             # via svcnode): zip truncation would leave accumulator
@@ -1117,7 +1196,7 @@ class BatchedEnsembleService:
             # per entry was ~20% of the keyed host ceiling
             self._push(ens, _PendingBatch(
                 eng.OP_PUT, slot_l, handle_l, fut, pos_l, live_keys,
-                gen_l, accum=accum, n=len(live_keys)))
+                gen_l, accum=accum, n=len(live_keys), t_sub=t_sub))
         return fut
 
     def kupdate_many(self, ens: int, keys: List[Any],
@@ -1128,6 +1207,7 @@ class BatchedEnsembleService:
         expected_vsns[i] ((0, 0) = create-if-missing).  One future,
         per-key ('ok', new_vsn) | 'failed' in order."""
         fut = Future()
+        t_sub = time.perf_counter()
         n = len(keys)
         if n != len(values) or n != len(expected_vsns):
             raise ValueError(
@@ -1170,7 +1250,7 @@ class BatchedEnsembleService:
         if live_keys:
             self._push(ens, _PendingBatch(
                 eng.OP_CAS, slot, handle, fut, pos, live_keys, gen,
-                exp_e, exp_s, accum, n=len(live_keys)))
+                exp_e, exp_s, accum, n=len(live_keys), t_sub=t_sub))
         return fut
 
     def kdelete_many(self, ens: int, keys: List[Any]) -> Future:
@@ -1178,6 +1258,7 @@ class BatchedEnsembleService:
         ('ok', vsn) | ('ok', NOTFOUND) (no such key) | 'failed' in
         order.  Committed slots recycle like scalar kdelete."""
         fut = Future()
+        t_sub = time.perf_counter()
         n = len(keys)
         if self._dead(ens) or n == 0:
             # dead-ensemble rejection, same as scalar kdelete and the
@@ -1207,7 +1288,7 @@ class BatchedEnsembleService:
             m = len(live_keys)
             batch = _PendingBatch(
                 eng.OP_PUT, slot, [0] * m, fut, pos, live_keys, gen,
-                accum=accum, n=m)
+                accum=accum, n=m, t_sub=t_sub)
             self._push(ens, batch)
             # deferred recycle per committed tombstone, keyed off the
             # batch result list (the _recycle_on_ok discipline)
@@ -1231,6 +1312,7 @@ class BatchedEnsembleService:
         kget_vsn contract).  Unknown keys resolve ('ok', NOTFOUND)
         immediately and consume no device round."""
         fut = Future()
+        t_sub = time.perf_counter()
         n = len(keys)
         if self._dead(ens) or n == 0:
             fut.resolve(["failed"] * n)
@@ -1271,7 +1353,7 @@ class BatchedEnsembleService:
             m = len(slot_l)
             self._push(ens, _PendingBatch(
                 eng.OP_GET, slot_l, [0] * m, fut, pos_l, accum=accum,
-                want_vsn=want_vsn, n=m))
+                want_vsn=want_vsn, n=m, t_sub=t_sub))
         return fut
 
     def kget(self, ens: int, key: Any) -> Future:
@@ -1911,7 +1993,19 @@ class BatchedEnsembleService:
                 # floor), so a read-heavy tenant's p50/p99 reflects
                 # its real service time instead of reporting 0
                 self.tenant_ops[ens] += 1
-                self._tenant_lat[ens, 0] += 1
+                if self._slo is not None:
+                    # per-op + per-tenant latency samples follow the
+                    # ring knob (RETPU_SLO_RING=0 freezes BOTH —
+                    # leaving only the lowest-bucket fast-read
+                    # samples live would skew p50/p99, worse than
+                    # frozen): one lowest-bucket 'get_fast' sample —
+                    # mirror hits ARE the client's experienced
+                    # latency for these reads
+                    self._tenant_lat[ens, 0] += 1
+                    child = self._h_op.labels(obs.opslo.KIND_NAMES[
+                        obs.opslo.KIND_FAST_READ])
+                    child.counts[0] += 1
+                    child.count += 1
             return True
         self.read_fastpath_misses += 1
         r = self.read_fastpath_miss_reasons
@@ -2816,19 +2910,49 @@ class BatchedEnsembleService:
         wide_sliced = variant("full_step_wide_sliced",
                               "full_step_wide", None)
         if self._donate:
-            return (variant("full_step_donate", "full_step",
-                            e.full_step),
-                    variant("full_step_wide_donate", "full_step_wide",
-                            wide),
-                    # a rejected sliced step stays rejected: its
-                    # donated form must not resurrect it
-                    (variant("full_step_sliced_donate",
-                             "full_step_sliced", sliced)
-                     if sliced is not None else None),
-                    (variant("full_step_wide_sliced_donate",
-                             "full_step_wide_sliced", wide_sliced)
-                     if wide_sliced is not None else None))
-        return e.full_step, wide, sliced, wide_sliced
+            fns = (variant("full_step_donate", "full_step",
+                           e.full_step),
+                   variant("full_step_wide_donate", "full_step_wide",
+                           wide),
+                   # a rejected sliced step stays rejected: its
+                   # donated form must not resurrect it
+                   (variant("full_step_sliced_donate",
+                            "full_step_sliced", sliced)
+                    if sliced is not None else None),
+                   (variant("full_step_wide_sliced_donate",
+                            "full_step_wide_sliced", wide_sliced)
+                    if wide_sliced is not None else None))
+        else:
+            fns = (e.full_step, wide, sliced, wide_sliced)
+        if not self._obs:
+            return fns
+        # compile telemetry: every step variant the launch dispatches
+        # reports its executable-cache misses (ARCHITECTURE §11)
+        names = ("step", "step_wide", "step_sliced",
+                 "step_wide_sliced")
+        return tuple(self._watched(n, f)
+                     for n, f in zip(names, fns))
+
+    def _watched(self, name: str, fn):
+        """Memoized CompileWatch wrapper around a launch program (a
+        changed underlying fn — engine swap, donate flip — re-wraps)."""
+        if fn is None:
+            return None
+        w = self._compile_watch.get(name)
+        if w is None or w.fn is not fn:
+            w = self._compile_watch[name] = obs.CompileWatch(
+                fn, name, on_miss=self._on_compile_event)
+        return w
+
+    def _on_compile_event(self, ev: Dict[str, Any]) -> None:
+        """One watched program compiled: count it by phase (warmup
+        coverage vs a serve-time first-use leak — the latter is the
+        dispatch-p99 bug the counter exists to catch) and keep the
+        event in the service-local log the flight dumps carry."""
+        phase = "warmup" if self._in_warmup else "serve"
+        self._c_compile.labels(phase).inc()
+        self._c_compile_ms.labels(phase).inc(ev.get("compile_ms", 0.0))
+        self._compile_log.append({**ev, "phase": phase})
 
     def _launch_enqueue(self, kind: np.ndarray, slot: np.ndarray,
                         val: np.ndarray, k: int, want_vsn: bool,
@@ -3049,7 +3173,8 @@ class BatchedEnsembleService:
             lease_snapshot=lease_snapshot, donated=donated,
             active=active, a_width=a_width, sliced=sliced,
             op_slot_np=np.asarray(slot) if host_planes else None,
-            flush_id=obs.next_flush_id() if self._obs else 0)
+            flush_id=obs.next_flush_id() if self._obs else 0,
+            t_join=t0)
 
     def _fetch_packed(self, fl: _InFlightLaunch) -> np.ndarray:
         """Block until the launch's packed result is on the host (the
@@ -3229,6 +3354,9 @@ class BatchedEnsembleService:
         # rolled the election back.
         if won_np.any():
             self._slot_vsn_ok[won_np] = False
+            # election-churn mirror (the health verb's signal): one
+            # count per won election per row, successful launches only
+            self.elections_np[won_np] += 1
         # Leader changes (won elections) notify watchers only on a
         # SUCCESSFUL launch — the except path above rolled the mirror
         # back, and a watcher told of a rolled-back leader would act
@@ -3451,6 +3579,84 @@ class BatchedEnsembleService:
         horizon = self.runtime.now + self._read_margin
         return float((self.lease_until[live] > horizon).mean())
 
+    def health(self, ens: Optional[int] = None) -> Dict[str, Any]:
+        """Ensemble-health snapshot — the scale path's analog of the
+        reference's cluster status / ``get_info`` surface, sourced
+        ENTIRELY from host mirrors (leader_np, lease_until, the
+        corruption flags, the committed-vsn slab): zero device
+        rounds, callable on a loaded service at verb rate.
+
+        ``ens=None`` answers the service level: per-row aggregates
+        (leadered/electing/corrupt/lease-valid row counts, total
+        election churn) plus the service depths a capacity dashboard
+        needs — WAL record depth, queued device rounds, launches in
+        flight, per-slot pending writes, live payload handles.
+
+        ``ens=N`` answers one row: leader, margin-valid lease (and
+        raw remaining seconds), election churn, corrupt flag, queue
+        depth, pending writes, live keys, and the row's COMMITTED
+        epoch/seq high-water (the max (epoch, seq) over the
+        committed-vsn mirror — the host-visible analog of the ballot
+        epoch; rows whose mirror was invalidated by a fresh election
+        report the pre-election watermark until their next device
+        read re-mirrors).
+
+        Everything is plain ints/floats/strings — the svcnode
+        ``("health",)`` verb ships it through the restricted wire
+        codec verbatim."""
+        now = self.runtime.now
+        horizon = now + self._read_margin
+        if ens is not None:
+            assert 0 <= ens < self.n_ens, f"bad ensemble {ens}"
+            vsn_row = self._slot_vsn_np[ens]
+            ok_row = self._slot_vsn_ok[ens]
+            if ok_row.any():
+                epochs = vsn_row[ok_row]
+                hi = epochs[np.lexsort((epochs[:, 1], epochs[:, 0]))][-1]
+                committed = (int(hi[0]), int(hi[1]))
+            else:
+                committed = (0, 0)
+            return {
+                "ens": int(ens),
+                "live": bool(self._live[ens]),
+                "leader": int(self.leader_np[ens]),
+                "members": [bool(b) for b in self.member_np[ens]],
+                "lease_valid": bool(self.lease_until[ens] > horizon),
+                "lease_remaining_s": round(
+                    max(0.0, float(self.lease_until[ens]) - now), 6),
+                "elections": int(self.elections_np[ens]),
+                "corrupt": bool(self._corrupt_rows[ens]),
+                "committed_epoch": committed[0],
+                "committed_seq": committed[1],
+                "queued_ops": int(self._queue_rounds[ens]),
+                "pending_writes": len(self._pending_writes[ens]),
+                "live_keys": len(self.key_slot[ens]),
+                "tenant": self.tenant_label(ens),
+            }
+        live = self._live
+        elect, _cand = self._election_inputs()
+        return {
+            "schema": "retpu-health-v1",
+            "n_ens": int(self.n_ens),
+            "live_ensembles": int(live.sum()),
+            "ensembles_with_leader": int(
+                ((self.leader_np >= 0) & live).sum()),
+            "electing": int((elect & live).sum()),
+            "lease_valid_fraction": round(
+                self._lease_valid_fraction(), 4),
+            "corrupt_rows": int(self._corrupt_rows.sum()),
+            "elections_total": int(self.elections_np.sum()),
+            "wal_records": (int(self._wal.count)
+                            if self._wal is not None else None),
+            "queued_ops": int(sum(self._queue_rounds)),
+            "launches_in_flight": len(self._inflight_launches),
+            "pending_writes": int(sum(
+                len(d) for d in self._pending_writes)),
+            "live_payloads": len(self.values),
+            "flushes": int(self.flushes),
+            "ops_served": int(self.ops_served),
+        }
+
     # -- observability plane (docs/ARCHITECTURE.md §11) ---------------------
 
     def _register_obs_metrics(self) -> None:
@@ -3463,6 +3669,41 @@ class BatchedEnsembleService:
         directly."""
         self.obs_registry.collect(self._obs_service_collect)
         self.obs_registry.collect(self._obs_tenant_collect)
+        self.obs_registry.collect(self._obs_cost_collect)
+        # live backend memory (device plane telemetry): reads the
+        # default device's allocator stats at export time; backends
+        # without memory_stats (CPU) export None/NaN rather than 0
+        self.obs_registry.gauge(
+            "retpu_backend_mem_bytes",
+            "bytes in use on the default jax device (NaN when the "
+            "backend reports no memory stats)", fn=_backend_mem_bytes)
+
+    def _obs_cost_collect(self) -> Dict[str, Any]:
+        """Per-bucket XLA cost-analysis gauges captured at warmup
+        (labels are step buckets: ``k8``, ``k8_a16``, ...)."""
+        return {
+            "retpu_step_cost_flops": obs.registry.family(
+                "gauge", "warmup-lowered step cost model flops",
+                {b: c.get("flops") for b, c in
+                 self._step_costs.items()
+                 if c.get("flops") is not None}, label="bucket"),
+            "retpu_step_cost_bytes": obs.registry.family(
+                "gauge", "warmup-lowered step bytes accessed",
+                {b: c.get("bytes_accessed") for b, c in
+                 self._step_costs.items()
+                 if c.get("bytes_accessed") is not None},
+                label="bucket"),
+        }
+
+    def _flight_extras(self) -> Dict[str, Any]:
+        """Flight-dump sections beyond the flush ring (schema v2):
+        the per-op SLO tail (slowest acked entries with their stage
+        splits) and the recent compile events."""
+        return {
+            "slow_ops": (self._slo.slowest(5)
+                         if self._slo is not None else []),
+            "compile_events": list(self._compile_log),
+        }
 
     def _obs_service_collect(self) -> Dict[str, Any]:
         def fam(typ, help, val):
@@ -3620,49 +3861,122 @@ class BatchedEnsembleService:
                     float(self.tenant_rounds[rr].sum()) / launches,
                     4)),
             "retpu_tenant_op_p50_ms": fam(
-                "gauge", "tenant op latency p50 upper bound (each op "
-                "charged its flush's oldest enqueue-to-resolve time)",
+                "gauge", "tenant op latency p50 (each entry charged "
+                "its measured submit-to-ack time, per-op SLO ring)",
                 lambda rr: round(self._tenant_pctl(rr, 0.5), 3)),
             "retpu_tenant_op_p99_ms": fam(
-                "gauge", "tenant op latency p99 upper bound (each op "
-                "charged its flush's oldest enqueue-to-resolve time)",
+                "gauge", "tenant op latency p99 (each entry charged "
+                "its measured submit-to-ack time, per-op SLO ring)",
                 lambda rr: round(self._tenant_pctl(rr, 0.99), 3)),
         }
 
-    def _obs_account_taken(self, taken, committed) -> None:
-        """Per-tenant attribution for one resolved flush: vectorized
-        adds over the flush's active rows (O(|taken|), not O(E) and
-        not per-op).
+    def _obs_account_taken(self, taken, committed,
+                           t_settle: Optional[float] = None,
+                           rec: Optional[Dict[str, float]] = None,
+                           fid: int = 0,
+                           t_join: float = 0.0) -> None:
+        """Per-tenant + per-op attribution for one resolved flush:
+        ONE pass over the taken entries (C-level attrgetter per
+        entry) feeding vectorized folds — O(|entries|) appends, not
+        per-op Python dicts.
 
-        Latency estimator: each OP is charged its flush's
-        oldest-enqueue→resolve time (the batch's worst op) — a
-        conservative upper bound recorded at batch granularity, the
-        price of staying off the per-op Python path.  Weighting by
-        the op count (not one sample per flush) keeps a 64-op batch
-        from counting like a 1-op batch, so cross-tenant p99
-        comparisons compare the same estimator; leased fast reads
-        contribute lowest-bucket samples from their own hook."""
+        The per-op SLO ring records each entry's REAL client-
+        perceived submit→ack latency (an entry's ops share its
+        stamps — batch granularity within an entry, entry granularity
+        within the flush; the join/settle/ack times are the flush's,
+        shared); the fold targets are the per-kind
+        ``retpu_op_latency_ms`` histogram, the per-tenant ``[E, B]``
+        plane, and the span store (the flush's slowest entry attaches
+        under ``slow_ops`` with its stage split, so
+        ``obs.timeline(fid)`` resolves a tail op to queue wait vs
+        flush vs ack).  Leased fast reads contribute their own
+        samples from the hit hook.  ``t_settle`` is when the flush's
+        outcome was known (on a replicated leader: AFTER the host
+        quorum — ack stamps land after quorum settle by
+        construction); ``rec`` is the launch's latency record,
+        consulted for the slow entry's dominating flush mark."""
         now = time.perf_counter()
         rows: List[int] = []
-        nops: List[int] = []
-        lats: List[float] = []
+        cols: List[Tuple] = []   # (kind, n, t_sub, t_enq) per entry
+        enss: List[int] = []
+        fields = _OP_SLO_FIELDS
         for e, ops in taken:
             rows.append(e)
-            nops.append(sum(op.n for op in ops))
-            t0 = min((op.t_enq for op in ops if op.t_enq),
-                     default=now)
-            lats.append((now - t0) * 1e3)
+            cols.extend(map(fields, ops))
+            enss.extend([e] * len(ops))
         if not rows:
             return
         rr = np.asarray(rows, np.int64)
-        nn = np.asarray(nops, np.int64)
-        np.add.at(self.tenant_ops, rr, nn)
-        bidx = np.searchsorted(self._lat_edges,
-                               np.asarray(lats)).astype(np.int64)
-        np.add.at(self._tenant_lat, (rr, bidx), nn)
         if committed is not None:
             np.add.at(self.tenant_commits, rr,
                       committed[:, rr].sum(axis=0).astype(np.int64))
+        if not cols:
+            return
+        kk_l, nn_l, ts_l, te_l = zip(*cols)
+        w = np.asarray(nn_l, np.int64)
+        ee = np.asarray(enss, np.int64)
+        np.add.at(self.tenant_ops, ee, w)
+        if self._slo is None:
+            return
+        folded = self._slo.record_flush(
+            kk_l, enss, nn_l, ts_l, te_l, fid,
+            t_join if t_join else (t_settle or now),
+            t_settle if t_settle else now, now)
+        if folded is None:
+            return
+        _phys, lat_ms = folded
+        bidx = np.searchsorted(self._lat_edges, lat_ms)
+        # per-tenant: each entry's ops charged the entry's own
+        # client-perceived latency (replacing PR 6's flush-oldest
+        # upper bound with the measured per-entry value)
+        np.add.at(self._tenant_lat, (ee, bidx), w)
+        # per-kind registry histogram: fold bucket counts per kind
+        # present in this flush (<= 5 kinds, B buckets — bounded)
+        kk = np.asarray(kk_l, np.int16)
+        nb = len(self._lat_edges) + 1
+        for kcode in np.unique(kk):
+            sel = kk == kcode
+            child = self._h_op.labels(
+                obs.opslo.KIND_NAMES[int(kcode)])
+            counts = np.bincount(bidx[sel], weights=w[sel],
+                                 minlength=nb)
+            ccounts = child.counts
+            for bi in np.nonzero(counts)[0]:
+                ccounts[bi] += int(counts[bi])
+            child.count += int(w[sel].sum())
+            child.sum += float((lat_ms[sel] * w[sel]).sum())
+        # tail attachment: the flush's slowest entry joins the span
+        # record under its flush_id, with the launch's dominating
+        # mark riding along when the record is at hand.  Built from
+        # THIS call's locals, never from the ring row — a flush wider
+        # than the ring capacity recycles physical rows within one
+        # record_flush, and reading the row back would attach a
+        # different entry's identity to the tail sample.
+        i = int(np.argmax(lat_ms))
+        t_sub_i = ts_l[i] if ts_l[i] > 0.0 else te_l[i]
+        tj = t_join if t_join else (t_settle or now)
+        tst = t_settle if t_settle else now
+        slow = {
+            "kind": obs.opslo.KIND_NAMES[int(kk_l[i])],
+            "ens": int(enss[i]),
+            "n": int(nn_l[i]),
+            "flush_id": int(fid),
+            "ms": round(max(0.0, now - t_sub_i) * 1e3, 3),
+            "stages_ms": {
+                "assign": round(max(0.0, te_l[i] - t_sub_i) * 1e3, 3),
+                "queue_wait": round(max(0.0, tj - te_l[i]) * 1e3, 3),
+                "flush": round(max(0.0, tst - tj) * 1e3, 3),
+                "ack": round(max(0.0, now - tst) * 1e3, 3),
+            },
+        }
+        if rec is not None:
+            marks = {c: v for c, v in rec.items()
+                     if isinstance(v, (int, float))
+                     and c not in obs.flightrec.META_FIELDS}
+            if marks:
+                slow["flush_mark"] = max(marks, key=marks.get)
+        if fid:
+            obs.SPANS.record(fid, "leader", [], slow_ops=[slow])
 
     def _obs_note_put_bytes(self, ens: int, handles) -> None:
         """Attribute queued put payload bytes to the row's tenant
@@ -3688,6 +4002,10 @@ class BatchedEnsembleService:
         rec = fl.rec
         total = rec.get("total", 0.0)
         self._h_flush.record(total * 1e3)
+        # (re-)attach the dump extras provider: tests replace the
+        # recorder to lower its trigger thresholds, and the per-op
+        # tail + compile-event sections must survive that
+        self.flight.extras = self._flight_extras
         obs.SPANS.record(
             fl.flush_id, "leader",
             # META_FIELDS (incl. the derived 'enqueue' = h2d +
@@ -3720,7 +4038,7 @@ class BatchedEnsembleService:
                 b <<= 1
         return ladder
 
-    def warmup(self, buckets=None) -> None:
+    def warmup(self, buckets=None, capture_costs=None) -> None:
         """Pre-compile the launch path's XLA programs on a THROWAWAY
         state (never the live one: a warmup launch that mutated
         ``self.state`` outside the real op stream would corrupt it —
@@ -3747,7 +4065,23 @@ class BatchedEnsembleService:
         (a_width None = full width) restricting the PACK grid — the
         step ladder always warms in full.  bench.py and svcnode share
         the default full grid.
+
+        ``capture_costs``: XLA cost-analysis gauges per warmed step
+        bucket (``retpu_step_cost_flops``/``_bytes``, labeled by
+        bucket; engine.lowered_cost_analysis — an extra lowering per
+        bucket, ~0.5 s each).  None (default) captures only the
+        deepest full-width bucket so routine warmups stay cheap;
+        True captures every bucket (svcnode ``--warm`` boots do);
+        False skips capture.  Compile events recorded during warmup
+        land under ``phase="warmup"`` either way.
         """
+        self._in_warmup = True
+        try:
+            self._warmup(buckets, capture_costs)
+        finally:
+            self._in_warmup = False
+
+    def _warmup(self, buckets, capture_costs) -> None:
         jnp = self._jnp
         e, m, s = self.n_ens, self.n_peers, self.n_slots
         pack = self._pack
@@ -3809,6 +4143,13 @@ class BatchedEnsembleService:
                 st, won, res = step_sliced(
                     st, aidx, el, cd, kind_a, kind_a, kind_a,
                     lease_a, up, exp_epoch=kind_a, exp_seq=kind_a)
+                if self._obs and capture_costs:
+                    ca = eng.lowered_cost_analysis(
+                        step_sliced, st, aidx, el, cd, kind_a, kind_a,
+                        kind_a, lease_a, up, exp_epoch=kind_a,
+                        exp_seq=kind_a)
+                    if ca:
+                        self._step_costs[f"k{k_eff}_a{aw}"] = ca
             np.asarray(pack(won, res, True))
             return True
 
@@ -3838,6 +4179,15 @@ class BatchedEnsembleService:
             st, won, res = step(
                 st, elect, cand, kind, kind, kind, lease, up,
                 exp_epoch=kind, exp_seq=kind)
+            # per-bucket XLA cost gauges: always the deepest bucket
+            # (one extra lowering); every bucket when asked
+            if (self._obs and capture_costs is not False
+                    and (capture_costs or k >= self.max_k)):
+                ca = eng.lowered_cost_analysis(
+                    step, st, elect, cand, kind, kind, kind, lease,
+                    up, exp_epoch=kind, exp_seq=kind)
+                if ca:
+                    self._step_costs[f"k{k}"] = ca
             warm_pack(won, res, k)
             if k >= self.max_k:
                 break
@@ -4321,7 +4671,8 @@ class BatchedEnsembleService:
                                      ack=wal_err is None,
                                      op_planes=(fl.kind_np,
                                                 fl.op_slot_np),
-                                     rec=rec)
+                                     rec=rec, fid=fl.flush_id,
+                                     t_join=fl.t_join)
         t_end = time.perf_counter()
         # Finish the breakdown the launch recorded: oldest-op queue
         # wait, WAL append+sync, per-future resolve.  Per-component
@@ -4749,7 +5100,8 @@ class BatchedEnsembleService:
 
     def _resolve_flush(self, taken, planes, ack: bool = True,
                        ack_reads: bool = True, op_planes=None,
-                       rec=None) -> int:
+                       rec=None, fid: int = 0,
+                       t_join: float = 0.0) -> int:
         """Resolve every taken op from the result planes.  With
         ``ack=False`` (the WAL write failed) committed writes keep
         their device-side bookkeeping — the commit is real — but
@@ -4766,6 +5118,11 @@ class BatchedEnsembleService:
         in the loop's exact per-column round order, and the per-op
         loops below skip their mirror writes — byte-identical slabs
         either way."""
+        # per-op SLO settle stamp: the moment this flush's outcome is
+        # known to the host.  On a replicated leader this method runs
+        # AFTER the host-quorum decision (_settle_batch), so ack
+        # stamps land after quorum settle by construction.
+        t_settle = time.perf_counter() if self._obs else 0.0
         committed, get_ok, found, value, vsn = planes
 
         if committed is None:  # k == 0: election-only launch, no ops
@@ -4929,6 +5286,7 @@ class BatchedEnsembleService:
                         self._fail_op(e, op)
         self.ops_served += served
         if self._obs and taken:
-            self._obs_account_taken(taken, committed)
+            self._obs_account_taken(taken, committed, t_settle, rec,
+                                    fid, t_join)
         self._drain_recycles()
         return served

@@ -453,6 +453,12 @@ def _delta_scatter_cells(svc: BatchedEnsembleService,
 
     t0 = time.perf_counter()
     scatter, finish = _delta_fns()
+    if svc._obs:
+        # compile telemetry (ARCHITECTURE §11): a delta batch landing
+        # on an un-warmed scatter bucket pays a mid-ack XLA compile —
+        # the watch makes that a counted, named event
+        scatter = svc._watched("delta_scatter", scatter)
+        finish = svc._watched("delta_finish", finish)
     st = svc.state
     for off in range(0, cells.shape[0], _DELTA_SCATTER_CAP):
         chunk = cells[off:off + _DELTA_SCATTER_CAP]
@@ -495,19 +501,26 @@ def warm_delta_apply(svc: BatchedEnsembleService) -> None:
     import jax.numpy as jnp
 
     scatter, finish = _delta_fns()
+    if svc._obs:
+        scatter = svc._watched("delta_scatter", scatter)
+        finish = svc._watched("delta_finish", finish)
     top = 8
     while top < min(_DELTA_SCATTER_CAP, svc.n_ens * svc.n_slots):
         top <<= 1
-    st, b = svc.state, 8
-    while b <= top:
-        e_j = jnp.zeros((b,), jnp.int32)
-        s_j = jnp.full((b,), svc.n_slots, jnp.int32)  # o-o-r: drop
-        z = jnp.zeros((b,), jnp.int32)
-        st = scatter(st, e_j, s_j, z, z, z)
-        b <<= 1
-    svc.state = finish(
-        st, jnp.asarray(np.asarray(st.obj_seq_ctr, np.int32)),
-        jnp.zeros((svc.n_ens, svc.n_peers), bool))
+    svc._in_warmup = True  # compile events land under phase=warmup
+    try:
+        st, b = svc.state, 8
+        while b <= top:
+            e_j = jnp.zeros((b,), jnp.int32)
+            s_j = jnp.full((b,), svc.n_slots, jnp.int32)  # oor: drop
+            z = jnp.zeros((b,), jnp.int32)
+            st = scatter(st, e_j, s_j, z, z, z)
+            b <<= 1
+        svc.state = finish(
+            st, jnp.asarray(np.asarray(st.obj_seq_ctr, np.int32)),
+            jnp.zeros((svc.n_ens, svc.n_peers), bool))
+    finally:
+        svc._in_warmup = False
 
 
 def tree_roots(svc: BatchedEnsembleService) -> np.ndarray:
@@ -1568,7 +1581,8 @@ class _PendingEntry:
     outcome is known."""
 
     __slots__ = ("seq", "crc", "entry", "taken", "planes", "ack",
-                 "ack_reads", "shipped_at", "fid", "op_planes")
+                 "ack_reads", "shipped_at", "fid", "op_planes",
+                 "rec", "t_join")
 
     def __init__(self, seq: int, crc: int, entry: Tuple,
                  shipped_at: float = 0.0, fid: int = 0) -> None:
@@ -1583,6 +1597,12 @@ class _PendingEntry:
         #: host (kind, slot) op planes — the native mirror scatter's
         #: inputs, claimed with taken/planes and replayed at settle
         self.op_planes: Any = None
+        #: the launch's latency record + flush-join time (obs): the
+        #: deferred resolve replays them so the per-op SLO fold sees
+        #: the true join→quorum-settle window and the slow-op tail
+        #: gets its dominating flush mark
+        self.rec: Any = None
+        self.t_join = 0.0
         self.ack = True
         self.ack_reads = True
         #: runtime.now when the flush was enqueued — the base of any
@@ -2844,7 +2864,8 @@ class ReplicatedService(BatchedEnsembleService):
 
     def _resolve_flush(self, taken, planes, ack: bool = True,
                        ack_reads: bool = True, op_planes=None,
-                       rec=None) -> int:
+                       rec=None, fid: int = 0,
+                       t_join: float = 0.0) -> int:
         """Defer resolution until the flush's host-quorum outcome is
         in (an ack may never outrun the host quorum — READS INCLUDED:
         a minority/deposed leader serving reads would break
@@ -2858,10 +2879,13 @@ class ReplicatedService(BatchedEnsembleService):
             return super()._resolve_flush(taken, planes, ack=ack,
                                           ack_reads=ack_reads,
                                           op_planes=op_planes,
-                                          rec=rec)
+                                          rec=rec, fid=fid,
+                                          t_join=t_join)
         self._unclaimed = None
         entry.taken, entry.planes = taken, planes
         entry.op_planes = op_planes
+        entry.rec = rec
+        entry.t_join = t_join
         entry.ack, entry.ack_reads = ack, ack_reads
         self._drain_pending(down_to=self.repl_window)
         return 0
@@ -3013,7 +3037,9 @@ class ReplicatedService(BatchedEnsembleService):
                 super()._resolve_flush(entry.taken, entry.planes,
                                        ack=entry.ack and q,
                                        ack_reads=entry.ack_reads and q,
-                                       op_planes=entry.op_planes)
+                                       op_planes=entry.op_planes,
+                                       rec=entry.rec, fid=entry.fid,
+                                       t_join=entry.t_join)
 
     def flush(self) -> int:
         served = super().flush()
@@ -3193,6 +3219,36 @@ class ReplicatedService(BatchedEnsembleService):
             **self.group_stats,
         }
         return s
+
+    def health(self, ens: Optional[int] = None) -> Dict[str, Any]:
+        """The ensemble-health verb on a replicated leader carries a
+        ``group`` section too (the host-quorum plane a dashboard
+        needs next to the device-plane rows): role, group epoch/seq,
+        link liveness/sync, pipeline depth outstanding, host-lease
+        validity and the quorum-failure/deposition history — all
+        host-side bookkeeping, zero device rounds (per-row queries
+        pass through unchanged)."""
+        out = super().health(ens)
+        if ens is not None:
+            return out
+        out["group"] = {
+            "leader": bool(self.is_leader),
+            "epoch": int(self._ge),
+            "seq": int(self._grp_seq),
+            "size": int(self.group_size),
+            "peers_connected": sum(l.connected for l in self._links),
+            "peers_synced": sum(not l.needs_sync
+                                for l in self._links),
+            "pipeline_pending": int(self._outstanding()),
+            "host_lease_valid": bool(
+                self._host_lease_until
+                > self.runtime.now + self._read_margin),
+            "quorum_failures": int(
+                self.group_stats.get("quorum_failures", 0)),
+            "depositions": int(
+                self.group_stats.get("depositions", 0)),
+        }
+        return out
 
     def stop(self) -> None:
         self._drain_launches()

@@ -36,6 +36,18 @@ requests and correlate out-of-order completions:
     ("metrics", "prometheus")        -> str: the same registry in
                                        Prometheus text exposition
                                        format (scrape-ready)
+    ("health",)                      -> dict: ensemble-health summary
+                                       (leadered/electing/corrupt row
+                                       counts, lease validity, WAL/
+                                       queue/pending-write depths) —
+                                       host mirrors only, zero device
+                                       rounds (the cluster-status
+                                       analog; ARCHITECTURE §11)
+    ("health", ens)                  -> dict: one row's leader,
+                                       lease validity + remaining,
+                                       election churn, corrupt flag,
+                                       committed (epoch, seq) high-
+                                       water, queue/pending depths
 
 Reads (``kget``/``kget_vsn``/``kget_many``) are served through the
 service's lease-protected fast path when its conditions hold — the
@@ -236,6 +248,21 @@ class ServiceServer:
                     else:
                         send(req_id, self.svc.obs_registry.snapshot())
                     continue
+                if op == "health":
+                    # ensemble-health verb (the cluster-status
+                    # analog): host-mirror-sourced, zero device
+                    # rounds — safe to poll on a loaded service
+                    try:
+                        ens_arg = None
+                        if args:
+                            ens_arg = args[0]
+                            if type(ens_arg) is not int or \
+                                    not 0 <= ens_arg < self.svc.n_ens:
+                                raise ValueError(ens_arg)
+                        send(req_id, self.svc.health(ens_arg))
+                    except Exception:
+                        send(req_id, ("error", "bad-request"))
+                    continue
                 if op in ("create_ensemble", "destroy_ensemble",
                           "resolve_ensemble"):
                     send(req_id, self._lifecycle(op, args))
@@ -407,6 +434,15 @@ class ServiceClient:
             return await self.call("metrics", **kw)
         return await self.call("metrics", fmt, **kw)
 
+    async def health(self, ens: Optional[int] = None, **kw):
+        """Ensemble-health snapshot (the riak_ensemble cluster-status
+        analog): service-level depths + per-row aggregates, or one
+        row's leader/lease/epoch/churn/corrupt detail with ``ens`` —
+        served from host mirrors, zero device rounds."""
+        if ens is None:
+            return await self.call("health", **kw)
+        return await self.call("health", ens, **kw)
+
     async def create_ensemble(self, name, view=None, **kw):
         return await self.call("create_ensemble", name, view, **kw)
 
@@ -468,8 +504,10 @@ async def serve(n_ens: int, n_peers: int, n_slots: int,
         # pow2 active-column widths, both want_vsn pack variants
         # (covers the read fast path's get-only fallback shapes) — so
         # no client ever pays a mid-serving first-compile inside its
-        # op latency (the dispatch p99 blip)
-        svc.warmup()
+        # op latency (the dispatch p99 blip).  A --warm boot also
+        # captures the per-bucket XLA cost gauges
+        # (retpu_step_cost_flops/_bytes) for the metrics verb.
+        svc.warmup(capture_costs=True)
     server = ServiceServer(svc, host, port)
     await server.start()
     return server

@@ -103,6 +103,102 @@ def test_obs_overhead_smoke():
             > 0.4 * out["obs_off_ops_per_sec"]), out
 
 
+def test_op_trace_overhead_smoke():
+    """The per-op SLO tracing A/B on the keyed rung: both arms run,
+    the traced arm really recorded per-op samples, and tracing
+    doesn't crater throughput even at smoke shapes (the 2% bound is
+    pinned at round time on the real shape — smoke batches on a CI
+    box measure noise, so the tier-1 bound stays loose)."""
+    out = bench.run_op_trace_overhead(16, 3, 8, 4, seconds=0.4)
+    assert out["op_trace_on_ops_per_sec"] > 0
+    assert out["op_trace_off_ops_per_sec"] > 0
+    assert out["op_trace_samples_recorded"] > 0, \
+        "traced arm recorded no per-op samples"
+    assert (out["op_trace_on_ops_per_sec"]
+            > 0.4 * out["op_trace_off_ops_per_sec"]), out
+
+
+def test_bench_trend_check():
+    """The bench-trend ratchet rides tier-1 (the CI/tooling
+    satellite): a missing/malformed BENCH round JSON, an empty
+    trajectory, or an out-of-band same-box regression in the
+    recorded rounds fails HERE instead of shipping an unreadable
+    trajectory into the next round."""
+    import os
+
+    from tools import bench_trend
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    report = bench_trend.check(repo)
+    assert report["rounds"] >= 5, report
+    assert report["newest_ops_per_sec"] > 0
+    # the trajectory table renders every recorded round
+    rows = bench_trend.trajectory(bench_trend.load_rounds(repo))
+    assert len(rows) == report["rounds"]
+    assert all(isinstance(r["value"], (int, float)) for r in rows)
+
+
+def test_bench_trend_check_rejects_malformed(tmp_path):
+    """The ratchet is loud: a torn/headline-less round file raises,
+    it does not read as an empty trajectory."""
+    import json
+
+    import pytest as _pytest
+
+    from tools import bench_trend
+
+    with _pytest.raises(bench_trend.TrendError):
+        bench_trend.check(str(tmp_path))  # no rounds at all
+    (tmp_path / "BENCH_r01.json").write_text("{not json")
+    with _pytest.raises(bench_trend.TrendError):
+        bench_trend.check(str(tmp_path))
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"n": 1, "parsed": {"no_value": True}}))
+    with _pytest.raises(bench_trend.TrendError):
+        bench_trend.check(str(tmp_path))
+    # a same-box regression below the band trips the ratchet
+    box = {"cpu_count": 2, "jax": "j", "jaxlib": "jl",
+           "platform": "p"}
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"n": 1, "parsed": {"value": 100.0, "box": box}}))
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps({"n": 2, "parsed": {"value": 10.0, "box": box}}))
+    with _pytest.raises(bench_trend.TrendError):
+        bench_trend.check(str(tmp_path), tolerance=0.5)
+    # within the band: ok, and the report names the comparison
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps({"n": 2, "parsed": {"value": 80.0, "box": box}}))
+    rep = bench_trend.check(str(tmp_path), tolerance=0.5)
+    assert rep["comparable_rounds"] == 1
+    assert rep["best_same_box_ops_per_sec"] == 100.0
+
+
+def test_bench_smoke_trend_tripwire():
+    """The current smoke rung vs the best same-fingerprint recorded
+    point (BENCH_SMOKE_TREND.json), within a tolerance band: a
+    host-path regression that halves the keyed rung on the SAME box
+    fails tier-1 here.  A different box (no matching fingerprint)
+    skips — cross-box comparisons are weather, not regressions."""
+    import os
+
+    from riak_ensemble_tpu.obs import box_fingerprint
+    from tools import bench_trend
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    shape = {"n_ens": 32, "n_peers": 3, "n_slots": 8, "k": 8}
+    best = bench_trend.smoke_best(
+        repo, bench_trend.fingerprint_key(box_fingerprint()), shape)
+    if best is None:
+        pytest.skip("no same-fingerprint smoke point recorded in "
+                    "BENCH_SMOKE_TREND.json")
+    rate = bench.run_keyed_batched_only(seconds=0.5, **shape)
+    # 4x band: wide enough for loadavg weather on a shared box,
+    # tight enough to catch a real host-path cliff
+    assert rate > best / 4.0, (
+        f"keyed smoke rung {rate:.0f} ops/s fell out of band vs the "
+        f"recorded same-box best {best:.0f} (tolerance 4x)")
+
+
 def test_native_resolve_ab_smoke():
     """The native-resolve A/B runner: both arms run, the native arm
     really takes the kernel (or the runner says the toolchain is

@@ -45,6 +45,21 @@ try:
 except Exception as exc:
     print("SKIP:", exc); raise SystemExit(0)
 
+# Capability probe: initialize() succeeding does NOT mean the backend
+# can EXECUTE cross-process computations — jaxlib's CPU collectives
+# need a Gloo/MPI client, and without one the first sharded
+# device_put dies mid-scenario with "Multiprocess computations
+# aren't implemented on the CPU backend".  Probe with one tiny
+# cross-process broadcast and convert that environment limitation
+# into the deterministic SKIP the parent understands.
+try:
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("retpu-mp-probe")
+except Exception as exc:
+    print("SKIP: multiprocess collectives unavailable on this "
+          "backend:", exc)
+    raise SystemExit(0)
+
 assert jax.device_count() == 8, jax.device_count()
 assert jax.local_device_count() == 4
 

@@ -134,6 +134,8 @@ def test_flight_trigger_ring_bound_and_dump_roundtrip(tmp_path,
     assert data["schema"] == DUMP_SCHEMA
     assert data["trigger"]["flush_id"] == 999
     assert any(r.get("flush_id") == 999 for r in data["ring"])
+    # schema v2 sections present even without an extras provider
+    assert data["slow_ops"] == [] and data["compile_events"] == []
     box = data["box"]
     assert box["schema"] == "retpu-box-fingerprint-v1"
     assert box["cpu_count"] == os.cpu_count()
@@ -198,6 +200,25 @@ def test_injected_slow_flush_dumps_on_live_service(tmp_path,
     assert snap["box"]["cpu_count"] == os.cpu_count()
     assert len(snap["ring"]) >= 8
     assert os.path.exists(snap["path"])
+    # schema v2: the live service's dump carries the per-op ring
+    # tail (slowest acked ops, stage splits, flush-id joins).  The
+    # very slowest row is the first-compile-era op (its queue wait
+    # ate the XLA compile — itself a correct attribution); the
+    # STALLED op appears in the tail with its flush stage dominating
+    assert snap["slow_ops"], "per-op tail section missing"
+    assert all(o["flush_id"] > 0 for o in snap["slow_ops"])
+    stalled = [o for o in snap["slow_ops"]
+               if o["ms"] >= stall * 1e3 * 0.9
+               and o["stages_ms"]["flush"]
+               >= o["stages_ms"]["queue_wait"]]
+    assert stalled, snap["slow_ops"]
+    # compile-event section present and well-formed (entries only
+    # when THIS process's jit caches were cold for these shapes —
+    # earlier tests may have warmed them; the deterministic
+    # un-warmed-bucket catch lives in test_opslo with a unique E)
+    assert isinstance(snap["compile_events"], list)
+    for e in snap["compile_events"]:
+        assert e["phase"] in ("serve", "warmup") and e["fn"], e
     # the anomalous flush is queryable through the obs span API too
     tl = obs.timeline(snap["trigger"]["flush_id"])
     assert tl is not None and "leader" in tl
@@ -408,6 +429,50 @@ def test_tracer_finished_ring_bounded_and_registry_fold():
     assert h.count == 100
     assert tr.percentiles("op")[0.5] == 0.5
     tr.uninstall()
+
+
+# -- svcnode health verb ----------------------------------------------------
+
+def test_svcnode_health_verb():
+    """The ensemble-health verb over the wire: service summary and
+    per-row detail, host-mirror-sourced (no flush needed to answer),
+    with hostile ensemble indices rejected."""
+    import asyncio
+
+    from riak_ensemble_tpu import svcnode
+
+    async def run():
+        server = await svcnode.serve(4, 3, 8, port=0, tick=0.002,
+                                     config=fast_test_config())
+        client = svcnode.ServiceClient(server.host, server.port)
+        await client.connect()
+        try:
+            r = await client.kput(1, "k", b"v")
+            assert r[0] == "ok"
+            h = await client.health()
+            assert h["schema"] == "retpu-health-v1"
+            assert h["n_ens"] == 4
+            assert h["ensembles_with_leader"] >= 1
+            assert h["queued_ops"] == 0
+            assert isinstance(h["pending_writes"], int)
+            row = await client.health(1)
+            assert row["ens"] == 1 and row["leader"] >= 0
+            assert row["committed_epoch"] >= 1
+            assert row["elections"] >= 1
+            assert row["corrupt"] is False
+            assert row["lease_valid"] in (True, False)
+            # flushes advance the flush counter, not the verb: a
+            # health read is zero-device-round (flushes unchanged
+            # modulo the server's own tick loop serving real ops)
+            bad = await client.call("health", 99)
+            assert bad == ("error", "bad-request")
+            bad2 = await client.call("health", -1)
+            assert bad2 == ("error", "bad-request")
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(run())
 
 
 # -- svcnode metrics verb ---------------------------------------------------

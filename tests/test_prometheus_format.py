@@ -1,0 +1,202 @@
+"""Prometheus exposition-format conformance (text format 0.0.4).
+
+``render_prometheus`` is a scrape surface: one malformed line makes
+Prometheus reject the WHOLE scrape.  This test parses the rendered
+text with a strict line grammar — labeled histograms' cumulative
+``_bucket``/``+Inf``/``_sum``/``_count`` families, NaN gauges from
+broken callbacks, escaped tenant labels — so the format can't
+silently drift under refactors (the exposition-conformance
+satellite).
+"""
+
+import math
+import re
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from riak_ensemble_tpu import obs  # noqa: E402
+from riak_ensemble_tpu.parallel.batched_host import (  # noqa: E402
+    BatchedEnsembleService, WallRuntime)
+
+NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+#: exposition value grammar: decimal/scientific floats, integers,
+#: NaN and signed Inf (what Prometheus' strconv accepts)
+VALUE = r"(?:[+-]?(?:\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|\.\d+)|NaN|[+-]Inf)"
+#: label VALUE: backslash-escaped; raw newlines/quotes are illegal
+LABEL_VAL = r'"(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+LABEL = rf"{NAME}={LABEL_VAL}"
+SAMPLE_RE = re.compile(
+    rf"^({NAME})(?:\{{({LABEL}(?:,{LABEL})*)?\}})? ({VALUE})$")
+HELP_RE = re.compile(rf"^# HELP ({NAME}) [^\n]*$")
+TYPE_RE = re.compile(
+    rf"^# TYPE ({NAME}) (counter|gauge|histogram|summary|untyped)$")
+LABEL_SPLIT_RE = re.compile(rf"({NAME})=({LABEL_VAL})(?:,|$)")
+
+
+def parse_exposition(txt: str):
+    """Strict parse: every line must be a HELP/TYPE comment or a
+    sample matching the grammar.  Returns (samples, types) where
+    samples is [(name, {label: rawvalue}, value_str)]."""
+    assert txt.endswith("\n"), "exposition must end with a newline"
+    samples = []
+    types = {}
+    for line in txt.split("\n")[:-1]:
+        if line.startswith("# HELP"):
+            assert HELP_RE.match(line), f"bad HELP line: {line!r}"
+            continue
+        if line.startswith("# TYPE"):
+            m = TYPE_RE.match(line)
+            assert m, f"bad TYPE line: {line!r}"
+            assert m.group(1) not in types, \
+                f"duplicate TYPE for {m.group(1)}"
+            types[m.group(1)] = m.group(2)
+            continue
+        m = SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name, labelblob, value = m.group(1), m.group(2), m.group(3)
+        labels = dict(LABEL_SPLIT_RE.findall(labelblob or ""))
+        samples.append((name, labels, value))
+    return samples, types
+
+
+def base_name(name: str) -> str:
+    for suf in ("_bucket", "_sum", "_count"):
+        if name.endswith(suf):
+            return name[:-len(suf)]
+    return name
+
+
+def _check_histogram_series(samples, hist_name, sel_labels):
+    """One (histogram, label-set) series: cumulative nondecreasing
+    buckets ending at le=+Inf == _count, plus _sum and _count."""
+    def match(labels):
+        # exact series selector: the non-le labels must equal the
+        # selector (so {} picks the parent's own unlabeled series)
+        return {k: v for k, v in labels.items()
+                if k != "le"} == sel_labels
+
+    buckets = [(lbl["le"], float(v)) for n, lbl, v in samples
+               if n == f"{hist_name}_bucket" and match(lbl)]
+    assert buckets, (hist_name, sel_labels)
+    counts = [v for _le, v in buckets]
+    assert counts == sorted(counts), \
+        f"{hist_name}: buckets must be cumulative: {buckets}"
+    les = [le for le, _v in buckets]  # raw label values keep quotes
+    assert les[-1] == '"+Inf"', f"{hist_name}: last le must be +Inf"
+    # the finite edges are strictly increasing numbers
+    fin = [float(le.strip('"')) for le in les[:-1]]
+    assert fin == sorted(set(fin)), les
+    count = [float(v) for n, lbl, v in samples
+             if n == f"{hist_name}_count" and match(lbl)]
+    total = [float(v) for n, lbl, v in samples
+             if n == f"{hist_name}_sum" and match(lbl)]
+    assert len(count) == 1 and len(total) == 1, \
+        f"{hist_name}: need exactly one _count and _sum per series"
+    assert counts[-1] == count[0], \
+        f"{hist_name}: +Inf bucket {counts[-1]} != _count {count[0]}"
+
+
+def test_exposition_grammar_labeled_hist_nan_gauge_escaping():
+    """A registry exercising every exposition feature at once parses
+    under the strict grammar: labeled + unlabeled histogram series,
+    a NaN gauge (broken callback), counters with hostile tenant
+    labels, and a collector family."""
+    r = obs.MetricsRegistry()
+    c = r.counter("retpu_x_total", "a counter")
+    c.inc(2)
+    c.labels('evil"quote').inc(1)
+    c.labels("new\nline\\slash").inc(4)
+    r.gauge("retpu_broken_gauge", "callback dies",
+            fn=lambda: 1 / 0)  # reads NaN
+    r.gauge("retpu_neg_gauge").set(-2.5)
+    h = r.histogram("retpu_h_ms", "labeled hist",
+                    buckets=(0.5, 5.0, 50.0))
+    h.record(0.1)  # parent-direct records AND labeled children
+    h.labels("hot").record(3.0)
+    h.labels("hot").record(7000.0)  # +Inf overflow
+    h.labels('quiet"t').record(0.2)
+    r.collect(lambda: {"retpu_fam_total": {
+        "type": "counter", "help": "fam",
+        "values": {"a b": 1, None: 7}}})
+    txt = r.render_prometheus()
+    samples, types = parse_exposition(txt)
+
+    # TYPE declared for every sampled family, before its samples
+    sampled = {base_name(n) for n, _l, _v in samples}
+    assert sampled <= set(types), sampled - set(types)
+    for name, labels, _v in samples:
+        if base_name(name) != name:
+            assert types[base_name(name)] == "histogram", name
+
+    # counters: hostile labels escaped, values intact
+    cx = {tuple(sorted(lbl.items())): v for n, lbl, v in samples
+          if n == "retpu_x_total"}
+    assert (("tenant", '"evil\\"quote"'),) in cx
+    assert (("tenant", '"new\\nline\\\\slash"'),) in cx
+    assert cx[()] == "2"
+
+    # NaN gauge renders literal NaN (and parses under the grammar)
+    nan = [v for n, _l, v in samples if n == "retpu_broken_gauge"]
+    assert nan == ["NaN"] and math.isnan(float(nan[0]))
+    neg = [v for n, _l, v in samples if n == "retpu_neg_gauge"]
+    assert float(neg[0]) == -2.5
+
+    # histogram series: the labeled children AND the parent's own
+    # direct series, each cumulative with +Inf == _count
+    _check_histogram_series(samples, "retpu_h_ms",
+                            {"tenant": '"hot"'})
+    _check_histogram_series(samples, "retpu_h_ms",
+                            {"tenant": '"quiet\\"t"'})
+    parent = [s for s in samples
+              if s[0] == "retpu_h_ms_bucket" and "tenant" not in s[1]]
+    assert parent, "parent-direct histogram series missing"
+    _check_histogram_series(
+        samples, "retpu_h_ms",
+        {})  # unlabeled selector sees the parent series first
+    # collector family: labeled + unlabeled samples
+    fam = {lbl.get("tenant"): v for n, lbl, v in samples
+           if n == "retpu_fam_total"}
+    assert fam['"a b"'] == "1" and fam[None] == "7"
+
+
+def test_exposition_grammar_live_service():
+    """The real service registry (op-latency kind histogram, tenant
+    collectors, compile counters, NaN backend-mem gauge on CPU)
+    renders a scrape that parses clean under the same grammar."""
+    svc = BatchedEnsembleService(WallRuntime(), 4, 3, 8, tick=None,
+                                 max_ops_per_tick=4)
+    svc.set_tenant_label(0, 'ten"ant')
+    futs = [svc.kput_many(0, ["a", "b"], [b"1", b"2"]),
+            svc.kget(1, "x")]
+    while any(svc.queues):
+        svc.flush()
+    assert all(f.done for f in futs)
+    txt = svc.obs_registry.render_prometheus()
+    samples, types = parse_exposition(txt)
+    names = {n for n, _l, _v in samples}
+    assert "retpu_flushes_total" in names
+    assert "retpu_compile_events_total" in names
+    # per-op latency histogram: per-kind series, cumulative
+    assert types["retpu_op_latency_ms"] == "histogram"
+    _check_histogram_series(samples, "retpu_op_latency_ms",
+                            {"kind": '"put"'})
+    # CPU backend: the memory gauge reads NaN, and the scrape
+    # survives it
+    mem = [v for n, _l, v in samples
+           if n == "retpu_backend_mem_bytes"]
+    assert len(mem) == 1
+    svc.stop()
+
+
+def test_parse_rejects_malformed_lines():
+    """The grammar itself has teeth: raw quotes/newlines in label
+    values, bare words, and missing values all fail the parse."""
+    for bad in ('retpu_x{tenant="a"b"} 1\n',
+                "retpu_x 1 2 3 junk\n",
+                "retpu_x{tenant=unquoted} 1\n",
+                "retpu_x\n",
+                "# TYPE retpu_x flavor\n"):
+        with pytest.raises(AssertionError):
+            parse_exposition(bad)

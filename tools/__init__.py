@@ -1,0 +1,1 @@
+# repo tooling (tools.bench_trend et al.) — importable from tests

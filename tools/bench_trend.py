@@ -1,0 +1,269 @@
+"""Bench-trend ratchet: fold every recorded bench round into ONE
+trajectory table, and fail loudly when the record degrades.
+
+Five-plus rounds of ``BENCH_r0*.json`` ride the repo, but until now
+the only way to see the trajectory (24k → 436k → ...) was a human
+re-reading JSON — and a malformed round, a silently-empty field, or
+an out-of-band regression shipped without anyone noticing.  This
+tool is the ratchet:
+
+- ``python tools/bench_trend.py`` prints the trajectory table —
+  headline ops/sec, the keyed/mixed/repgroup rungs, the measured
+  speedup A/Bs, obs overhead, the ``escale_cpu`` E-scaling points,
+  and each round's box-fingerprint key (so a cross-round delta is
+  read against the box before being believed).
+- ``python tools/bench_trend.py --check`` exits non-zero when any
+  round file is missing its headline, malformed, or when the NEWEST
+  round regressed out-of-band against the best earlier round whose
+  box fingerprint matches (``--tolerance``, default 0.5: the newest
+  same-box headline must stay above half the best — loose on
+  purpose; boxes wobble, 2x cliffs don't happen by accident).
+- The smoke tripwire (``tests/test_bench_smoke.py``) compares the
+  CURRENT smoke-shape keyed rung against the best same-fingerprint
+  point recorded in ``BENCH_SMOKE_TREND.json`` via
+  :func:`smoke_best` — a tier-1 catch for host-path regressions that
+  only round-time bench rungs would otherwise see.
+
+Box-fingerprint matching uses (cpu_count, jax, jaxlib, platform):
+hostnames are container-random, loadavg is weather.  Rounds captured
+before fingerprints existed (r1-r5) report key ``None`` and never
+match — the check then validates structure only, which is the honest
+claim for them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["TrendError", "load_rounds", "trajectory", "check",
+           "fingerprint_key", "smoke_points", "smoke_best",
+           "render_table", "SMOKE_TREND_FILE"]
+
+ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+SMOKE_TREND_FILE = "BENCH_SMOKE_TREND.json"
+SMOKE_TREND_SCHEMA = "retpu-bench-smoke-trend-v1"
+
+#: trajectory columns pulled from each round's parsed JSON (missing
+#: values render as "-"; only ``value`` is REQUIRED by --check)
+COLUMNS: Tuple[Tuple[str, str], ...] = (
+    ("value", "ops/s"),
+    ("keyed_batched_ops_per_sec", "keyed"),
+    ("mixed_ops_per_sec", "mixed"),
+    ("repgroup_ops_per_sec", "repgrp"),
+    ("read_fastpath_speedup", "read_x"),
+    ("skewed_compaction_speedup", "compact_x"),
+    ("repl_delta_speedup", "delta_x"),
+    ("resolve_native_speedup", "native_x"),
+    ("obs_overhead_pct", "obs_%"),
+)
+
+
+class TrendError(Exception):
+    """A bench round is missing/malformed, or the newest same-box
+    round regressed out-of-band — the ratchet's loud failure."""
+
+
+def fingerprint_key(box: Optional[Dict[str, Any]]
+                    ) -> Optional[Tuple]:
+    """Comparable box identity from an ``obs.box_fingerprint`` dict
+    (None when the round predates fingerprints)."""
+    if not isinstance(box, dict):
+        return None
+    return (box.get("cpu_count"), box.get("jax"), box.get("jaxlib"),
+            box.get("platform") or box.get("jax_platforms"))
+
+
+def load_rounds(root: str) -> List[Dict[str, Any]]:
+    """Every ``BENCH_rNN.json`` under ``root``, parsed and validated
+    (strict: an unreadable file or a round without its headline
+    ``value`` raises :class:`TrendError` — an empty trajectory must
+    never ship silently)."""
+    out: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path, encoding="utf-8") as fh:
+                raw = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            raise TrendError(f"{path}: unreadable round JSON "
+                             f"({exc})") from exc
+        parsed = raw.get("parsed") if isinstance(raw, dict) else None
+        if not isinstance(parsed, dict):
+            raise TrendError(f"{path}: no 'parsed' result object — "
+                             "the round recorded nothing")
+        if not isinstance(parsed.get("value"), (int, float)):
+            raise TrendError(f"{path}: headline 'value' missing or "
+                             f"non-numeric: {parsed.get('value')!r}")
+        out.append({
+            "round": int(m.group(1)),
+            "file": os.path.basename(path),
+            "parsed": parsed,
+            "box_key": fingerprint_key(parsed.get("box")),
+        })
+    return sorted(out, key=lambda r: r["round"])
+
+
+def trajectory(rounds: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """One flat row per round: the COLUMNS fields + escale points +
+    the fingerprint key."""
+    rows = []
+    for r in rounds:
+        p = r["parsed"]
+        row: Dict[str, Any] = {"round": r["round"], "file": r["file"]}
+        for key, _label in COLUMNS:
+            row[key] = p.get(key)
+        esc = p.get("escale_cpu") or {}
+        row["escale"] = {e: (pt or {}).get("ops_per_sec")
+                         for e, pt in esc.items()} if esc else {}
+        row["box_key"] = r["box_key"]
+        row["platform"] = p.get("platform")
+        rows.append(row)
+    return rows
+
+
+def render_table(rows: List[Dict[str, Any]]) -> str:
+    heads = ["rnd"] + [label for _k, label in COLUMNS] \
+        + ["escale", "box"]
+    table = [heads]
+    for row in rows:
+        def fmt(v):
+            if v is None:
+                return "-"
+            if isinstance(v, float):
+                return f"{v:,.1f}" if abs(v) >= 100 else f"{v:g}"
+            return str(v)
+        esc = ",".join(f"{e}:{fmt(v)}" for e, v in row["escale"].items())
+        box = row["box_key"]
+        table.append([str(row["round"])]
+                     + [fmt(row[k]) for k, _l in COLUMNS]
+                     + [esc or "-",
+                        "-" if box is None else f"cpu{box[0]}"])
+    widths = [max(len(r[i]) for r in table)
+              for i in range(len(heads))]
+    lines = ["  ".join(c.rjust(w) for c, w in zip(r, widths))
+             for r in table]
+    lines.insert(1, "  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def check(root: str, tolerance: float = 0.5) -> Dict[str, Any]:
+    """The ratchet: load every round strictly, then compare the
+    newest round's headline against the best EARLIER round with the
+    same box fingerprint.  Returns the report dict; raises
+    :class:`TrendError` on malformed rounds, an empty trajectory, or
+    a same-box regression below ``tolerance`` x best."""
+    rounds = load_rounds(root)
+    if not rounds:
+        raise TrendError(f"no BENCH_rNN.json rounds under {root} — "
+                         "the trajectory is empty")
+    newest = rounds[-1]
+    report: Dict[str, Any] = {
+        "rounds": len(rounds),
+        "newest_round": newest["round"],
+        "newest_ops_per_sec": newest["parsed"]["value"],
+        "comparable_rounds": 0,
+        "best_same_box_ops_per_sec": None,
+        "tolerance": tolerance,
+    }
+    key = newest["box_key"]
+    if key is not None:
+        same = [r for r in rounds[:-1] if r["box_key"] == key]
+        report["comparable_rounds"] = len(same)
+        if same:
+            best = max(same, key=lambda r: r["parsed"]["value"])
+            best_v = best["parsed"]["value"]
+            report["best_same_box_ops_per_sec"] = best_v
+            if newest["parsed"]["value"] < tolerance * best_v:
+                raise TrendError(
+                    f"out-of-band regression: round "
+                    f"{newest['round']} headline "
+                    f"{newest['parsed']['value']:.1f} ops/s is below "
+                    f"{tolerance:.0%} of round {best['round']}'s "
+                    f"{best_v:.1f} on the same box fingerprint")
+    return report
+
+
+# -- the tier-1 smoke trend --------------------------------------------------
+
+
+def smoke_points(root: str) -> List[Dict[str, Any]]:
+    """Recorded smoke-rung points (``BENCH_SMOKE_TREND.json``);
+    empty when the file is absent, :class:`TrendError` when it is
+    present but malformed (a torn trend file must fail loudly, not
+    read as 'no baseline')."""
+    path = os.path.join(root, SMOKE_TREND_FILE)
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+        assert data.get("schema") == SMOKE_TREND_SCHEMA, data.get(
+            "schema")
+        points = data["points"]
+        assert isinstance(points, list)
+    except (OSError, json.JSONDecodeError, KeyError,
+            AssertionError) as exc:
+        raise TrendError(
+            f"{path}: malformed smoke trend file ({exc})") from exc
+    return points
+
+
+def smoke_best(root: str, box_key: Optional[Tuple],
+               shape: Dict[str, int]) -> Optional[float]:
+    """Best recorded smoke ``keyed_batched_ops_per_sec`` whose box
+    fingerprint AND shape match; None when nothing comparable is
+    recorded (the tripwire then skips — a different box is not a
+    regression)."""
+    best = None
+    for pt in smoke_points(root):
+        if fingerprint_key(pt.get("box")) != box_key:
+            continue
+        if pt.get("shape") != shape:
+            continue
+        v = pt.get("keyed_batched_ops_per_sec")
+        if isinstance(v, (int, float)) and (best is None or v > best):
+            best = float(v)
+    return best
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="repo root holding the BENCH_*.json rounds")
+    ap.add_argument("--check", action="store_true",
+                    help="validate every round + same-box regression "
+                         "band; non-zero exit on failure")
+    ap.add_argument("--tolerance", type=float, default=0.5,
+                    help="--check band: newest same-box headline "
+                         "must exceed tolerance x best (default 0.5)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine output (trajectory rows or the "
+                         "check report)")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.check:
+            report = check(args.dir, args.tolerance)
+            print(json.dumps(report) if args.json else
+                  "bench-trend check ok: " + json.dumps(report))
+            return 0
+        rows = trajectory(load_rounds(args.dir))
+        print(json.dumps(rows) if args.json
+              else render_table(rows))
+        return 0
+    except TrendError as exc:
+        print(f"bench-trend: {exc}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
