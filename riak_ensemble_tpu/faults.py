@@ -1,0 +1,435 @@
+"""Adversarial fault-injection plane (docs/ARCHITECTURE.md §13).
+
+One :class:`FaultPlan` is shared by every transport the repo runs —
+the asyncio scalar runtime (:mod:`riak_ensemble_tpu.netruntime`), the
+deterministic simulator (:class:`riak_ensemble_tpu.runtime.Network`),
+the replication-group leader links
+(:class:`riak_ensemble_tpu.parallel.repgroup.PeerLink`) and the WAL's
+fsync barrier (:class:`riak_ensemble_tpu.parallel.wal.ServiceWAL`) —
+so one nemesis schedule can express the sc.erl fault modes the
+reference's EQC suite injects, plus the ones it could not:
+
+- **directional drop** ``A→B`` — the one-directional link failure
+  (A's frames to B vanish; B→A still delivers), the classic failover
+  killer no symmetric ``partition()`` can reproduce;
+- **per-link one-way delay with jitter** — injected RTT, which makes
+  the launch/replication pipelining claims falsifiable on one box
+  (ops/s must rise with ``pipeline_depth`` once the link is slow);
+- **bounded reorder** — adjacent-frame swaps on a link (the
+  replica's seq discipline must nack and re-sync, never misapply);
+- **fsync delay** — a slow disk under the WAL's ack barrier.
+
+Rules are keyed by ``(src, dst)`` endpoint names with ``"*"``
+wildcards.  The scalar runtimes use node names; a ``PeerLink`` is
+addressed as ``"host:port"`` with :data:`LOCAL` as the leader-side
+name.  Faults are installed programmatically (:func:`install`, or a
+plan handed directly to a ``Network``) or via environment knobs —
+``RETPU_FAULT_DROP``, ``RETPU_FAULT_RTT_MS``,
+``RETPU_FAULT_RTT_JITTER_MS``, ``RETPU_FAULT_REORDER``,
+``RETPU_FAULT_FSYNC_MS``, ``RETPU_FAULT_SEED``,
+``RETPU_FAULT_SILENT`` (see the README knob table) — so a subprocess
+replica host can run under the same nemesis as its in-process leader.
+
+Every active fault is observable: injected-fault gauges ride each
+service's metrics registry, ``svc.health()`` carries an ``injected``
+section while a plan is active, and flight-recorder dumps embed the
+plan + its counters (an operator can always distinguish a running
+nemesis from a real outage).
+
+Drop semantics: by default a dropped frame FAILS FAST at the
+injection point (a ``PeerLink`` ticket fires unresolved — a missed
+ack; the quorum consequences are identical to a silent blackhole,
+the timing is compressed so nemesis sweeps stay cheap).
+``silent=True`` (``RETPU_FAULT_SILENT=1``) keeps the true blackhole
+timing: nothing fires, callers ride their own deadlines.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["FaultPlan", "LOCAL", "install", "clear", "plan",
+           "active_plan", "from_env", "fsync_sleep"]
+
+#: the local endpoint name a PeerLink uses for its own (leader) side
+LOCAL = "local"
+
+
+def _key(src: Optional[str], dst: Optional[str]) -> Tuple[str, str]:
+    return (str(src) if src is not None else "*",
+            str(dst) if dst is not None else "*")
+
+
+class FaultPlan:
+    """One nemesis schedule: directional rules + injection counters.
+
+    Thread-safe: rule mutation and rule queries take one lock; the
+    seeded RNG makes a fixed schedule reproducible.  Counters only
+    ever grow (``heal()`` clears the rules, not the evidence).
+    """
+
+    def __init__(self, seed: int = 0, silent: bool = False) -> None:
+        self._lock = threading.Lock()
+        self._rng = random.Random(seed)
+        self.seed = int(seed)
+        #: drops surface as immediately-failed sends (False) or as a
+        #: true silent blackhole (True: nothing fires, callers hit
+        #: their own deadlines)
+        self.silent = bool(silent)
+        self._drop: set = set()
+        self._rtt: Dict[Tuple[str, str], Tuple[float, float]] = {}
+        self._reorder: Dict[Tuple[str, str], float] = {}
+        self.fsync_ms = 0.0
+        self.fsync_jitter_ms = 0.0
+        # -- counters (monotonic; per-link under the same keys) ------
+        self.dropped_frames = 0
+        self.delayed_frames = 0
+        self.delay_injected_ms = 0.0
+        self.reordered_frames = 0
+        self.fsync_delays = 0
+        self.fsync_delay_injected_ms = 0.0
+        self._per_link: Dict[Tuple[str, str], Dict[str, Any]] = {}
+
+    # -- rule surface ------------------------------------------------------
+
+    def drop(self, src: Optional[str], dst: Optional[str]) -> "FaultPlan":
+        """Blackhole frames ``src→dst`` (one direction only)."""
+        with self._lock:
+            self._drop.add(_key(src, dst))
+        return self
+
+    def undrop(self, src: Optional[str], dst: Optional[str]) -> None:
+        with self._lock:
+            self._drop.discard(_key(src, dst))
+
+    def set_rtt(self, src: Optional[str], dst: Optional[str],
+                ms: float, jitter_ms: float = 0.0) -> "FaultPlan":
+        """Inject ``ms`` of ONE-WAY delay (± uniform jitter) on every
+        frame ``src→dst``.  ``ms=0`` removes the rule."""
+        with self._lock:
+            if ms <= 0.0 and jitter_ms <= 0.0:
+                self._rtt.pop(_key(src, dst), None)
+            else:
+                self._rtt[_key(src, dst)] = (float(ms),
+                                             float(jitter_ms))
+        return self
+
+    def set_link_rtt(self, a: Optional[str], b: Optional[str],
+                     rtt_ms: float,
+                     jitter_ms: float = 0.0) -> "FaultPlan":
+        """Convenience: a full round trip of ``rtt_ms`` on the
+        ``a↔b`` link, split evenly across the two directions."""
+        self.set_rtt(a, b, rtt_ms / 2.0, jitter_ms / 2.0)
+        self.set_rtt(b, a, rtt_ms / 2.0, jitter_ms / 2.0)
+        return self
+
+    def set_reorder(self, src: Optional[str], dst: Optional[str],
+                    prob: float) -> "FaultPlan":
+        """Swap adjacent frames ``src→dst`` with probability
+        ``prob`` (bounded reorder: a window of exactly two)."""
+        with self._lock:
+            if prob <= 0.0:
+                self._reorder.pop(_key(src, dst), None)
+            else:
+                self._reorder[_key(src, dst)] = min(float(prob), 1.0)
+        return self
+
+    def set_fsync_delay(self, ms: float,
+                        jitter_ms: float = 0.0) -> "FaultPlan":
+        """Delay every WAL fsync barrier by ``ms`` (± jitter)."""
+        with self._lock:
+            self.fsync_ms = max(float(ms), 0.0)
+            self.fsync_jitter_ms = max(float(jitter_ms), 0.0)
+        return self
+
+    def heal(self) -> None:
+        """Clear every rule; counters (the evidence) survive."""
+        with self._lock:
+            self._drop.clear()
+            self._rtt.clear()
+            self._reorder.clear()
+            self.fsync_ms = 0.0
+            self.fsync_jitter_ms = 0.0
+
+    def active(self) -> bool:
+        with self._lock:
+            return bool(self._drop or self._rtt or self._reorder
+                        or self.fsync_ms > 0.0
+                        or self.fsync_jitter_ms > 0.0)
+
+    # -- query surface (the transports call these per frame) ---------------
+
+    @staticmethod
+    def _candidates(src: str, dst: str):
+        return ((src, dst), (src, "*"), ("*", dst), ("*", "*"))
+
+    def _link_counters(self, src: str, dst: str) -> Dict[str, Any]:
+        return self._per_link.setdefault(
+            (src, dst), {"drops": 0, "delayed": 0, "delay_ms": 0.0,
+                         "reorders": 0})
+
+    def should_drop(self, src: str, dst: str) -> bool:
+        """True = drop the frame (counted against the link)."""
+        with self._lock:
+            for k in self._candidates(src, dst):
+                if k in self._drop:
+                    self.dropped_frames += 1
+                    self._link_counters(src, dst)["drops"] += 1
+                    return True
+        return False
+
+    def dropping(self, src: str, dst: str) -> bool:
+        """Rule check WITHOUT counting (planning/health queries)."""
+        with self._lock:
+            return any(k in self._drop
+                       for k in self._candidates(src, dst))
+
+    def delay_s(self, src: str, dst: str) -> float:
+        """Sampled injected one-way delay in SECONDS (0.0 = no rule);
+        counts every nonzero sample against the link."""
+        with self._lock:
+            for k in self._candidates(src, dst):
+                rule = self._rtt.get(k)
+                if rule is None:
+                    continue
+                ms, jitter = rule
+                if jitter > 0.0:
+                    ms += self._rng.uniform(-jitter, jitter)
+                ms = max(ms, 0.0)
+                if ms <= 0.0:
+                    return 0.0
+                self.delayed_frames += 1
+                self.delay_injected_ms += ms
+                lc = self._link_counters(src, dst)
+                lc["delayed"] += 1
+                lc["delay_ms"] += ms
+                return ms / 1000.0
+        return 0.0
+
+    def should_swap(self, src: str, dst: str) -> bool:
+        """True = TRY to swap this frame with the next one on the
+        link.  Not counted here: a swap only really happens when a
+        second frame is queued — the sender calls
+        :meth:`count_reorder` at the moment it actually reorders."""
+        with self._lock:
+            for k in self._candidates(src, dst):
+                prob = self._reorder.get(k)
+                if prob is None:
+                    continue
+                return self._rng.random() < prob
+        return False
+
+    def count_reorder(self, src: str, dst: str) -> None:
+        """Record one REAL adjacent-frame swap (wire order changed)."""
+        with self._lock:
+            self.reordered_frames += 1
+            self._link_counters(src, dst)["reorders"] += 1
+
+    def fsync_delay_s(self) -> float:
+        """Sampled fsync delay in seconds (counts when nonzero)."""
+        with self._lock:
+            ms = self.fsync_ms
+            if self.fsync_jitter_ms > 0.0:
+                ms += self._rng.uniform(-self.fsync_jitter_ms,
+                                        self.fsync_jitter_ms)
+            ms = max(ms, 0.0)
+            if ms <= 0.0:
+                return 0.0
+            self.fsync_delays += 1
+            self.fsync_delay_injected_ms += ms
+            return ms / 1000.0
+
+    def sleep_fsync(self) -> None:
+        d = self.fsync_delay_s()
+        if d > 0.0:
+            time.sleep(d)
+
+    # -- observability -----------------------------------------------------
+
+    def describe(self) -> Dict[str, Any]:
+        """Plain-data (wire-encodable) snapshot of rules + counters —
+        the health verb's ``injected`` section, the flight-recorder
+        dump section, and the bench's embedded fault config."""
+        with self._lock:
+            return {
+                "active": bool(self._drop or self._rtt or self._reorder
+                               or self.fsync_ms > 0.0
+                               or self.fsync_jitter_ms > 0.0),
+                "silent": self.silent,
+                "seed": self.seed,
+                "drop": sorted(f"{s}>{d}" for s, d in self._drop),
+                "rtt_ms": {f"{s}>{d}": [ms, jit] for (s, d), (ms, jit)
+                           in sorted(self._rtt.items())},
+                "reorder": {f"{s}>{d}": p for (s, d), p
+                            in sorted(self._reorder.items())},
+                "fsync_ms": self.fsync_ms,
+                "fsync_jitter_ms": self.fsync_jitter_ms,
+                "counters": self.counters(),
+            }
+
+    def counters(self) -> Dict[str, Any]:
+        return {
+            "dropped_frames": self.dropped_frames,
+            "delayed_frames": self.delayed_frames,
+            "delay_injected_ms": round(self.delay_injected_ms, 3),
+            "reordered_frames": self.reordered_frames,
+            "fsync_delays": self.fsync_delays,
+            "fsync_delay_injected_ms": round(
+                self.fsync_delay_injected_ms, 3),
+        }
+
+    def link_injected(self, src: str, dst: str) -> Dict[str, Any]:
+        """One link's injected-fault view (per-link health section):
+        whether rules target it right now, plus its counters."""
+        with self._lock:
+            cand = self._candidates(src, dst)
+            rtt = next((self._rtt[k] for k in cand if k in self._rtt),
+                       None)
+            reorder = next((self._reorder[k] for k in cand
+                            if k in self._reorder), None)
+            counts = self._per_link.get((src, dst), {})
+            return {
+                "dropping": any(k in self._drop for k in cand),
+                "rtt_ms": (0.0 if rtt is None else rtt[0]),
+                "rtt_jitter_ms": (0.0 if rtt is None else rtt[1]),
+                "reorder": (0.0 if reorder is None else reorder),
+                "drops": int(counts.get("drops", 0)),
+                "delayed": int(counts.get("delayed", 0)),
+                "delay_ms": round(float(counts.get("delay_ms", 0.0)),
+                                  3),
+                "reorders": int(counts.get("reorders", 0)),
+            }
+
+
+# -- the process-global plan (env-armed) --------------------------------------
+
+def _parse_links(spec: str, with_value: bool = False,
+                 knob: str = "fault knob"):
+    """``"a>b,b>*"`` → [("a", "b"), ("b", "*")].  With
+    ``with_value=True`` each entry MUST carry a trailing ``=value``
+    suffix → [("a", "b", 2.0)] — ``=`` (not ``:``) precisely so a
+    ``host:port`` endpoint can never have its port consumed as the
+    value; an entry without a parseable value raises loudly (a
+    silently-ignored rule would report an armed nemesis that injects
+    nothing)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        val = None
+        if with_value:
+            part, sep, v = part.rpartition("=")
+            try:
+                val = float(v) if sep else None
+            except ValueError:
+                val = None
+            if val is None:
+                raise ValueError(
+                    f"{knob}: per-link entry {part + sep + v!r} "
+                    f"needs a trailing =value (e.g. 'a>b=2.5')")
+        if ">" not in part:
+            src, dst = "*", part
+        else:
+            src, _, dst = part.partition(">")
+        entry = (src.strip() or "*", dst.strip() or "*")
+        out.append(entry + ((val,) if val is not None else ()))
+    return out
+
+
+def from_env(environ=None) -> Optional[FaultPlan]:
+    """Build a plan from the environment fault knobs; None when no
+    knob is set (the common case costs one dict scan at arm time)."""
+    env = os.environ if environ is None else environ
+    keys = ("RETPU_FAULT_DROP", "RETPU_FAULT_RTT_MS",
+            "RETPU_FAULT_RTT_JITTER_MS", "RETPU_FAULT_REORDER",
+            "RETPU_FAULT_FSYNC_MS")
+    if not any(env.get(k) for k in keys):
+        return None
+    p = FaultPlan(seed=int(env.get("RETPU_FAULT_SEED", "0") or 0),
+                  silent=env.get("RETPU_FAULT_SILENT", "") == "1")
+    jitter = float(env.get("RETPU_FAULT_RTT_JITTER_MS", "0") or 0.0)
+    for entry in _parse_links(env.get("RETPU_FAULT_DROP", "")):
+        p.drop(entry[0], entry[1])
+    rtt_spec = env.get("RETPU_FAULT_RTT_MS", "").strip()
+    if rtt_spec:
+        try:
+            # global form: one number, every link both directions
+            p.set_rtt("*", "*", float(rtt_spec), jitter)
+        except ValueError:
+            for entry in _parse_links(rtt_spec, with_value=True,
+                                      knob="RETPU_FAULT_RTT_MS"):
+                p.set_rtt(entry[0], entry[1], entry[2], jitter)
+    ro_spec = env.get("RETPU_FAULT_REORDER", "").strip()
+    if ro_spec:
+        try:
+            p.set_reorder("*", "*", float(ro_spec))
+        except ValueError:
+            for entry in _parse_links(ro_spec, with_value=True,
+                                      knob="RETPU_FAULT_REORDER"):
+                p.set_reorder(entry[0], entry[1], entry[2])
+    fs = env.get("RETPU_FAULT_FSYNC_MS", "").strip()
+    if fs:
+        p.set_fsync_delay(float(fs))
+    return p
+
+
+_global: Optional[FaultPlan] = None
+_armed = False
+_arm_lock = threading.Lock()
+
+
+def install(p: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install ``p`` as the process-global plan (None = disarm; the
+    env knobs are NOT re-read after an explicit install/clear)."""
+    global _global, _armed
+    with _arm_lock:
+        _global = p
+        _armed = True
+    return p
+
+
+def clear() -> None:
+    install(None)
+
+
+def plan() -> Optional[FaultPlan]:
+    """The process-global plan: an explicit :func:`install` wins;
+    otherwise the environment fault knobs arm one lazily (once).
+    A malformed knob spec disarms the plane and shouts to stderr —
+    the first consumer may be a transport worker thread, and an
+    exception there would kill the thread and wedge its link, which
+    is worse than running without the nemesis."""
+    global _global, _armed
+    if not _armed:
+        with _arm_lock:
+            if not _armed:
+                try:
+                    _global = from_env()
+                except Exception as exc:
+                    print("riak_ensemble_tpu.faults: IGNORING "
+                          f"malformed fault-injection knobs: {exc}",
+                          file=sys.stderr, flush=True)
+                    _global = None
+                _armed = True
+    return _global
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The global plan iff it has at least one live rule — the ONE
+    call every hot path makes (None short-circuits everything)."""
+    p = plan()
+    return p if p is not None and p.active() else None
+
+
+def fsync_sleep() -> None:
+    """The ServiceWAL sync hook's default: sleep the injected fsync
+    delay of the active global plan (no-op otherwise)."""
+    p = active_plan()
+    if p is not None:
+        p.sleep_fsync()

@@ -143,7 +143,8 @@ class Workload:
                  n_keys: int = 4, ops_per_worker: int = 60,
                  op_timeout: float = 8.0, seed: int = 0,
                  nemesis_hold: Tuple[float, float] = (0.3, 1.5),
-                 member_churn: bool = False) -> None:
+                 member_churn: bool = False,
+                 oneway_partitions: bool = False) -> None:
         import random
 
         self.mc = mc
@@ -159,6 +160,11 @@ class Workload:
         self.done = 0
         self.nemesis_hold = nemesis_hold
         self.member_churn = member_churn
+        #: opt-in ONE-DIRECTIONAL partitions (the sc.erl fault mode
+        #: never ported before the fault plane: A→B delivers, B→A
+        #: drops — the classic failover killer).  Opt-in so the
+        #: pre-existing seeded sweeps keep their exact schedules.
+        self.oneway_partitions = oneway_partitions
         self.op_counts: Dict[str, int] = {}
         self.violations: List[Violation] = []
 
@@ -267,6 +273,24 @@ class Workload:
                 self.mc.suspend_peer(self.ensemble, victim)
                 yield self.runtime.sleep(self.rng.uniform(lo, hi))
                 self.mc.resume_peer(self.ensemble, victim)
+            elif self.oneway_partitions and partitions \
+                    and len(nodes) >= 3 and action < 0.75:
+                # ONE-DIRECTIONAL cut (fault plane): the victim
+                # either goes deaf (inbound dropped: it keeps
+                # sending — acks, votes, heartbeats — but hears
+                # nothing) or mute (outbound dropped: it receives
+                # the cluster's traffic but every reply vanishes).
+                # Either asymmetry must serialize exactly like the
+                # symmetric cut: a leader that can reach clients but
+                # not its quorum must stop acking.
+                victim = self.rng.choice(nodes)
+                rest = [n for n in nodes if n != victim]
+                if self.rng.random() < 0.5:
+                    self.runtime.net.partition_oneway([victim], rest)
+                else:
+                    self.runtime.net.partition_oneway(rest, [victim])
+                yield self.runtime.sleep(self.rng.uniform(lo, 2 * hi))
+                self.runtime.net.heal()
             elif partitions and len(nodes) >= 3:
                 # cut off a minority node (sc.erl partition_nodes)
                 victim = self.rng.choice(nodes)

@@ -35,7 +35,7 @@ import sys
 import time
 from typing import Any, Callable, Dict, Generator, List, Optional, Tuple
 
-from riak_ensemble_tpu import wire
+from riak_ensemble_tpu import faults, wire
 from riak_ensemble_tpu.runtime import Actor, Future, Task, Timer
 from riak_ensemble_tpu.types import PeerId
 
@@ -220,6 +220,26 @@ class NetRuntime:
         if self.net.drop_hook is not None and \
                 self.net.drop_hook(src_node, dst, msg):
             return
+        fp = self.net.active_plan()
+        if fp is not None:
+            # fault-injection plane (docs/ARCHITECTURE.md §13):
+            # directional drop, then injected per-link delay.  Delay
+            # defers only the ENQUEUE onto the per-node connection
+            # (call_later), so concurrent frames overlap their delays
+            # like real in-flight latency; jitter within the delay
+            # reorders frames on the link — the bounded-reorder mode
+            # of this runtime (TCP still delivers each enqueue run in
+            # order).
+            if fp.should_drop(src_node, dst_node):
+                return
+            d = fp.delay_s(src_node, dst_node)
+            if d > 0.0 and self.loop is not None:
+                self.loop.call_later(
+                    d, lambda: self._net_forward(dst_node, dst, msg))
+                return
+        self._net_forward(dst_node, dst, msg)
+
+    def _net_forward(self, dst_node: str, dst: Any, msg: Any) -> None:
         conn = self._conns.get(dst_node)
         if conn is None:
             addr = self.peers.get(dst_node)
@@ -278,13 +298,27 @@ class NetRuntime:
 
 class _NetPolicy:
     """Test-hook surface kept API-compatible with the simulator's
-    Network (partition/heal map to the drop hook here)."""
+    Network (partition/heal map to the drop hook here).  ``plan``
+    attaches a :class:`riak_ensemble_tpu.faults.FaultPlan`
+    (directional drop / per-link delay / reorder-by-jitter); it
+    defaults to the process-global plan, so the environment fault
+    knobs arm a subprocess node without code changes."""
 
     def __init__(self) -> None:
         self.drop_hook: Optional[Callable[[str, Any, Any], bool]] = None
+        #: fault-injection plan; None = consult the process-global
+        #: plan (env-armed) on every send
+        self.plan: Optional[faults.FaultPlan] = None
+
+    def active_plan(self) -> Optional[faults.FaultPlan]:
+        if self.plan is not None:
+            return self.plan if self.plan.active() else None
+        return faults.active_plan()
 
     def heal(self) -> None:
         self.drop_hook = None
+        if self.plan is not None:
+            self.plan.heal()
 
 
 class _Conn:

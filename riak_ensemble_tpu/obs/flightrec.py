@@ -31,9 +31,11 @@ __all__ = ["FlightRecorder", "DUMP_SCHEMA", "META_FIELDS",
            "DERIVED_MARKS"]
 
 #: v2 adds the per-op SLO ring tail (``slow_ops``: the slowest acked
-#: ops with their stage splits) and the service's recent
-#: ``compile_events`` — both from the recorder's ``extras`` callback
-#: (empty lists when no extras provider is attached)
+#: ops with their stage splits), the service's recent
+#: ``compile_events``, and the active fault-injection plan
+#: (``injected_faults`` — so an anomaly captured mid-nemesis indicts
+#: the nemesis) — all from the recorder's ``extras`` callback (empty
+#: when no extras provider is attached)
 DUMP_SCHEMA = "retpu-flight-dump-v2"
 
 #: DERIVED latency marks — sums/subdivisions of other marks
@@ -150,10 +152,11 @@ class FlightRecorder:
             },
             "ring": [dict(r) for r in self.records],
             "box": box_fingerprint(),
-            # per-op tail + compile-event sections (schema v2): empty
-            # when no extras provider is attached
+            # per-op tail + compile-event + injected-fault sections
+            # (schema v2): empty when no extras provider is attached
             "slow_ops": [],
             "compile_events": [],
+            "injected_faults": {},
         }
         if self.extras is not None:
             try:

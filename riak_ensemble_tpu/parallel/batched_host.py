@@ -88,7 +88,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from riak_ensemble_tpu import obs
+from riak_ensemble_tpu import faults, obs
 from riak_ensemble_tpu.config import Config
 from riak_ensemble_tpu.ops import engine as eng
 from riak_ensemble_tpu.parallel import resolve_native
@@ -3635,7 +3635,8 @@ class BatchedEnsembleService:
             }
         live = self._live
         elect, _cand = self._election_inputs()
-        return {
+        fp = faults.active_plan()
+        out = {
             "schema": "retpu-health-v1",
             "n_ens": int(self.n_ens),
             "live_ensembles": int(live.sum()),
@@ -3656,6 +3657,14 @@ class BatchedEnsembleService:
             "flushes": int(self.flushes),
             "ops_served": int(self.ops_served),
         }
+        if fp is not None:
+            # active fault-injection plan (docs/ARCHITECTURE.md §13):
+            # surfaced so an operator reading the health verb can
+            # distinguish a running nemesis (injected drops / RTT /
+            # fsync delay) from a real outage.  Absent entirely when
+            # no plan is armed — a clean box shows a clean verb.
+            out["injected"] = fp.describe()
+        return out
 
     # -- observability plane (docs/ARCHITECTURE.md §11) ---------------------
 
@@ -3670,6 +3679,7 @@ class BatchedEnsembleService:
         self.obs_registry.collect(self._obs_service_collect)
         self.obs_registry.collect(self._obs_tenant_collect)
         self.obs_registry.collect(self._obs_cost_collect)
+        self.obs_registry.collect(self._obs_fault_collect)
         # live backend memory (device plane telemetry): reads the
         # default device's allocator stats at export time; backends
         # without memory_stats (CPU) export None/NaN rather than 0
@@ -3695,14 +3705,54 @@ class BatchedEnsembleService:
                 label="bucket"),
         }
 
+    def _obs_fault_collect(self) -> Dict[str, Any]:
+        """Injected-fault gauges (docs/ARCHITECTURE.md §13): always
+        registered — zeros on a clean box — so a dashboard's queries
+        don't change shape when a nemesis arms, and a nonzero
+        ``retpu_fault_active`` is the one-glance nemesis flag."""
+        def fam(typ, help, val):
+            return obs.registry.family(typ, help, {None: val})
+
+        fp = faults.plan()
+        c = (fp.counters() if fp is not None else {})
+        return {
+            "retpu_fault_active": fam(
+                "gauge", "1 while a fault-injection plan with live "
+                "rules is armed in this process",
+                int(fp is not None and fp.active())),
+            "retpu_fault_dropped_frames_total": fam(
+                "counter", "frames blackholed by injected "
+                "directional drops", c.get("dropped_frames", 0)),
+            "retpu_fault_delayed_frames_total": fam(
+                "counter", "frames delayed by injected per-link RTT",
+                c.get("delayed_frames", 0)),
+            "retpu_fault_delay_injected_ms_total": fam(
+                "counter", "total injected per-link delay",
+                c.get("delay_injected_ms", 0.0)),
+            "retpu_fault_reordered_frames_total": fam(
+                "counter", "adjacent frame pairs swapped by injected "
+                "reorder", c.get("reordered_frames", 0)),
+            "retpu_fault_fsync_delays_total": fam(
+                "counter", "WAL fsync barriers delayed by injection",
+                c.get("fsync_delays", 0)),
+            "retpu_fault_fsync_delay_injected_ms_total": fam(
+                "counter", "total injected fsync delay",
+                c.get("fsync_delay_injected_ms", 0.0)),
+        }
+
     def _flight_extras(self) -> Dict[str, Any]:
         """Flight-dump sections beyond the flush ring (schema v2):
         the per-op SLO tail (slowest acked entries with their stage
-        splits) and the recent compile events."""
+        splits), the recent compile events, and — while a fault plan
+        is armed — the injected-fault state (so an anomaly dump
+        captured mid-nemesis indicts the nemesis, not the code)."""
+        fp = faults.active_plan()
         return {
             "slow_ops": (self._slo.slowest(5)
                          if self._slo is not None else []),
             "compile_events": list(self._compile_log),
+            "injected_faults": (fp.describe()
+                                if fp is not None else {}),
         }
 
     def _obs_service_collect(self) -> Dict[str, Any]:

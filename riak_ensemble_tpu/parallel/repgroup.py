@@ -144,7 +144,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from riak_ensemble_tpu import obs, wire
+from riak_ensemble_tpu import faults, obs, wire
 from riak_ensemble_tpu.config import Config
 from riak_ensemble_tpu.ops import engine as eng
 from riak_ensemble_tpu.parallel.batched_host import (
@@ -1693,12 +1693,34 @@ class PeerLink:
     """
 
     RECONNECT_DELAY = 0.2
+    #: one summarized drop/reconnect line per link per interval: an
+    #: active nemesis (or a genuinely flapping link) produces drops
+    #: at frame rate, and a log line per drop would bury stderr
+    LOG_INTERVAL = 5.0
 
-    def __init__(self, host: str, port: int, get_epoch) -> None:
+    def __init__(self, host: str, port: int, get_epoch,
+                 local_label: str = faults.LOCAL) -> None:
         self.host, self.port = host, port
+        #: fault-plane endpoint names: directional rules against this
+        #: link address the replica side as "host:port" and the
+        #: leader side as ``local_label`` (default ``faults.LOCAL``;
+        #: a service with several leaders in ONE process — in-process
+        #: nemesis groups — sets a distinct ``fault_label`` per
+        #: service so rules can target one leader's links)
+        self.label = f"{host}:{port}"
+        self.local = str(local_label)
         self._get_epoch = get_epoch
         self.connected = False
         self.needs_sync = True
+        #: connection failures observed on this link (stats surface)
+        self.drops = 0
+        #: successful re-establishments after the first connect
+        self.reconnects = 0
+        #: frames/responses the fault plane blackholed on this link
+        self.injected_drops = 0
+        self._ever_connected = False
+        self._last_drop_log = 0.0
+        self._drops_unlogged = 0
         #: at most one in-flight state snapshot; consumed (not waited
         #: on) by a later flush — installs never block the commit path
         self.install_ticket: Optional[_Ticket] = None
@@ -1757,38 +1779,89 @@ class PeerLink:
             item = self._q.get()
             if item is None:
                 continue
-            frame, ticket = item
-            try:
-                self._ensure_connected()
-                # LOCAL capture: a concurrent receiver-side _drop sets
-                # self._sock to None, and an AttributeError escaping
-                # this try would kill the sender thread — a silently
-                # dead link that never sends, fails, or resyncs again
-                sock = self._sock
-                if sock is None:
-                    raise ConnectionError("dropped mid-send")
-                # append BEFORE send: the response cannot precede the
-                # send, so the receiver always finds the ticket queued.
-                # Re-stamp posted NOW — the ticket may have dwelled in
-                # the sender queue behind a large install/patch
-                # upload, and the receiver's overdue check must time
-                # the wire wait, not the queue wait (a fresh request
-                # read as overdue would drop a healthy link and force
-                # the very re-sync the idle-timeout fix removed).
-                with self._alock:
-                    ticket.posted = time.monotonic()
-                    self._awaiting.append(ticket)
-                if isinstance(frame, _Encoded):
-                    sock.sendall(frame.payload)
-                elif isinstance(frame, _EncodedParts):
-                    _send_parts(sock, frame.parts)
-                else:
-                    send_frame(sock, frame)
-            except (OSError, ConnectionError, wire.WireError,
-                    AttributeError):
-                # the ticket may or may not have joined _awaiting;
-                # _drop fails everything outstanding either way
-                self._drop(fail_also=ticket)
+            fp = faults.active_plan()
+            if fp is not None \
+                    and fp.should_swap(self.local, self.label):
+                # bounded reorder: hold this frame and send the NEXT
+                # queued one first (window of exactly two).  Tickets
+                # ride their frames, so FIFO response pairing follows
+                # the actual wire order; the replica's seq discipline
+                # nacks the early frame and the re-sync path heals —
+                # exactly the misordering the nemesis exists to drive.
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    self._send_one(item, fp)  # nothing to swap with
+                    continue
+                if nxt is None:
+                    # close sentinel, not a frame: no swap happened —
+                    # requeue it so the loop still terminates
+                    self._q.put(None)
+                    self._send_one(item, fp)
+                    continue
+                # only NOW did the wire order actually change
+                fp.count_reorder(self.local, self.label)
+                self._send_one(nxt, fp)
+                self._send_one(item, fp)
+                continue
+            self._send_one(item, fp)
+
+    def _send_one(self, item, fp) -> None:
+        if item is None:
+            # close sentinel consumed out of order (reorder stash):
+            # put it back for the loop's own None handling
+            self._q.put(None)
+            return
+        frame, ticket = item
+        if fp is not None and fp.should_drop(self.local, self.label):
+            # injected directional blackhole (leader→replica): the
+            # frame never reaches the wire and the ticket never joins
+            # _awaiting (pairing stays consistent).  Non-silent plans
+            # fire the ticket unresolved NOW — the missed-ack outcome
+            # at injection speed; silent plans leave it to the
+            # caller's deadline (true blackhole timing).
+            self.injected_drops += 1
+            if not fp.silent:
+                ticket._fire()
+            return
+        try:
+            self._ensure_connected()
+            # LOCAL capture: a concurrent receiver-side _drop sets
+            # self._sock to None, and an AttributeError escaping
+            # this try would kill the sender thread — a silently
+            # dead link that never sends, fails, or resyncs again
+            sock = self._sock
+            if sock is None:
+                raise ConnectionError("dropped mid-send")
+            if fp is not None:
+                # injected one-way request latency: sleeping the
+                # sender delays this frame AND serializes behind it —
+                # the in-order single-connection wire a slow link is
+                d = fp.delay_s(self.local, self.label)
+                if d > 0.0:
+                    time.sleep(d)
+            # append BEFORE send: the response cannot precede the
+            # send, so the receiver always finds the ticket queued.
+            # Re-stamp posted NOW — the ticket may have dwelled in
+            # the sender queue behind a large install/patch
+            # upload, and the receiver's overdue check must time
+            # the wire wait, not the queue wait (a fresh request
+            # read as overdue would drop a healthy link and force
+            # the very re-sync the idle-timeout fix removed).
+            with self._alock:
+                ticket.posted = time.monotonic()
+                self._awaiting.append(ticket)
+            if isinstance(frame, _Encoded):
+                sock.sendall(frame.payload)
+            elif isinstance(frame, _EncodedParts):
+                _send_parts(sock, frame.parts)
+            else:
+                send_frame(sock, frame)
+        except (OSError, ConnectionError, wire.WireError,
+                AttributeError):
+            # the ticket may or may not have joined _awaiting;
+            # _drop fails everything outstanding either way
+            self._drop(fail_also=ticket)
 
     #: sentinel: the receive timed out before ANY byte arrived
     _IDLE = object()
@@ -1844,6 +1917,15 @@ class PeerLink:
                     continue
                 self._drop()
                 return
+            fp = faults.active_plan()
+            if fp is not None:
+                # injected one-way response latency (replica→leader):
+                # sleep BEFORE pairing, so the ack lands late exactly
+                # like a slow return path (later responses queue
+                # behind it — the in-order wire again)
+                d = fp.delay_s(self.label, self.local)
+                if d > 0.0:
+                    time.sleep(d)
             with self._alock:
                 # a stale receiver (its connection already dropped and
                 # replaced) must not consume the NEW connection's
@@ -1857,6 +1939,18 @@ class PeerLink:
                 # corruption — drop the connection
                 self._drop()
                 return
+            if fp is not None and fp.should_drop(self.label,
+                                                 self.local):
+                # injected directional blackhole on the RETURN path:
+                # the request reached the replica (it may well have
+                # applied!) but its ack vanishes — the genuinely
+                # ambiguous asymmetry.  The ticket is consumed (the
+                # pairing is real) but resolves to None: a missed
+                # ack; silent plans don't even fire it.
+                self.injected_drops += 1
+                if not fp.silent:
+                    t._fire()
+                continue
             t.result = resp
             t._fire()
 
@@ -1864,10 +1958,22 @@ class PeerLink:
     #: (state transfer + replica-side checkpoint), bounded so a
     #: SIGSTOP'd/partitioned peer can't wedge the worker forever
     IO_TIMEOUT = 120.0
+    #: connect + HANDSHAKE budget: the whole establishment (TCP
+    #: connect, hello, helloed) must finish inside this bound.  The
+    #: handshake reply previously ran under IO_TIMEOUT (120 s) — a
+    #: half-open peer (SYN accepted, nothing ever answers: a
+    #: firewalled port, a SIGSTOP'd accept loop, a one-directional
+    #: partition eating the response) wedged the sender thread for
+    #: two minutes per attempt
+    CONNECT_TIMEOUT = 10.0
 
     def _ensure_connected(self) -> None:
         if self.connected and self._sock is not None:
             return
+        # (no separate injected-partition check here: _send_one — the
+        # only caller — already short-circuits the same directional
+        # drop rule before any socket work, so a dropped link never
+        # reaches the connect path at all)
         # a FRESH connection must start with an EMPTY pairing queue:
         # a ticket whose send slipped in between a receiver-side
         # _drop clearing the deque and the socket actually dying can
@@ -1881,16 +1987,22 @@ class PeerLink:
         for t in dead:
             t._fire()
         self._sock = socket.create_connection(
-            (self.host, self.port), timeout=10.0)
-        self._sock.settimeout(self.IO_TIMEOUT)
+            (self.host, self.port), timeout=self.CONNECT_TIMEOUT)
         # handshake runs lockstep on the fresh socket BEFORE the
-        # receiver thread attaches (so its response is consumed here)
+        # receiver thread attaches (so its response is consumed
+        # here), under the CONNECT budget — only an ESTABLISHED link
+        # earns the generous IO_TIMEOUT
+        self._sock.settimeout(self.CONNECT_TIMEOUT)
         send_frame(self._sock, ("hello", self._get_epoch()))
         resp = recv_frame(self._sock)
         if resp[0] != "helloed":
             raise ConnectionError(f"bad handshake: {resp!r}")
+        self._sock.settimeout(self.IO_TIMEOUT)
         self.remote_state = (int(resp[1]), int(resp[2]), int(resp[3]))
         self.connected = True
+        if self._ever_connected:
+            self.reconnects += 1
+        self._ever_connected = True
         # any (re)connect is conservative: re-sync before counting
         self.needs_sync = True
         self.tried_tree = False
@@ -1900,6 +2012,11 @@ class PeerLink:
                          daemon=True).start()
 
     def _drop(self, fail_also: Optional[_Ticket] = None) -> None:
+        if not self._stop:
+            # a deliberate close() tears the socket down too — that
+            # is not a link FAILURE; only live drops count and log
+            self.drops += 1
+            self._log_drop()
         self.connected = False
         self.needs_sync = True
         self._gen += 1  # detach any receiver bound to the old socket
@@ -1918,6 +2035,52 @@ class PeerLink:
             fail_also._fire()
         if not self._stop:
             time.sleep(self.RECONNECT_DELAY)
+
+    def _log_drop(self) -> None:
+        """Rate-limited link-failure logging: at most one SUMMARIZED
+        stderr line per link per LOG_INTERVAL, carrying the count of
+        drops since the last line — an active nemesis (or a real
+        flapping link) failing at frame rate cannot spam stderr,
+        while a quiet link's first failure still logs immediately.
+        The full history rides ``drops``/``reconnects``/
+        ``injected_drops`` in stats()."""
+        self._drops_unlogged += 1
+        now = time.monotonic()
+        if now - self._last_drop_log < self.LOG_INTERVAL:
+            return
+        n, self._drops_unlogged = self._drops_unlogged, 0
+        self._last_drop_log = now
+        try:
+            print(f"[repgroup] link {self.label}: connection dropped "
+                  f"({n} drop(s), {self.injected_drops} injected, "
+                  f"{self.reconnects} reconnects since start; "
+                  f"retrying)", file=sys.stderr, flush=True)
+        except Exception:
+            pass  # a broken stderr must never take the link down
+
+    def link_stats(self) -> Dict[str, Any]:
+        """Per-link observability row (wire-encodable plain data):
+        liveness + failure counters, and — while a fault plan is
+        active — the ``injected`` section that lets an operator tell
+        a running nemesis from a real outage."""
+        out = {
+            "host": self.host,
+            "port": int(self.port),
+            "connected": bool(self.connected),
+            "synced": not self.needs_sync,
+            "drops": int(self.drops),
+            "reconnects": int(self.reconnects),
+            "injected_drops": int(self.injected_drops),
+        }
+        fp = faults.active_plan()
+        if fp is not None:
+            inj = fp.link_injected(self.local, self.label)
+            ret = fp.link_injected(self.label, self.local)
+            inj["return_dropping"] = ret.pop("dropping")
+            inj["return_rtt_ms"] = ret["rtt_ms"]
+            inj["return_drops"] = ret["drops"]
+            out["injected"] = inj
+        return out
 
 
 # -- the replicated service (leader role) ------------------------------------
@@ -1946,6 +2109,7 @@ class ReplicatedService(BatchedEnsembleService):
                  repl_window: int = 4,
                  self_addr: Optional[Tuple[str, int]] = None,
                  trust_host_lease: bool = False,
+                 fault_label: Optional[str] = None,
                  **kw) -> None:
         # the (runtime, n_ens, n_peers, n_slots) positional prefix
         # matches the base class so restore() reconstructs us from a
@@ -1997,8 +2161,19 @@ class ReplicatedService(BatchedEnsembleService):
         #: waits out the lease (docs/ARCHITECTURE.md §9).
         self.trust_host_lease = bool(trust_host_lease)
         self._host_lease_until = 0.0
+        #: fault-plane endpoint name for THIS leader's side of its
+        #: links (docs/ARCHITECTURE.md §13).  Default "local"; tests
+        #: hosting several leaders in one process pass distinct
+        #: labels so directional rules can target one leader's
+        #: links.  Constructor-peer links are built right below, so a
+        #: non-default label must arrive via this parameter (a bare
+        #: attribute assignment only affects links attached LATER —
+        #: attach_peers / promote / config growth).
+        self.fault_label = (str(fault_label) if fault_label is not None
+                            else faults.LOCAL)
         self._links: List[PeerLink] = [
-            PeerLink(h, p, lambda: self._ge) for h, p in peers]
+            PeerLink(h, p, lambda: self._ge,
+                     local_label=self.fault_label) for h, p in peers]
         #: replication window: resolved-but-unsettled flush entries,
         #: oldest first; at most repl_window deep before the ship path
         #: blocks on the head batch (per-flush quorum barrier stands —
@@ -2097,7 +2272,9 @@ class ReplicatedService(BatchedEnsembleService):
 
     def attach_peers(self, peers: Sequence[Tuple[str, int]]) -> None:
         assert not self._links, "peers already attached"
-        self._links = [PeerLink(h, p, lambda: self._ge) for h, p in peers]
+        self._links = [PeerLink(h, p, lambda: self._ge,
+                                local_label=self.fault_label)
+                       for h, p in peers]
 
     def takeover(self, timeout: float = 30.0) -> bool:
         """Establish leadership: promise round to a majority, adopt
@@ -2332,7 +2509,8 @@ class ReplicatedService(BatchedEnsembleService):
             if a == self.self_addr or a in have:
                 continue
             self._links.append(PeerLink(a[0], a[1],
-                                        lambda: self._ge))
+                                        lambda: self._ge,
+                                        local_label=self.fault_label))
             have.add(a)
 
     def membership_status(self) -> Dict[str, Any]:
@@ -3209,6 +3387,13 @@ class ReplicatedService(BatchedEnsembleService):
             "size": self.group_size,
             "peers_connected": sum(l.connected for l in self._links),
             "peers_synced": sum(not l.needs_sync for l in self._links),
+            # per-link liveness/failure rows (drop counters + any
+            # injected-fault view) — the flapping-link evidence the
+            # rate-limited stderr line summarizes
+            "links": [l.link_stats() for l in self._links],
+            "link_drops": sum(l.drops for l in self._links),
+            "link_injected_drops": sum(l.injected_drops
+                                       for l in self._links),
             "repl_window": self.repl_window,
             "pipeline_pending": self._outstanding(),
             "repl_delta": self._repl_delta and self._delta_shape_ok,
@@ -3239,6 +3424,10 @@ class ReplicatedService(BatchedEnsembleService):
             "peers_connected": sum(l.connected for l in self._links),
             "peers_synced": sum(not l.needs_sync
                                 for l in self._links),
+            # per-link rows: connection drops + the injected-fault
+            # section (satellite: an operator reading health must be
+            # able to tell a running nemesis from a real outage)
+            "links": [l.link_stats() for l in self._links],
             "pipeline_pending": int(self._outstanding()),
             "host_lease_valid": bool(
                 self._host_lease_until
@@ -4041,6 +4230,13 @@ def main(argv=None) -> int:
         trust_host_lease=args.trust_host_lease)
     print(f"repgroup replica repl={srv.repl_port} "
           f"client={srv.client_port}", flush=True)
+    fp = faults.active_plan()
+    if fp is not None:
+        # a replica host started under fault-injection knobs is part
+        # of a nemesis — say so once, loudly, so its injected fsync
+        # delays / drops are never read as a real incident
+        print(f"repgroup replica: FAULT INJECTION ACTIVE "
+              f"{fp.describe()!r}", file=sys.stderr, flush=True)
     try:
         while True:
             time.sleep(3600)

@@ -31,7 +31,9 @@ import os
 import pickle
 import struct
 import zlib
-from typing import Any, Dict, Iterable, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from riak_ensemble_tpu import faults
 
 #: sync modes: "fsync" forces records to stable storage before the ack
 #: (power-loss safe — the basic_backend put contract); "buffer" writes
@@ -188,6 +190,14 @@ class ServiceWAL:
         self.dir_path = dir_path
         self.sync_mode = sync_mode
         self._store = _open_store(os.path.join(dir_path, "wal"))
+        #: fault-injection seam (docs/ARCHITECTURE.md §13): called
+        #: immediately BEFORE every durability barrier this WAL
+        #: forces (the fsync the ack waits on), so an injected fsync
+        #: delay lands exactly where a slow disk would.  Defaults to
+        #: the process-global fault plane's sleep (a no-op without an
+        #: active ``RETPU_FAULT_FSYNC_MS``/programmatic plan);
+        #: assign a callable for a WAL-local override.
+        self.sync_hook: Callable[[], None] = faults.fsync_sleep
         # The underlying stores are not thread-safe; a replica host's
         # promise grants (connection threads) and its apply/campaign
         # writes (other threads) share one WAL.
@@ -201,6 +211,7 @@ class ServiceWAL:
             for key, value in records:
                 self._store.store(key, value)
             if self.sync_mode == "fsync":
+                self.sync_hook()
                 self._store.sync()
             else:
                 # buffer mode promises PROCESS-crash safety: the
@@ -235,6 +246,7 @@ class ServiceWAL:
             for key, value in extra_records:
                 st.store(key, value)
             if self.sync_mode == "fsync":
+                self.sync_hook()
                 self._store.sync()
             else:
                 self._flush_store()
@@ -246,6 +258,7 @@ class ServiceWAL:
             for key in keys:
                 self._store.delete(key)
             if self.sync_mode == "fsync":
+                self.sync_hook()
                 self._store.sync()
             else:
                 # Mirror log(): buffer mode still promises

@@ -184,6 +184,12 @@ class Network:
         #: adversarial reorder window (chaos mode); 0 = off
         self.chaos_window = 0.0
         self.chaos_local = 0.0
+        #: fault-injection plan (riak_ensemble_tpu.faults.FaultPlan):
+        #: DIRECTIONAL drop (the one-way partition ``partition()``
+        #: cannot express) + per-link injected delay, applied in
+        #: net_send on top of the base latency.  Lazily created by
+        #: :meth:`fault_plan`; healed with everything else.
+        self.plan = None
 
     def chaos(self, window: float = 0.05, local: float = 0.0) -> None:
         """PULSE-analog delivery permutation: every cross-node message
@@ -205,8 +211,30 @@ class Network:
             for b in group_b:
                 self.cut_links.add(frozenset((a, b)))
 
+    def fault_plan(self):
+        """The network's fault-injection plan, created on first use
+        (seeded from the runtime's RNG for reproducible schedules)."""
+        if self.plan is None:
+            from riak_ensemble_tpu import faults
+
+            self.plan = faults.FaultPlan(
+                seed=self.runtime.rng.randrange(1 << 30))
+        return self.plan
+
+    def partition_oneway(self, srcs: List[str],
+                         dsts: List[str]) -> None:
+        """Cut links in ONE direction only: frames ``src→dst`` drop,
+        ``dst→src`` still deliver — the classic failover killer the
+        symmetric :meth:`partition` cannot express."""
+        plan = self.fault_plan()
+        for a in srcs:
+            for b in dsts:
+                plan.drop(a, b)
+
     def heal(self) -> None:
         self.cut_links.clear()
+        if self.plan is not None:
+            self.plan.heal()
 
     def can_reach(self, src: str, dst: str) -> bool:
         return src == dst or frozenset((src, dst)) not in self.cut_links
@@ -346,6 +374,15 @@ class Runtime:
             return
         delay = self.net.local_latency() if dst_node == src_node \
             else self.net.latency()
+        plan = self.net.plan
+        if plan is not None and dst_node is not None \
+                and dst_node != src_node and plan.active():
+            # fault plane: directional drop, then injected per-link
+            # delay stacked on the base latency (virtual time — the
+            # schedule stays deterministic under the seeded plan RNG)
+            if plan.should_drop(src_node, dst_node):
+                return
+            delay += plan.delay_s(src_node, dst_node)
         self.send_after(delay, dst, msg)
 
     def spawn_task(self, gen: Generator, name: str = "task") -> Task:

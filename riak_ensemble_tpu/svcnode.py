@@ -42,7 +42,14 @@ requests and correlate out-of-order completions:
                                        queue/pending-write depths) —
                                        host mirrors only, zero device
                                        rounds (the cluster-status
-                                       analog; ARCHITECTURE §11)
+                                       analog; ARCHITECTURE §11).
+                                       While a fault-injection plan
+                                       is armed (env fault knobs or
+                                       programmatic) it carries an
+                                       ``injected`` section — rules +
+                                       counters — so an operator can
+                                       tell a running nemesis from a
+                                       real outage (ARCHITECTURE §13)
     ("health", ens)                  -> dict: one row's leader,
                                        lease validity + remaining,
                                        election churn, corrupt flag,
@@ -81,9 +88,10 @@ import asyncio
 import itertools
 import os
 import struct
+import sys
 from typing import Any, Dict, Optional, Tuple
 
-from riak_ensemble_tpu import wire
+from riak_ensemble_tpu import faults, wire
 from riak_ensemble_tpu.config import Config, fast_test_config
 from riak_ensemble_tpu.netruntime import NetRuntime
 from riak_ensemble_tpu.parallel.batched_host import BatchedEnsembleService
@@ -553,6 +561,14 @@ def main(argv=None) -> int:
             fast_reads=False if args.no_fast_reads else None)
         print(f"svcnode serving {args.n_ens} ensembles on "
               f"{server.host}:{server.port}", flush=True)
+        fp = faults.active_plan()
+        if fp is not None:
+            # loud, once, at boot: a node started under fault-injection
+            # knobs is part of a nemesis — an operator tailing the
+            # log must never mistake its injected failures for a real
+            # incident (the health verb carries the same section)
+            print(f"svcnode: FAULT INJECTION ACTIVE "
+                  f"{fp.describe()!r}", file=sys.stderr, flush=True)
         try:
             await asyncio.Event().wait()
         finally:

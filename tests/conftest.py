@@ -28,6 +28,15 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`: long nemesis sweeps/soaks carry
+    # the slow marker and run only in the soak lane — register it so
+    # -W error environments don't trip on an unknown marker
+    config.addinivalue_line(
+        "markers", "slow: long-running nemesis sweeps/soaks excluded "
+        "from the tier-1 window (run explicitly or via -m slow)")
+
+
 def soak_seeds(base):
     """CI runs the fixed seed list; soak sweeps widen it via
     RETPU_SOAK_SEEDS="start:count" (fresh seeds, not repeats) so

@@ -290,3 +290,59 @@ def test_repgroup_rung_smoke():
     assert out["repl_delta_entries"] > 0
     assert (out["repl_bytes_per_entry"]
             < 0.25 * out["repl_bytes_per_entry_full_plane"]), out
+
+
+def test_faultsweep_cheap_arms_smoke():
+    """Tier-1 tripwire for the faultsweep plumbing at the cheap end:
+    the fsync-delay arm really delays the WAL barrier (counted,
+    slower than baseline within noise), and the noisy-tenant arm
+    attributes hot vs quiet ops with a real quiet p99.  The RTT
+    depth-sweep arms spin up replica groups (seconds each) — they run
+    in the slow lane and at round time."""
+    from riak_ensemble_tpu import faults
+
+    base = bench._faultsweep_fsync_arm(8, 8, 8, 0.3, 0.0)
+    slow = bench._faultsweep_fsync_arm(8, 8, 8, 0.3, 3.0)
+    assert faults.active_plan() is None  # the arms clean up
+    assert base["ops_per_sec"] > 0 and slow["ops_per_sec"] > 0
+    assert base["fsync_delays"] == 0
+    assert slow["fsync_delays"] > 0, \
+        "fsync arm ran but the barrier was never delayed"
+    nt = bench._noisy_tenant_arm(16, 8, 8, 0.3, compact=True)
+    assert nt["hot_ops"] > nt["quiet_ops"] > 0
+    assert nt["quiet_p99_ms"] is not None
+    assert nt["ops_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_faultsweep_smoke():
+    """The full fault-injection rung runner (ARCHITECTURE §13): both
+    RTT arms and depths run, the injected-delay counters prove the
+    fault plane really fired inside the measured loops, the fsync arm
+    shows a real (bounded-from-below) slowdown, the noisy-tenant A/B
+    reports both compaction arms, and the fault config is embedded.
+    Ratio bounds stay loose: smoke shapes on a CI box measure noise —
+    the depth-2-wins-under-RTT acceptance is pinned at round time on
+    the full shape."""
+    from riak_ensemble_tpu import faults
+
+    out = bench.run_faultsweep(0.4, smoke=True)
+    fs = out["faultsweep"]
+    assert faults.active_plan() is None  # the runner cleans up
+    sweep = fs["rtt_sweep"]
+    assert [p["rtt_ms"] for p in sweep] == [0.0, 1.0]
+    for p in sweep:
+        assert p["depth1_ops_per_sec"] > 0
+        assert p["depth2_ops_per_sec"] > 0
+        assert p["depth2_speedup"] > 0.4, p
+    assert fs["fsync"]["baseline_ops_per_sec"] > 0
+    assert fs["fsync"]["injected_fsync_delays"] > 0, \
+        "fsync arm ran but the barrier was never delayed"
+    assert fs["fsync"]["slowdown"] > 0.8, fs["fsync"]
+    nt = fs["noisy_tenant"]
+    assert nt["hot_ops"] > nt["quiet_ops"] > 0
+    assert nt["quiet_p99_ms_compact"] is not None
+    assert nt["quiet_p99_ms_nocompact"] is not None
+    assert nt["quiet_p99_ratio"] > 0
+    assert fs["fault_config"]["fsync_ms"] == 2.0
+    assert out["faultsweep_depth2_speedup"] is not None

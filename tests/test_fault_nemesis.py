@@ -1,0 +1,401 @@
+"""One-directional-partition nemesis sweeps (docs/ARCHITECTURE.md
+§13) — the last unported sc.erl fault mode, driven against BOTH
+consensus planes:
+
+- the scalar peer plane: ``Workload(oneway_partitions=True)`` on the
+  deterministic simulator (elections, probes, quorum rounds all cross
+  asymmetric cuts; virtual clock — the fast tier-1 smoke);
+- the replication group: a live 3-host group where a leader's quorum
+  traffic is blackholed in ONE direction while its client surface
+  stays up — proving the no-dual-leader-ack-window property (a
+  deposed leader must stop acking before the new leader's first
+  commit) with the linearizability KeyModel watching every op.
+
+Fast deterministic variants (fixed seed, bounded rounds) run in
+tier-1; the randomized multi-round sweeps carry the ``slow`` marker
+(soak lane: ``-m slow``, seeds widened via RETPU_SOAK_SEEDS).
+"""
+
+import os
+import signal
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import conftest
+
+jax = pytest.importorskip("jax")
+
+from riak_ensemble_tpu import faults  # noqa: E402
+from riak_ensemble_tpu.config import fast_test_config  # noqa: E402
+from riak_ensemble_tpu.linearizability import (  # noqa: E402
+    KeyModel, Violation, Workload)
+from riak_ensemble_tpu.parallel import repgroup  # noqa: E402
+from riak_ensemble_tpu.parallel.batched_host import WallRuntime  # noqa: E402
+from riak_ensemble_tpu.testing import ManagedCluster  # noqa: E402
+from riak_ensemble_tpu.types import NOTFOUND, PeerId  # noqa: E402
+
+N_ENS = 4
+N_SLOTS = 8
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_fault_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# -- scalar peer plane: one-way partitions on the simulator ------------------
+
+
+def _three_node_cluster(seed):
+    mc = ManagedCluster(seed=seed, nodes=("node0", "node1", "node2"))
+    mc.enable("node0")
+    mc.join("node1", "node0")
+    mc.join("node2", "node0")
+    peers = [PeerId(i, f"node{i}") for i in range(3)]
+    mc.create_ensemble("sc", peers)
+    mc.wait_stable("sc")
+    return mc
+
+
+@pytest.mark.parametrize("seed", [4202])
+def test_scalar_oneway_partition_workload_smoke(seed):
+    """Tier-1 deterministic smoke: the full random workload with the
+    ONE-WAY partition nemesis arm enabled on a 3-node ensemble —
+    every acked write observable, no stale/phantom read, and the
+    asymmetric cuts really fired (plan counters)."""
+    mc = _three_node_cluster(seed)
+    w = Workload(mc, "sc", n_workers=3, n_keys=3, ops_per_worker=25,
+                 op_timeout=1.5, seed=seed, nemesis_hold=(0.3, 1.5),
+                 oneway_partitions=True)
+    w.run(partitions=True)
+    assert sum(w.op_counts.values()) >= 75
+    plan = mc.runtime.net.plan
+    assert plan is not None, "one-way nemesis arm never engaged"
+    assert plan.dropped_frames > 0, \
+        "one-way cuts were installed but no frame ever crossed them"
+    # healed at the end: the evidence stays, the rules are gone
+    assert not plan.active()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", conftest.soak_seeds([4301, 4302,
+                                                      4303]))
+def test_scalar_oneway_partition_workload_sweep(seed):
+    """Soak-lane sweep: longer workloads, member churn AND one-way
+    partitions together — the full adversarial schedule."""
+    mc = _three_node_cluster(seed)
+    w = Workload(mc, "sc", n_workers=3, n_keys=4, ops_per_worker=60,
+                 op_timeout=1.0, seed=seed, nemesis_hold=(0.5, 2.5),
+                 member_churn=True, oneway_partitions=True)
+    w.run(partitions=True)
+    assert sum(w.op_counts.values()) >= 180
+    # whether the one-way arm fired is schedule-dependent under soak
+    # seeds (member churn shares the probability space); when it did,
+    # the evidence must be coherent — healed rules, counted drops
+    plan = mc.runtime.net.plan
+    if plan is not None:
+        assert not plan.active()
+
+
+# -- replication group: in-process live 3-host harness -----------------------
+
+
+def _inproc_group(tmp_path, ack_timeout=3.0):
+    """Leader (in-process service) + two in-process ReplicaServer
+    hosts — real sockets, real protocol, one jit cache.  Each
+    replica's own future links get a distinct fault label so
+    directional rules can target the OLD leader's links alone."""
+    servers = []
+    for i in (1, 2):
+        s = repgroup.ReplicaServer(
+            N_ENS, 3, N_SLOTS, data_dir=str(tmp_path / f"r{i}"),
+            config=fast_test_config())
+        s.svc.fault_label = f"replica{i}"
+        servers.append(s)
+    svc = repgroup.ReplicatedService(
+        WallRuntime(), N_ENS, 1, N_SLOTS, group_size=3,
+        peers=[("127.0.0.1", s.repl_port) for s in servers],
+        ack_timeout=ack_timeout, config=fast_test_config(),
+        data_dir=str(tmp_path / "leader"))
+    repgroup.warmup_kernels(svc)
+    assert svc.takeover(), "takeover needs a replica majority"
+    return svc, servers
+
+
+def _settle(svc, futs, flushes=12):
+    for _ in range(flushes):
+        if all(f.done for f in futs):
+            break
+        try:
+            svc.flush()
+        except repgroup.DeposedError:
+            break
+    return [f.value if f.done else None for f in futs]
+
+
+def _control(port, frame, timeout=60.0):
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.settimeout(timeout)
+        repgroup.send_frame(s, frame)
+        return repgroup.recv_frame(s)
+
+
+@pytest.mark.slow
+def test_repgroup_oneway_blackhole_fences_deposed_leader(tmp_path):
+    """THE acceptance scenario: the leader's quorum traffic is
+    blackholed in the RETURN direction (its applies still reach the
+    replicas — they may even apply! — but every ack vanishes), while
+    its client surface stays up.  From the first blackholed flush on
+    it must ack NOTHING; a replica promotes itself and commits; the
+    linearizability model checks every key across the handoff — zero
+    dual-leader ack window.
+
+    Slow lane (3 live hosts, ~20 s): tier-1 carries the fast
+    deterministic variants instead — the scalar one-way Workload
+    smoke above and the link-level injection tests in
+    test_repgroup_link.py — so the 870 s window stays safe."""
+    svc, (r1, r2) = _inproc_group(tmp_path, ack_timeout=2.0)
+    models = {}
+
+    def model(key):
+        return models.setdefault(key, KeyModel(key))
+
+    try:
+        # -- phase 1: healthy acked writes -----------------------------
+        futs = []
+        for i in range(4):
+            m = model(f"pre{i}")
+            op = m.invoke_write(b"p%d" % i)
+            futs.append((m, op, svc.kput(i % N_ENS, f"pre{i}",
+                                         b"p%d" % i)))
+        _settle(svc, [f for *_x, f in futs])
+        for m, op, f in futs:
+            assert f.value[0] == "ok", f.value
+            m.ack_write(op)
+
+        # -- phase 2: inbound blackhole (acks dropped, sends deliver) --
+        plan = faults.install(faults.FaultPlan())
+        for link in svc._links:
+            plan.drop(link.label, faults.LOCAL)
+
+        dark = []
+        for i in range(4):
+            m = model(f"dark{i}")
+            op = m.invoke_write(b"d%d" % i)
+            dark.append((m, op, svc.kput(i % N_ENS, f"dark{i}",
+                                         b"d%d" % i)))
+        _settle(svc, [f for *_x, f in dark])
+        for m, op, f in dark:
+            assert f.done and (not isinstance(f.value, tuple)
+                               or f.value[0] != "ok"), \
+                f"acked through a blackholed quorum: {f.value!r}"
+            # the apply reached the replicas — it may have landed:
+            # ambiguous, exactly like an ack timeout
+            m.timeout_write(op)
+        g = svc.stats()["group"]
+        assert g["quorum_failures"] > 0, g
+        assert g["link_injected_drops"] > 0, g
+        # the operator-facing evidence: health names the nemesis
+        h = svc.health()
+        assert h["injected"]["active"] is True
+        assert any(l["injected_drops"] > 0
+                   for l in h["group"]["links"]), h["group"]["links"]
+
+        # -- phase 3: replica 1 promotes itself and commits ------------
+        resp = _control(r1.repl_port,
+                        ("promote", [("127.0.0.1", r2.repl_port)]))
+        assert resp[0] == "ok", resp
+        assert resp[1] > svc._ge
+
+        import asyncio
+
+        from riak_ensemble_tpu import svcnode
+
+        async def new_leader_io():
+            c = svcnode.ServiceClient("127.0.0.1", r1.client_port)
+            await c.connect()
+            # the new leader's FIRST commit
+            m = model("newldr")
+            op = m.invoke_write(b"n0")
+            r = await c.kput(0, "newldr", b"n0", timeout=60.0)
+            assert r[0] == "ok", r
+            m.ack_write(op)
+            # read back EVERY key through the new leader, checked
+            # against the model: every pre-blackhole ack observable,
+            # dark writes plausible-or-absent, nothing phantom
+            for key, m in sorted(models.items()):
+                r = await c.kget(0 if key == "newldr"
+                                 else int(key[-1]) % N_ENS, key,
+                                 timeout=60.0)
+                assert r[0] == "ok", (key, r)
+                m.ack_read(r[1])
+            await c.close()
+
+        asyncio.run(new_leader_io())
+
+        # -- phase 4: the deposed leader still cannot ack --------------
+        # (its nack responses are blackholed too, so it cannot even
+        # OBSERVE the deposition — the classic asymmetry; it must
+        # keep failing, never acking)
+        m = model("stale")
+        op = m.invoke_write(b"s0")
+        f = svc.kput(0, "stale", b"s0")
+        _settle(svc, [f])
+        assert f.done and (not isinstance(f.value, tuple)
+                           or f.value[0] != "ok"), \
+            f"deposed leader acked after the rival's commit: {f.value!r}"
+        m.timeout_write(op)
+
+        # heal: the old leader's next contact observes the fencing
+        plan.heal()
+        try:
+            for _ in range(6):
+                svc.heartbeat()
+                if svc._deposed:
+                    break
+                time.sleep(0.1)
+        except repgroup.DeposedError:
+            pass
+        assert svc._deposed, "healed leader never observed the fence"
+    finally:
+        faults.clear()
+        try:
+            svc.stop()
+        except repgroup.DeposedError:
+            pass
+        for s in (r1, r2):
+            s.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", conftest.soak_seeds([5101, 5102]))
+def test_repgroup_oneway_nemesis_sweep(tmp_path, seed):
+    """Randomized directional-fault sweep on a live 3-host group
+    (replica hosts = real OS processes): each round the nemesis
+    toggles a one-directional drop (either direction of either
+    link), injects 1-3 ms of link RTT, or heals — while random
+    put/get load runs through the leader under the KeyModel.  Ends
+    healed: every model key reads back plausible through the leader,
+    then replica 1 takes over and the same read-back must hold
+    through the NEW leader (the nemesis schedule cannot have forked
+    history across the handoff)."""
+    from test_repgroup import _spawn_replica
+
+    rng = np.random.default_rng(seed)
+    plan = faults.install(faults.FaultPlan(seed=int(seed)))
+    procs = {}
+    for name in ("r1", "r2"):
+        procs[name] = _spawn_replica(str(tmp_path / name))
+    svc = repgroup.ReplicatedService(
+        WallRuntime(), 4, 1, 8, group_size=3,
+        peers=[("127.0.0.1", procs["r1"][1]),
+               ("127.0.0.1", procs["r2"][1])],
+        ack_timeout=3.0, config=fast_test_config(),
+        data_dir=str(tmp_path / "leader"))
+    repgroup.warmup_kernels(svc)
+    assert svc.takeover()
+    labels = [l.label for l in svc._links]
+    models = {}
+    vals = iter(range(1, 10 ** 6))
+
+    def model(e, k):
+        return models.setdefault((e, k), KeyModel(f"{e}/k{k}"))
+
+    try:
+        for rnd in range(14):
+            r = rng.random()
+            lab = labels[int(rng.integers(len(labels)))]
+            if r < 0.30:
+                if rng.random() < 0.5:
+                    plan.drop(faults.LOCAL, lab)   # requests die
+                else:
+                    plan.drop(lab, faults.LOCAL)   # acks die
+            elif r < 0.45:
+                plan.set_rtt(faults.LOCAL, lab,
+                             float(rng.uniform(1.0, 3.0)))
+            elif r < 0.75:
+                plan.heal()
+
+            pending = []
+            for _ in range(6):
+                e = int(rng.integers(4))
+                k = int(rng.integers(3))
+                m = model(e, k)
+                if rng.random() < 0.6:
+                    v = next(vals)
+                    op = m.invoke_write(v)
+                    pending.append(
+                        ("put", m, op,
+                         svc.kput(e, f"k{k}", v.to_bytes(4, "big"))))
+                else:
+                    pending.append(("get", m, None,
+                                    svc.kget(e, f"k{k}")))
+            _settle(svc, [f for *_x, f in pending], flushes=10)
+            for kind, m, op, f in pending:
+                res = f.value if f.done else None
+                ok = isinstance(res, tuple) and res[0] == "ok"
+                if kind == "put":
+                    if ok:
+                        m.ack_write(op)
+                    else:
+                        m.timeout_write(op)  # may have applied
+                elif ok:
+                    v = res[1]
+                    m.ack_read(v if v is NOTFOUND
+                               else int.from_bytes(v, "big"))
+
+        # quiesce: heal, re-sync, read back through the leader
+        plan.heal()
+        end = time.monotonic() + 90.0
+        while time.monotonic() < end:
+            svc.heartbeat()
+            if svc.stats()["group"]["peers_synced"] >= 2:
+                break
+            time.sleep(0.1)
+        pending = [(m, svc.kget(e, f"k{k}"))
+                   for (e, k), m in models.items()]
+        _settle(svc, [f for _m, f in pending], flushes=12)
+        for m, f in pending:
+            assert f.done and isinstance(f.value, tuple) \
+                and f.value[0] == "ok", f.value
+            v = f.value[1]
+            m.ack_read(v if v is NOTFOUND
+                       else int.from_bytes(v, "big"))
+
+        # handoff: replica 1 takes over; history must not have forked
+        resp = _control(procs["r1"][1],
+                        ("promote", [("127.0.0.1", procs["r2"][1])]))
+        assert resp[0] == "ok", resp
+
+        import asyncio
+
+        from riak_ensemble_tpu import svcnode
+
+        async def read_through_new_leader():
+            c = svcnode.ServiceClient("127.0.0.1", procs["r1"][2])
+            await c.connect()
+            for (e, k), m in sorted(models.items()):
+                r = await c.kget(e, f"k{k}", timeout=60.0)
+                assert r[0] == "ok", ((e, k), r)
+                v = r[1]
+                m.ack_read(v if v is NOTFOUND
+                           else int.from_bytes(v, "big"))
+            await c.close()
+
+        asyncio.run(read_through_new_leader())
+        assert plan.dropped_frames > 0 or plan.delayed_frames > 0
+    finally:
+        faults.clear()
+        try:
+            svc.stop()
+        except repgroup.DeposedError:
+            pass
+        for p, _rp, _cp in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)

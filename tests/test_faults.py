@@ -1,0 +1,330 @@
+"""Fault-injection plane unit tests (docs/ARCHITECTURE.md §13):
+FaultPlan rule/counter semantics, env-knob parsing, the simulator
+Network's directional drop + injected delay, the WAL fsync-delay
+hook, and the plane's observability surfaces (gauges, health verb,
+flight-dump section).
+"""
+
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from riak_ensemble_tpu import faults, wire  # noqa: E402
+from riak_ensemble_tpu.runtime import Actor, Runtime  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_global_plan():
+    """Every test starts and ends with a disarmed global plane (a
+    leaked plan would poison unrelated suites' transports)."""
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# -- FaultPlan rules + counters ---------------------------------------------
+
+
+def test_directional_drop_is_one_way():
+    p = faults.FaultPlan()
+    p.drop("a", "b")
+    assert p.should_drop("a", "b")
+    assert not p.should_drop("b", "a")  # the other direction delivers
+    assert p.dropped_frames == 1  # only the True answer counted
+    assert p.link_injected("a", "b")["drops"] == 1
+    assert p.link_injected("b", "a")["drops"] == 0
+
+
+def test_wildcards_and_heal():
+    p = faults.FaultPlan()
+    p.drop("*", "c")
+    p.drop("d", None)  # None = "*"
+    assert p.should_drop("anything", "c")
+    assert p.should_drop("d", "anywhere")
+    assert not p.should_drop("x", "y")
+    p.heal()
+    assert not p.active()
+    assert not p.should_drop("d", "anywhere")
+    # counters (the evidence) survive the heal
+    assert p.dropped_frames == 2
+
+
+def test_rtt_jitter_bounds_and_counters():
+    p = faults.FaultPlan(seed=3)
+    p.set_rtt("a", "b", 4.0, jitter_ms=1.0)
+    for _ in range(50):
+        d = p.delay_s("a", "b")
+        assert 0.003 <= d <= 0.005, d
+    assert p.delay_s("b", "a") == 0.0  # one-way rule
+    assert p.delayed_frames == 50
+    assert 150.0 <= p.delay_injected_ms <= 250.0
+    p.set_rtt("a", "b", 0.0)  # zero removes the rule
+    assert not p.active()
+
+
+def test_link_rtt_splits_both_directions():
+    p = faults.FaultPlan()
+    p.set_link_rtt("a", "b", 10.0)
+    assert abs(p.delay_s("a", "b") - 0.005) < 1e-9
+    assert abs(p.delay_s("b", "a") - 0.005) < 1e-9
+
+
+def test_reorder_probability_seeded():
+    p = faults.FaultPlan(seed=11)
+    p.set_reorder("a", "b", 1.0)
+    assert p.should_swap("a", "b")
+    assert not p.should_swap("b", "a")
+    # should_swap only PROPOSES; a swap counts when the sender
+    # actually reorders two queued frames
+    assert p.reordered_frames == 0
+    p.count_reorder("a", "b")
+    assert p.reordered_frames == 1
+    assert p.link_injected("a", "b")["reorders"] == 1
+    p.set_reorder("a", "b", 0.0)
+    assert not p.should_swap("a", "b")
+
+
+def test_describe_is_wire_encodable_plain_data():
+    p = faults.FaultPlan(seed=5)
+    p.drop("a", "b")
+    p.set_rtt("*", "c", 2.5, 0.5)
+    p.set_reorder("a", "b", 0.25)
+    p.set_fsync_delay(3.0)
+    d = p.describe()
+    assert d["active"] and d["drop"] == ["a>b"]
+    assert d["rtt_ms"] == {"*>c": [2.5, 0.5]}
+    assert d["fsync_ms"] == 3.0
+    # the health verb ships this through the restricted codec
+    assert wire.decode(wire.encode(d)) == d
+
+
+# -- env knobs ---------------------------------------------------------------
+
+
+def test_from_env_full_parse():
+    env = {
+        "RETPU_FAULT_DROP": "a>b, *>c, local>127.0.0.1:9000",
+        "RETPU_FAULT_RTT_MS": "local>127.0.0.1:9001=2.5,b>a=1",
+        "RETPU_FAULT_RTT_JITTER_MS": "0.5",
+        "RETPU_FAULT_REORDER": "0.1",
+        "RETPU_FAULT_FSYNC_MS": "3",
+        "RETPU_FAULT_SEED": "7",
+        "RETPU_FAULT_SILENT": "1",
+    }
+    p = faults.from_env(env)
+    assert p is not None and p.active() and p.silent
+    assert p.seed == 7
+    assert p.dropping("a", "b") and p.dropping("x", "c")
+    assert not p.dropping("b", "a")
+    # a host:port DROP destination keeps its port — the README's
+    # repgroup form `local>host:port` must target the link label,
+    # never eat the port as a numeric suffix
+    assert p.dropping("local", "127.0.0.1:9000")
+    assert not p.dropping("local", "127.0.0.1")
+    # per-link rtt with a host:port destination (the ':' belongs to
+    # the address, the trailing number is the value)
+    assert p._rtt[("local", "127.0.0.1:9001")] == (2.5, 0.5)
+    assert p._rtt[("b", "a")] == (1.0, 0.5)
+    assert p._reorder[("*", "*")] == 0.1
+    assert p.fsync_ms == 3.0
+
+
+def test_from_env_global_rtt_and_empty():
+    assert faults.from_env({}) is None
+    p = faults.from_env({"RETPU_FAULT_RTT_MS": "2"})
+    assert p._rtt[("*", "*")] == (2.0, 0.0)
+
+
+def test_from_env_valueless_per_link_rtt_fails_loudly(capsys):
+    """A per-link RTT entry without its ``=ms`` value (e.g. the DROP
+    knob's endpoint form pasted into the wrong variable) must fail
+    LOUDLY, not silently arm a nemesis that injects nothing — and
+    the lazy global arm converts that to a stderr shout + disarm
+    rather than killing the first transport thread that asks."""
+    with pytest.raises(ValueError, match="needs a trailing =value"):
+        faults.from_env({"RETPU_FAULT_RTT_MS": "local>127.0.0.1:9000"})
+    import os
+    os.environ["RETPU_FAULT_RTT_MS"] = "local>127.0.0.1:9000"
+    try:
+        faults._armed = False
+        faults._global = None
+        assert faults.plan() is None  # disarmed, not crashed
+        assert "malformed fault-injection knobs" in \
+            capsys.readouterr().err
+    finally:
+        del os.environ["RETPU_FAULT_RTT_MS"]
+        faults.clear()
+
+
+def test_install_clear_and_active_plan():
+    assert faults.active_plan() is None
+    p = faults.install(faults.FaultPlan())
+    # armed but rule-less: active_plan still answers None (the hot
+    # paths short-circuit on one call)
+    assert faults.active_plan() is None
+    p.drop("a", "b")
+    assert faults.active_plan() is p
+    faults.clear()
+    assert faults.active_plan() is None
+
+
+# -- simulator Network integration ------------------------------------------
+
+
+class _Sink(Actor):
+    def __init__(self, runtime, name, node):
+        super().__init__(runtime, name, node=node)  # self-registers
+        self.got = []
+
+    def handle(self, msg):
+        self.got.append((self.runtime.now, msg))
+
+
+def _two_node_runtime():
+    rt = Runtime(seed=0)
+    a = _Sink(rt, ("manager", "a"), "a")
+    b = _Sink(rt, ("manager", "b"), "b")
+    return rt, a, b
+
+
+def test_sim_network_oneway_partition():
+    rt, a, b = _two_node_runtime()
+    rt.net.partition_oneway(["a"], ["b"])  # a→b drops, b→a delivers
+    rt.net_send("a", ("manager", "b"), "x")
+    rt.net_send("b", ("manager", "a"), "y")
+    rt.run_for(1.0)
+    assert b.got == []
+    assert [m for _t, m in a.got] == ["y"]
+    rt.net.heal()
+    rt.net_send("a", ("manager", "b"), "x2")
+    rt.run_for(1.0)
+    assert [m for _t, m in b.got] == ["x2"]
+    # the evidence survives the heal
+    assert rt.net.plan.dropped_frames == 1
+
+
+def test_sim_network_injected_delay_virtual_time():
+    rt, a, b = _two_node_runtime()
+    rt.net.fault_plan().set_rtt("a", "b", 50.0)  # 50 ms one way
+    t0 = rt.now
+    rt.net_send("a", ("manager", "b"), "slow")
+    rt.net_send("b", ("manager", "a"), "fast")
+    rt.run_for(1.0)
+    (tb, _m), = b.got
+    (ta, _m2), = a.got
+    assert tb - t0 >= 0.050          # injected on top of base latency
+    assert ta - t0 < 0.010           # unaffected direction
+
+
+# -- WAL fsync-delay hook ----------------------------------------------------
+
+
+def test_wal_fsync_delay_injected_and_counted(tmp_path):
+    from riak_ensemble_tpu.parallel.wal import ServiceWAL
+
+    w = ServiceWAL(str(tmp_path / "w"))
+    rec = [(("kv", 0, 0), ("k", 1, 1, 1, b"v", False))]
+    t0 = time.perf_counter()
+    w.log(rec)
+    base = time.perf_counter() - t0
+
+    p = faults.install(faults.FaultPlan())
+    p.set_fsync_delay(30.0)
+    t0 = time.perf_counter()
+    w.log(rec)
+    slow = time.perf_counter() - t0
+    assert slow >= 0.030
+    assert slow > base
+    assert p.fsync_delays == 1
+    assert p.fsync_delay_injected_ms >= 30.0
+
+    faults.clear()
+    t0 = time.perf_counter()
+    w.log(rec)
+    assert time.perf_counter() - t0 < 0.030
+    w.close()
+
+
+def test_wal_sync_hook_is_overridable(tmp_path):
+    """A WAL-local hook (programmatic injection without the global
+    plane) — the seam the ISSUE names."""
+    from riak_ensemble_tpu.parallel.wal import ServiceWAL
+
+    calls = []
+    w = ServiceWAL(str(tmp_path / "w"))
+    w.sync_hook = lambda: calls.append(1)
+    w.log([(("kv", 0, 0), ("k", 1, 1, 1, b"v", False))])
+    w.delete([("kv", 0, 0)])
+    assert len(calls) == 2
+    w.close()
+
+
+def test_wal_buffer_mode_skips_fsync_hook(tmp_path):
+    """Buffer mode has no fsync barrier — the slow-disk nemesis must
+    not tax the path that never touches the disk barrier."""
+    from riak_ensemble_tpu.parallel.wal import ServiceWAL
+
+    p = faults.install(faults.FaultPlan())
+    p.set_fsync_delay(50.0)
+    w = ServiceWAL(str(tmp_path / "w"), sync_mode="buffer")
+    t0 = time.perf_counter()
+    w.log([(("kv", 0, 0), ("k", 1, 1, 1, b"v", False))])
+    assert time.perf_counter() - t0 < 0.050
+    assert p.fsync_delays == 0
+    w.close()
+
+
+# -- observability surfaces --------------------------------------------------
+
+
+def test_fault_gauges_health_and_flight_section():
+    from riak_ensemble_tpu.parallel.batched_host import (
+        BatchedEnsembleService, WallRuntime)
+
+    svc = BatchedEnsembleService(WallRuntime(), 2, 1, 4, tick=None,
+                                 max_ops_per_tick=2)
+    try:
+        # clean box: gauges registered (zeros), no injected section
+        snap = svc.obs_registry.snapshot()
+        assert snap["retpu_fault_active"] == 0
+        assert snap["retpu_fault_dropped_frames_total"] == 0
+        assert "injected" not in svc.health()
+        assert svc._flight_extras()["injected_faults"] == {}
+
+        p = faults.install(faults.FaultPlan())
+        p.drop("a", "b").set_fsync_delay(1.0)
+        p.should_drop("a", "b")
+        snap = svc.obs_registry.snapshot()
+        assert snap["retpu_fault_active"] == 1
+        assert snap["retpu_fault_dropped_frames_total"] == 1
+        inj = svc.health()["injected"]
+        assert inj["active"] and inj["drop"] == ["a>b"]
+        assert svc._flight_extras()["injected_faults"]["active"]
+
+        # healed: gauge drops to 0, counters keep the history
+        p.heal()
+        snap = svc.obs_registry.snapshot()
+        assert snap["retpu_fault_active"] == 0
+        assert snap["retpu_fault_dropped_frames_total"] == 1
+        assert "injected" not in svc.health()
+    finally:
+        svc.stop()
+
+
+def test_netruntime_policy_heal_and_plan_scope():
+    """The asyncio runtime's policy: an attached plan wins over the
+    global one, and heal() clears its rules."""
+    from riak_ensemble_tpu.netruntime import _NetPolicy
+
+    pol = _NetPolicy()
+    assert pol.active_plan() is None  # nothing armed anywhere
+    g = faults.install(faults.FaultPlan().drop("x", "y"))
+    assert pol.active_plan() is g     # falls through to the global
+    own = faults.FaultPlan().drop("a", "b")
+    pol.plan = own
+    assert pol.active_plan() is own   # attached plan wins
+    pol.heal()
+    assert pol.active_plan() is None  # own rules cleared...
+    assert g.active()                 # ...the global plan untouched

@@ -61,6 +61,10 @@ COLUMNS: Tuple[Tuple[str, str], ...] = (
     ("repl_delta_speedup", "delta_x"),
     ("resolve_native_speedup", "native_x"),
     ("obs_overhead_pct", "obs_%"),
+    # depth-2 vs depth-1 ops/s at the stage's deepest injected
+    # per-link RTT point (>=1 ms; bench --stage faultsweep.  >=1.0 =
+    # the pipelining claim holds against an adversarially slow link)
+    ("faultsweep_depth2_speedup", "fault_x"),
 )
 
 
