@@ -243,6 +243,12 @@ def run_service(n_ens: int, n_peers: int, n_slots: int, k: int,
     out.update(run_native_resolve_ab(
         min(n_ens, 512), n_peers, min(n_slots, 64), min(k, 16),
         seconds))
+    # native-enqueue A/B (the other half: slab-resident pending ops +
+    # per-flush completion slab vs the per-entry pack + per-op future
+    # fan-out — same interleaved batch-granular methodology)
+    out.update(run_native_enqueue_ab(
+        min(n_ens, 512), n_peers, min(n_slots, 64), min(k, 16),
+        seconds))
     return out
 
 
@@ -362,6 +368,119 @@ def run_native_resolve_ab(n_ens: int, n_peers: int, n_slots: int,
         # kernel share — the honest answer to "did the bottleneck
         # move off resolve"
         "resolve_native_latency_breakdown": breakdown,
+    }
+
+
+def run_native_enqueue_ab(n_ens: int, n_peers: int, n_slots: int,
+                          k: int, seconds: float) -> dict:
+    """The slab enqueue half's A/B (``enqueue_native_speedup``): the
+    WAL'd keyed batched rung with ``RETPU_NATIVE_ENQUEUE`` on
+    (slab-resident pending ops, one-traversal op-plane pack, per-flush
+    completion slab — docs/ARCHITECTURE.md §12) against the per-entry
+    pack + per-op future fan-out oracle arm (``=0``).
+
+    Methodology is the PR 6/7 batch-granular interleave verbatim: one
+    live service per arm (the knob binds at construction), one stream
+    of alternating batches with the pair order flipping per
+    iteration, per-arm medians.  The round JSON gets the on arm's
+    component breakdown (``queue_wait``/``resolve`` plus the derived
+    ``enqueue_native``/``enqueue_fallback`` pack marks), BOTH arms'
+    ``queue_wait + resolve`` p50 — the acceptance criterion is that
+    combined share cut >= 2x — and the completion-slab ledger, whose
+    wakes must equal the op-carrying flush count (one wake per
+    flush, observable)."""
+    import shutil
+    import tempfile
+
+    from riak_ensemble_tpu.parallel.batched_host import (
+        BatchedEnsembleService, WallRuntime,
+    )
+
+    keys = [f"key{j}" for j in range(k)]
+    vals = [b"v%d" % j for j in range(k // 2)]
+    tmp = tempfile.mkdtemp(prefix="bench_native_enqueue_")
+
+    def make(env: str) -> BatchedEnsembleService:
+        svc = _env_scoped(
+            "RETPU_NATIVE_ENQUEUE", env,
+            lambda: BatchedEnsembleService(
+                WallRuntime(), n_ens, n_peers, n_slots, tick=None,
+                max_ops_per_tick=k,
+                data_dir=os.path.join(tmp, f"arm{env}"),
+                wal_sync="buffer"))
+        batch(svc)  # warm: slots allocate, elections fold in
+        svc.lat_records.clear()
+        return svc
+
+    def batch(svc: BatchedEnsembleService) -> float:
+        t0 = time.perf_counter()
+        futs = []
+        for e in range(n_ens):
+            futs.append(svc.kput_many(e, keys[:k // 2], vals))
+            futs.append(svc.kget_many(e, keys[k // 2:]))
+        while any(svc.queues):
+            svc.flush()
+        dt = time.perf_counter() - t0
+        assert all(f.done for f in futs), "enqueue A/B: unsettled"
+        return dt
+
+    def qw_res_p50(svc: BatchedEnsembleService) -> float:
+        """The acceptance criterion's quantity: the arm's p50
+        queue_wait + resolve (enqueue-side wait + settle fan-out)."""
+        br = svc.latency_breakdown()
+        return round(sum(br.get(c, {}).get("p50_ms", 0.0)
+                         for c in ("queue_wait", "resolve")), 3)
+
+    on_svc = off_svc = None
+    try:
+        on_svc, off_svc = make("1"), make("0")
+        assert on_svc._enq_slab and not off_svc._enq_slab
+        on_t, off_t, n = _interleaved_ab(on_svc, off_svc, batch,
+                                         seconds, 3)
+        stats_on = on_svc.stats()
+        slab = stats_on["completion_slab"]
+        breakdown = {
+            c: {"p50": round(v["p50_ms"], 3),
+                "p99": round(v["p99_ms"], 3)}
+            for c, v in on_svc.latency_breakdown().items()}
+        on_qw, off_qw = qw_res_p50(on_svc), qw_res_p50(off_svc)
+    finally:
+        for svc in (on_svc, off_svc):
+            if svc is not None:
+                try:
+                    svc.stop()
+                except Exception:
+                    pass
+        shutil.rmtree(tmp, ignore_errors=True)
+    on_med = float(np.median(on_t))
+    off_med = float(np.median(off_t))
+    ops = k * n_ens
+    return {
+        "enqueue_native_available": (
+            stats_on["native_enqueue"]["kernel"]),
+        "enqueue_native_ops_per_sec": ops / on_med,
+        "enqueue_fallback_ops_per_sec": ops / off_med,
+        "enqueue_native_speedup": round(off_med / on_med, 3),
+        "enqueue_ab_samples_per_arm": n,
+        "enqueue_ab_spread_ms": {
+            "on": [round(float(np.percentile(on_t, q)) * 1e3, 1)
+                   for q in (10, 90)],
+            "off": [round(float(np.percentile(off_t, q)) * 1e3, 1)
+                    for q in (10, 90)]},
+        # the acceptance criterion's two sides: combined queue_wait +
+        # fan-out p50 per arm (>= 2x cut is the claim under test)
+        "enqueue_queue_wait_resolve_p50_ms": {
+            "on": on_qw, "off": off_qw,
+            "cut_x": (round(off_qw / on_qw, 2) if on_qw else None)},
+        "enqueue_native_latency_breakdown": breakdown,
+        # one wake per op-carrying flush, rounds conserved — the
+        # completion slab's own ledger rides the round JSON
+        "enqueue_completion_slab": {
+            **slab,
+            "pack_flushes": (
+                stats_on["native_enqueue"]["flushes"]
+                + stats_on["native_enqueue"]["fallback_flushes"]),
+        },
     }
 
 
@@ -2329,12 +2448,13 @@ def main() -> None:
                 svc.update({k: v for k, v in r.items()
                             if k.startswith("faultsweep")})
             # E-scaling datapoints (ROADMAP carried debt item 2): the
-            # 1k-ens CPU rung always rides the round JSON; the 2k-ens
-            # point lands when the box completes it inside its own
-            # budget (each point is its own killable stage, so a slow
-            # 2k attempt can never cost the 1k number)
+            # 1k-ens CPU rung always rides the round JSON; the 2k-
+            # and 4k-ens points land when the box completes them
+            # inside their own budgets (each point is its own
+            # killable stage, so a slow deep attempt can never cost
+            # the shallower numbers)
             svc["escale_cpu"] = {}
-            for ee in (1024, 2048):
+            for ee in (1024, 2048, 4096):
                 r = _run_stage("escale", f"{ee}_ens_cpu",
                                dict(n_ens=ee, n_peers=5, n_slots=64,
                                     k=16), args.seconds, 360.0, True)
@@ -2498,6 +2618,27 @@ def main() -> None:
             if svc.get("resolve_fallback_ops_per_sec") else None),
         "resolve_native_latency_breakdown_ms": svc.get(
             "resolve_native_latency_breakdown"),
+        # slab enqueue half (ARCHITECTURE §12): the interleaved
+        # on/off A/B on the same WAL'd keyed rung, the acceptance
+        # criterion's queue_wait+resolve p50 cut per arm, the on
+        # arm's breakdown (with the derived enqueue_native/
+        # enqueue_fallback pack marks), and the completion slab's
+        # one-wake-per-flush ledger
+        "enqueue_native_available": svc.get(
+            "enqueue_native_available"),
+        "enqueue_native_speedup": svc.get("enqueue_native_speedup"),
+        "enqueue_native_ops_per_sec": (
+            round(svc["enqueue_native_ops_per_sec"], 1)
+            if svc.get("enqueue_native_ops_per_sec") else None),
+        "enqueue_fallback_ops_per_sec": (
+            round(svc["enqueue_fallback_ops_per_sec"], 1)
+            if svc.get("enqueue_fallback_ops_per_sec") else None),
+        "enqueue_queue_wait_resolve_p50_ms": svc.get(
+            "enqueue_queue_wait_resolve_p50_ms"),
+        "enqueue_native_latency_breakdown_ms": svc.get(
+            "enqueue_native_latency_breakdown"),
+        "enqueue_completion_slab": svc.get(
+            "enqueue_completion_slab"),
         # adversarial fault-injection rungs (ARCHITECTURE §13): the
         # RTT sweep's depth-1/2 points, the fsync-delay rung and the
         # noisy-tenant isolation A/B, with the injected fault config

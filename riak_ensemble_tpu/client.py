@@ -9,6 +9,13 @@ node's cluster is not enabled (maybe, client.erl:134-143).
 
 ``kmodify`` is intentionally not exposed (root-ensemble internal use
 only — client.erl:22-24).
+
+This is the SCALAR actor-plane client (one op, one FSM round).  The
+scale path's network client is :class:`riak_ensemble_tpu.svcnode.
+ServiceClient`, whose ``kput_many``/``kget_many`` are slab-native:
+batches in the slab subset ride the zero-copy ``kput_slab``/
+``kget_slab`` wire verbs straight into the service's slab-resident
+enqueue half (docs/ARCHITECTURE.md §12).
 """
 
 from __future__ import annotations

@@ -40,12 +40,16 @@ DUMP_SCHEMA = "retpu-flight-dump-v2"
 
 #: DERIVED latency marks — sums/subdivisions of other marks
 #: ('enqueue' = h2d + dispatch; resolve_native/resolve_fallback =
-#: the resolve half's per-arm share).  THE canonical list: the
-#: service's total sums (batched_host.DERIVED_MARKS) and the flight
-#: recorder's dominant-mark argmax both derive from it, so a new
-#: derived mark can never be additive in one place and excluded in
-#: the other (it would dominate every tail attribution).
-DERIVED_MARKS = ("enqueue", "resolve_native", "resolve_fallback")
+#: the resolve half's per-arm share; enqueue_native/enqueue_fallback
+#: = the ENQUEUE half's lane-build + op-plane-pack share attributed
+#: to whichever pack arm ran, already inside queue_wait).  THE
+#: canonical list: the service's total sums
+#: (batched_host.DERIVED_MARKS) and the flight recorder's
+#: dominant-mark argmax both derive from it, so a new derived mark
+#: can never be additive in one place and excluded in the other (it
+#: would dominate every tail attribution).
+DERIVED_MARKS = ("enqueue", "resolve_native", "resolve_fallback",
+                 "enqueue_native", "enqueue_fallback")
 
 #: per-flush record fields that are shape/identity metadata or
 #: derived marks, not additive latency components — shared with

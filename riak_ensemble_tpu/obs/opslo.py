@@ -126,7 +126,14 @@ class OpSloRing:
         between ~0 and a real keyed-rung overhead).  Entries that
         never settle (failed at enqueue, abandoned launches) never
         occupy a row; a batch split across flushes is two entries
-        recording under their own flush ids, weights conserved."""
+        recording under their own flush ids, weights conserved.
+
+        The column inputs accept plain sequences OR the service's
+        enqueue-time pending-slab columns verbatim (the slab enqueue
+        path collects kind/ens/weight/t_sub/t_enq per entry while
+        building its op lanes — docs/ARCHITECTURE.md §12): stamps
+        keep riding even though the entries' futures resolve from
+        completion-slab rows rather than per-op fan-out."""
         n = len(kinds)
         if not n:
             return None

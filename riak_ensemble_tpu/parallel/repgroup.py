@@ -1582,7 +1582,7 @@ class _PendingEntry:
 
     __slots__ = ("seq", "crc", "entry", "taken", "planes", "ack",
                  "ack_reads", "shipped_at", "fid", "op_planes",
-                 "rec", "t_join")
+                 "rec", "t_join", "lanes")
 
     def __init__(self, seq: int, crc: int, entry: Tuple,
                  shipped_at: float = 0.0, fid: int = 0) -> None:
@@ -1597,6 +1597,11 @@ class _PendingEntry:
         #: host (kind, slot) op planes — the native mirror scatter's
         #: inputs, claimed with taken/planes and replayed at settle
         self.op_planes: Any = None
+        #: the flush's flat op lanes (the slab enqueue path's
+        #: completion-slab index) — replayed with the planes so the
+        #: deferred resolve runs the same one-gather-per-plane path
+        #: an unreplicated settle would
+        self.lanes: Any = None
         #: the launch's latency record + flush-join time (obs): the
         #: deferred resolve replays them so the per-op SLO fold sees
         #: the true join→quorum-settle window and the slow-op tail
@@ -3043,7 +3048,7 @@ class ReplicatedService(BatchedEnsembleService):
     def _resolve_flush(self, taken, planes, ack: bool = True,
                        ack_reads: bool = True, op_planes=None,
                        rec=None, fid: int = 0,
-                       t_join: float = 0.0) -> int:
+                       t_join: float = 0.0, lanes=None) -> int:
         """Defer resolution until the flush's host-quorum outcome is
         in (an ack may never outrun the host quorum — READS INCLUDED:
         a minority/deposed leader serving reads would break
@@ -3058,10 +3063,11 @@ class ReplicatedService(BatchedEnsembleService):
                                           ack_reads=ack_reads,
                                           op_planes=op_planes,
                                           rec=rec, fid=fid,
-                                          t_join=t_join)
+                                          t_join=t_join, lanes=lanes)
         self._unclaimed = None
         entry.taken, entry.planes = taken, planes
         entry.op_planes = op_planes
+        entry.lanes = lanes
         entry.rec = rec
         entry.t_join = t_join
         entry.ack, entry.ack_reads = ack, ack_reads
@@ -3217,7 +3223,8 @@ class ReplicatedService(BatchedEnsembleService):
                                        ack_reads=entry.ack_reads and q,
                                        op_planes=entry.op_planes,
                                        rec=entry.rec, fid=entry.fid,
-                                       t_join=entry.t_join)
+                                       t_join=entry.t_join,
+                                       lanes=entry.lanes)
 
     def flush(self) -> int:
         served = super().flush()

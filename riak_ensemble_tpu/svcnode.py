@@ -27,6 +27,11 @@ requests and correlate out-of-order completions:
     ("kget_many", ens, keys)         -> [per-key results, in order]
     ("kupdate_many", ens, keys, vsns, vals) / ("kdelete_many",
     ens, keys)                       -> [per-key results, in order]
+    ("kput_slab", ens, key_lens, key_arena, val_lens, val_arena)
+                                     -> [per-key results, in order]
+    ("kget_slab", ens, key_lens, key_arena[, want_vsn])
+                                     -> [per-key results, in order]
+
     ("stats",)                       -> dict
     ("metrics",)                     -> dict: the service's full obs
                                        registry snapshot (counters,
@@ -64,6 +69,18 @@ unchanged (linearizable).  ``--no-fast-reads`` (or
 ``("stats",)`` reports ``read_fastpath_hits``/``misses`` with
 per-reason miss counters and the live ``lease_valid_fraction``.
 
+The ``*_slab`` verbs are the zero-copy batched lane
+(docs/ARCHITECTURE.md §12b): whole client-side op slabs — an int32
+byte-length table plus one joined arena per column, ascii keys /
+bytes payloads — ride a ``wire.Raw``/``encode_parts`` raw frame
+client→leader the way PR 5's delta frames already ride
+leader→replica, so a 10k-key batch decodes as a handful of term
+objects + arena slices instead of 10k per-key containers.
+:class:`ServiceClient`'s ``kput_many``/``kget_many`` route through
+them automatically whenever the batch fits the slab subset (all-str
+ascii keys, all-bytes values) and fall back to the legacy list verbs
+otherwise — byte-exotic batches lose nothing.
+
 Dynamic-lifecycle ops (service constructed with ``dynamic=True``;
 the runtime create/destroy surface of
 ``riak_ensemble_manager:create_ensemble``, manager.erl:157-166):
@@ -98,6 +115,44 @@ from riak_ensemble_tpu.parallel.batched_host import BatchedEnsembleService
 
 _HDR = struct.Struct(">I")
 _MAX_FRAME = 16 << 20
+
+
+def _slab_lens(lens_buf, arena_buf) -> "np.ndarray":
+    """Validate one slab column's int32 byte-length table against its
+    arena (trust boundary: both arrive off the network) and return
+    the cumulative offsets [n+1].  Little-endian on the wire — the
+    delta lane's existing raw-plane contract."""
+    import numpy as np
+    lens = np.frombuffer(lens_buf, dtype="<i4")
+    if len(lens) and int(lens.min()) < 0:
+        raise ValueError("negative slab length")
+    offs = np.zeros((len(lens) + 1,), np.int64)
+    np.cumsum(lens, out=offs[1:])
+    if int(offs[-1]) != memoryview(arena_buf).nbytes:
+        raise ValueError("slab arena size mismatch")
+    return offs
+
+
+def _slab_keys(lens_buf, arena_buf) -> list:
+    """Key slab -> key list: ONE arena decode (the client's slab lane
+    is ascii-only, so char offsets equal byte offsets) + one slice
+    per key."""
+    offs = _slab_lens(lens_buf, arena_buf)
+    s = bytes(arena_buf).decode("ascii")
+    o = offs.tolist()
+    return [s[o[i]:o[i + 1]] for i in range(len(o) - 1)]
+
+
+def _slab_vals(lens_buf, arena_buf) -> list:
+    """Value slab -> bytes list: memoryview slices of the received
+    frame, materialized per value (the payload store owns them past
+    the frame's lifetime)."""
+    offs = _slab_lens(lens_buf, arena_buf)
+    mv = memoryview(arena_buf)
+    o = offs.tolist()
+    return [bytes(mv[o[i]:o[i + 1]]) for i in range(len(o) - 1)]
+
+
 #: per-connection backpressure bounds: a client may pipeline at most
 #: this many unresolved ops (further frames stay in the TCP receive
 #: path — flow control rides the transport), and a client that stops
@@ -149,6 +204,16 @@ class ServiceServer:
             return svc.kput_many(*args)
         if op == "kget_many":
             return svc.kget_many(*args)
+        if op == "kput_slab":
+            # zero-copy batched lane: decode = arena slicing, not
+            # per-key term decode (malformed tables raise here and
+            # answer bad-request)
+            return svc.kput_many(ens, _slab_keys(args[1], args[2]),
+                                 _slab_vals(args[3], args[4]))
+        if op == "kget_slab":
+            return svc.kget_many(
+                ens, _slab_keys(args[1], args[2]),
+                want_vsn=bool(args[3]) if len(args) > 3 else False)
         if op == "kupdate_many":
             return svc.kupdate_many(*args)
         if op == "kdelete_many":
@@ -353,17 +418,27 @@ class ServiceClient:
                 asyncio.CancelledError, wire.WireError):
             self._fail_pending()
 
-    async def call(self, op: str, *args: Any, timeout: float = 30.0):
+    async def _roundtrip(self, encode, timeout: float):
+        """The shared request lifecycle both frame flavors ride:
+        disconnected guard, req-id allocation, pending registration,
+        scatter-gather write, and the leak-proof cleanup on
+        connection loss / timeout (advisor findings — ONE copy, so a
+        fix can never miss a flavor).  ``encode(req_id)`` returns the
+        frame's parts; encoding errors raise BEFORE the id registers
+        (a WireError is a caller bug, never a leaked future)."""
         # Never-connected or already-closed clients get the documented
         # DISCONNECTED result, not an AttributeError (advisor finding).
         if self._writer is None or self._writer.is_closing():
             return self.DISCONNECTED
         req_id = next(self._ids)
-        payload = wire.encode((req_id, op) + args)  # WireError = caller
-        fut = asyncio.get_running_loop().create_future()  # bug: raise
+        parts = encode(req_id)
+        length = sum(memoryview(p).nbytes for p in parts)
+        fut = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
         try:
-            self._writer.write(_HDR.pack(len(payload)) + payload)
+            self._writer.write(_HDR.pack(length))
+            for p in parts:
+                self._writer.write(p)
             await self._writer.drain()
         except (ConnectionError, OSError):
             # The write raced a connection loss: the future must not
@@ -375,6 +450,20 @@ class ServiceClient:
         except asyncio.TimeoutError:
             self._pending.pop(req_id, None)  # a long-lived pipelined
             raise                            # client must not leak ids
+
+    async def call(self, op: str, *args: Any, timeout: float = 30.0):
+        return await self._roundtrip(
+            lambda rid: [wire.encode((rid, op) + args)], timeout)
+
+    async def call_parts(self, op: str, *args: Any,
+                         timeout: float = 30.0):
+        """Zero-copy variant of :meth:`call` for ``wire.Raw``-carrying
+        frames (the ``*_slab`` verbs): the request encodes through
+        :func:`wire.encode_parts`, so each wrapped buffer goes from
+        its owning array straight to the transport — no per-key term
+        encode, no arena concatenation into an intermediate frame."""
+        return await self._roundtrip(
+            lambda rid: wire.encode_parts((rid, op) + args), timeout)
 
     # convenience wrappers
     async def kput(self, ens, key, value, **kw):
@@ -414,15 +503,54 @@ class ServiceClient:
     async def ksafe_delete(self, ens, key, vsn, **kw):
         return await self.call("ksafe_delete", ens, key, vsn, **kw)
 
+    @staticmethod
+    def _key_slab(keys):
+        """(lens, arena) for an all-str ascii key batch; None when the
+        batch is outside the slab subset (the caller then takes the
+        legacy list verb — nothing is lost, only the zero-copy lane)."""
+        if not keys or not all(type(k) is str for k in keys):
+            return None
+        joined = "".join(keys)
+        if not joined.isascii():  # byte lens must equal char lens
+            return None
+        import numpy as np
+        lens = np.fromiter(map(len, keys), np.int32, len(keys))
+        return lens, joined.encode("ascii")
+
     async def kput_many(self, ens, keys, values, **kw):
-        return await self.call("kput_many", ens, list(keys),
-                               list(values), **kw)
+        """Vectorized keyed writes.  Slab-native: an all-str-ascii /
+        all-bytes batch rides the ``kput_slab`` zero-copy lane (one
+        length table + one joined arena per column, `wire.Raw` framed
+        — no per-key term encode either side); anything else takes
+        the legacy ``kput_many`` list verb with identical results."""
+        keys, values = list(keys), list(values)
+        ks = self._key_slab(keys)
+        if ks is not None and len(keys) == len(values) \
+                and all(type(v) is bytes for v in values):
+            import numpy as np
+            key_lens, key_arena = ks
+            val_lens = np.fromiter(map(len, values), np.int32,
+                                   len(values))
+            return await self.call_parts(
+                "kput_slab", ens, wire.Raw(key_lens),
+                wire.Raw(key_arena), wire.Raw(val_lens),
+                wire.Raw(b"".join(values)), **kw)
+        return await self.call("kput_many", ens, keys, values, **kw)
 
     async def kget_many(self, ens, keys, want_vsn=False, **kw):
+        """Vectorized keyed reads; all-str-ascii batches ride the
+        ``kget_slab`` zero-copy lane (see :meth:`kput_many`)."""
+        keys = list(keys)
+        ks = self._key_slab(keys)
+        if ks is not None:
+            key_lens, key_arena = ks
+            return await self.call_parts(
+                "kget_slab", ens, wire.Raw(key_lens),
+                wire.Raw(key_arena), bool(want_vsn), **kw)
         if want_vsn:
             return await self.call("kget_many", ens, list(keys), True,
                                    **kw)
-        return await self.call("kget_many", ens, list(keys), **kw)
+        return await self.call("kget_many", ens, keys, **kw)
 
     async def kupdate_many(self, ens, keys, vsns, values, **kw):
         return await self.call("kupdate_many", ens, list(keys),

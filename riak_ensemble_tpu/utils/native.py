@@ -176,5 +176,21 @@ def load_resolve() -> Optional[ctypes.CDLL]:
             # stale .so predating a symbol: fall back rather than
             # serving half an ABI
             return None
+        # enqueue half (native/enqueuekernel.cc, same .so): PROBED,
+        # not required — a stale .so predating the enqueue kernel
+        # still serves the resolve half; enqueue_native.get() checks
+        # the symbol itself and degrades to the numpy pack alone.
+        if hasattr(lib, "retpu_enqueue_pack"):
+            p = ctypes.c_void_p
+            lib.retpu_enqueue_version.restype = ctypes.c_int
+            lib.retpu_enqueue_pack.restype = ctypes.c_int
+            lib.retpu_enqueue_pack.argtypes = [
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                p, p, p, p, p, p, p, p, p, p, p, p, p]
+            if hasattr(lib, "retpu_enqueue_gather"):
+                lib.retpu_enqueue_gather.restype = ctypes.c_int
+                lib.retpu_enqueue_gather.argtypes = [
+                    ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                    p, p, p, p, p, p, p, p, p, p, p, p, p]
         _resolve_lib = lib
         return _resolve_lib

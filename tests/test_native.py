@@ -156,6 +156,18 @@ def test_resolve_kernel_build_smoke():
         assert hasattr(lib, sym), sym
 
 
+def test_enqueue_kernel_build_smoke():
+    """The sibling enqueue kernel (native/enqueuekernel.cc) rides the
+    SAME $(RESOLVESO) target and .so: when the resolve library builds,
+    the enqueue symbols must be there too (a stale .so without them
+    degrades through enqueue_native.get() -> None, never a crash)."""
+    lib = native.load_resolve()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    assert hasattr(lib, "retpu_enqueue_pack")
+    assert lib.retpu_enqueue_version() >= 1
+
+
 @needs_native
 def test_store_put_many_matches_per_record(tmp_path):
     """The arena batch append (the resolve kernel's WAL path) must

@@ -242,6 +242,56 @@ def test_svcnode_batch_ops_over_the_wire():
     asyncio.run(scenario())
 
 
+def test_svcnode_slab_verbs_and_fallback():
+    """The zero-copy slab lane (kput_slab/kget_slab): all-str-ascii /
+    all-bytes batches ride it transparently through the client's
+    kput_many/kget_many; exotic batches (non-ascii keys, non-bytes
+    payloads) fall back to the legacy list verbs with identical
+    results; malformed slab tables answer bad-request without
+    dropping the connection."""
+    import numpy as np
+
+    from riak_ensemble_tpu import wire
+
+    async def scenario():
+        server = await svcnode.serve(2, 3, 32, port=0,
+                                     config=fast_test_config())
+        c = svcnode.ServiceClient(server.host, server.port)
+        await c.connect()
+        # slab route (asserted: the client really built a slab frame)
+        assert c._key_slab(["a", "bb"]) is not None
+        res = await c.kput_many(0, ["a", "bb"], [b"1", b"22"])
+        assert [r[0] for r in res] == ["ok", "ok"]
+        got = await c.kget_many(0, ["a", "bb", "zz"], want_vsn=True)
+        assert got[0][:2] == ("ok", b"1") and len(got[0]) == 3
+        assert got[2] == ("ok", NOTFOUND, (0, 0))
+        # exotic batches bypass the slab subset, same results
+        assert c._key_slab(["κλειδί"]) is None
+        res = await c.kput_many(0, ["κλειδί", "plain"],
+                                [b"nb", b"pv"])
+        assert [r[0] for r in res] == ["ok", "ok"]
+        assert await c.kget_many(0, ["κλειδί"]) == [("ok", b"nb")]
+        res = await c.kput_many(0, ["obj"], ["not-bytes"])
+        assert res[0][0] == "ok"
+        assert await c.kget_many(0, ["obj"]) == [("ok", "not-bytes")]
+        # hostile slab: length table exceeding its arena answers
+        # bad-request (trust boundary), connection stays usable
+        bad = await c.call_parts(
+            "kput_slab", 0,
+            wire.Raw(np.asarray([5], np.int32)), wire.Raw(b"ab"),
+            wire.Raw(np.asarray([1], np.int32)), wire.Raw(b"x"))
+        assert bad == ("error", "bad-request")
+        bad = await c.call_parts(
+            "kget_slab", 0,
+            wire.Raw(np.asarray([-1], np.int32)), wire.Raw(b""))
+        assert bad == ("error", "bad-request")
+        assert await c.kget(0, "a") == ("ok", b"1")
+        await c.close()
+        await server.stop()
+
+    asyncio.run(scenario())
+
+
 def test_svcnode_restart_adopts_persisted_dynamic_mode(tmp_path):
     """ADVICE r3 (medium): restarting a --dynamic-persisted data_dir
     WITHOUT re-passing --dynamic must adopt the persisted mode (the

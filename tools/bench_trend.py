@@ -60,6 +60,10 @@ COLUMNS: Tuple[Tuple[str, str], ...] = (
     ("skewed_compaction_speedup", "compact_x"),
     ("repl_delta_speedup", "delta_x"),
     ("resolve_native_speedup", "native_x"),
+    # slab enqueue half + completion slab vs the per-entry/per-op
+    # oracle arm (bench run_native_enqueue_ab; ROADMAP item 4's
+    # ratchet column — absent in rounds predating it renders "-")
+    ("enqueue_native_speedup", "enqueue_x"),
     ("obs_overhead_pct", "obs_%"),
     # depth-2 vs depth-1 ops/s at the stage's deepest injected
     # per-link RTT point (>=1 ms; bench --stage faultsweep.  >=1.0 =
