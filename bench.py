@@ -1807,11 +1807,13 @@ def _faultsweep_fsync_arm(n_ens: int, n_slots: int, k: int,
 
 
 def _noisy_tenant_arm(n_ens: int, n_slots: int, k: int,
-                      seconds: float, compact: bool) -> dict:
+                      seconds: float, compact: bool,
+                      guard: bool = False) -> dict:
     """One hot tenant hammering 8 rows every round vs 8 near-idle
     quiet tenants (one small op per round, rotating) — the
     noisy-neighbor shape.  Reports the per-tenant p99s from the
-    attribution plane; the caller A/Bs compaction on/off."""
+    attribution plane; the caller A/Bs compaction on/off (and, for
+    the autotune rung, the controller's admission guard on/off)."""
     from riak_ensemble_tpu.parallel.batched_host import (
         BatchedEnsembleService, WallRuntime)
 
@@ -1820,6 +1822,12 @@ def _noisy_tenant_arm(n_ens: int, n_slots: int, k: int,
     try:
         if not compact:
             svc._compact = False  # the RETPU_COMPACT=0 arm
+        if guard:
+            # arm the controller's tenant-admission actuator with a
+            # bench-tight cadence/threshold (the svc._compact idiom)
+            svc.set_autotune(True)
+            svc.controller.cadence = 8
+            svc.controller.guard.min_ops = 16
         hot_n = min(8, n_ens // 2)
         hot_rows = list(range(hot_n))
         quiet_rows = list(range(hot_n, min(hot_n + 8, n_ens)))
@@ -1858,7 +1866,7 @@ def _noisy_tenant_arm(n_ens: int, n_slots: int, k: int,
         quiet = [v for lbl, v in ts.items()
                  if lbl.startswith("quiet") and v["ops"] > 0]
         assert quiet, ts
-        return {
+        out = {
             "ops_per_sec": round(ops / elapsed, 1),
             "hot_ops": ts.get("hot", {}).get("ops", 0),
             "quiet_ops": int(sum(v["ops"] for v in quiet)),
@@ -1866,8 +1874,226 @@ def _noisy_tenant_arm(n_ens: int, n_slots: int, k: int,
             "quiet_p99_ms": round(float(np.median(
                 [v["p99_ms"] for v in quiet])), 3),
         }
+        if guard:
+            out["guard_decisions"] = [
+                ev for ev in svc.controller.journal.snapshot()
+                if ev["actuator"] == "tenant_guard"]
+            out["throttled_rows"] = {
+                lbl: rows for lbl, rows in
+                svc.controller.guard.throttled.items()}
+        return out
     finally:
         svc.stop()
+
+
+def run_autotune(seconds: float, smoke: bool) -> dict:
+    """The controller A/B (docs/ARCHITECTURE.md §14): does the
+    obs-actuated runtime controller FIND the link-dependent optimum
+    the PR 9 faultsweep proved exists, and is every knob change it
+    makes reconstructible from its journal alone?
+
+    Per injected-ack-RTT point (0 ms = the clean link, 5 ms = the
+    slow link where depth 2 measured 1.222x): two STATIC arms
+    (depth 1 / window 1 and depth 2 / window 4 — the candidate
+    optima) and one CONTROLLER arm that starts at depth 1 / window 1
+    with ``RETPU_AUTOTUNE`` armed, adapts for the first part of the
+    budget, then measures steady state.  Acceptance (round time, not
+    smoke): the controller arm within 5% of the best static arm at
+    EVERY point.  Both modes assert the journal property: replaying
+    the decision journal over the initial knobs must land exactly on
+    the live knobs, and the ``retpu_autotune_*`` gauges must agree —
+    the self-tuning is auditable, not just present.
+
+    Plus the tenant-guard rung: the PR 9 noisy-tenant shape with the
+    guard armed vs not — the journal must show the admission
+    decision and the quiet tenants' p99 must not degrade."""
+    n_ens, n_slots, k = (8, 8, 8) if smoke else (32, 16, 16)
+    rtts = (0.0, 2.0) if smoke else (0.0, 5.0)
+    points = []
+    worst_ratio = None
+    for rtt in rtts:
+        statics = {}
+        for depth, window in ((1, 1), (2, 4)):
+            r = _faultsweep_rtt_arm(n_ens, n_slots, k, seconds,
+                                    depth, rtt)
+            statics[f"depth{depth}_win{window}"] = r["ops_per_sec"]
+        ctrl = _autotune_controller_arm(n_ens, n_slots, k, seconds,
+                                        rtt)
+        best = max(statics.values())
+        ratio = round(ctrl["ops_per_sec"] / max(best, 1e-9), 3)
+        worst_ratio = (ratio if worst_ratio is None
+                       else min(worst_ratio, ratio))
+        points.append({
+            "rtt_ms": rtt,
+            "static_ops_per_sec": statics,
+            "controller_ops_per_sec": ctrl["ops_per_sec"],
+            "controller_final": ctrl["final"],
+            "controller_decisions": ctrl["decisions"],
+            "journal_reconstructed": ctrl["journal_reconstructed"],
+            "vs_best_static": ratio,
+        })
+    guard = _autotune_guard_arm(
+        *((16, 8, 8) if smoke else (512, 16, 32)), seconds)
+    return {
+        "autotune": {
+            "shape": {"n_ens": n_ens, "n_slots": n_slots, "k": k},
+            "points": points,
+            "tenant_guard": guard,
+        },
+        "autotune_vs_best_static": worst_ratio,
+    }
+
+
+def _autotune_controller_arm(n_ens: int, n_slots: int, k: int,
+                             seconds: float, rtt_ms: float) -> dict:
+    """The controller arm of the autotune A/B: the faultsweep
+    leader + replica-host shape, starting at depth 1 / window 1 with
+    the controller armed (tight cadence so it converges inside a
+    bench budget), adaptation phase then steady-state measurement.
+    Asserts the journal-reconstruction property before returning."""
+    import shutil
+    import tempfile
+
+    from riak_ensemble_tpu import faults
+    from riak_ensemble_tpu.config import fast_test_config
+    from riak_ensemble_tpu.obs.controller import replay
+    from riak_ensemble_tpu.parallel import repgroup
+    from riak_ensemble_tpu.parallel.batched_host import WallRuntime
+
+    tmp = tempfile.mkdtemp(prefix="bench_autotune_")
+    server = None
+    svc = None
+    try:
+        server = repgroup.ReplicaServer(
+            n_ens, 2, n_slots, data_dir=f"{tmp}/r1",
+            config=fast_test_config())
+        svc = repgroup.ReplicatedService(
+            WallRuntime(), n_ens, 1, n_slots, group_size=2,
+            peers=[("127.0.0.1", server.repl_port)],
+            ack_timeout=60.0, max_ops_per_tick=k,
+            config=fast_test_config(), data_dir=tmp + "/leader",
+            pipeline_depth=1, repl_window=1)
+        repgroup.warmup_kernels(svc)
+        assert svc.takeover(), "autotune arm: takeover failed"
+        svc.set_autotune(True)
+        # bench-local controller tuning (the svc._compact idiom):
+        # a tight cadence so convergence fits a bench budget
+        svc.controller.cadence = 8
+        initial = {"pipeline_depth": svc.pipeline_depth,
+                   "repl_window": svc.repl_window}
+        keys = [f"key{j}" for j in range(k)]
+        vals = [b"v%d" % j for j in range(k // 2)]
+
+        def submit():
+            futs = []
+            for e in range(n_ens):
+                futs.append(svc.kput_many(e, keys[:k // 2], vals))
+                futs.append(svc.kget_many(e, keys[k // 2:]))
+            return futs
+
+        futs = submit()  # warm: slots, elections, remote ladder
+        while any(svc.queues):
+            svc.flush()
+        assert all(f.done for f in futs)
+        svc.ack_timeout = 30.0
+        plan = faults.install(faults.FaultPlan())
+        if rtt_ms > 0.0:
+            for link in svc._links:
+                plan.set_rtt(link.label, faults.LOCAL, rtt_ms)
+
+        def closed_loop(budget_s: float) -> tuple:
+            # window follows the LIVE depth so a controller step
+            # changes the offered concurrency exactly like the
+            # matching static arm's client would
+            lat = []
+            ops = 0
+            inflight = []
+            t_end = time.perf_counter() + max(budget_s, 1e-3)
+            t0 = time.perf_counter()
+            while True:
+                now = time.perf_counter()
+                window = 1 if svc.pipeline_depth == 1 else 4
+                if now < t_end and len(inflight) < window:
+                    inflight.append((now, submit()))
+                svc.flush()
+                while inflight and all(f.done
+                                       for f in inflight[0][1]):
+                    tb, done = inflight.pop(0)
+                    lat.append(time.perf_counter() - tb)
+                    ops += len(done) * (k // 2)
+                if now >= t_end and not inflight and lat:
+                    break
+                assert now < t_end + 120.0, "autotune arm wedged"
+            return ops, time.perf_counter() - t0
+
+        # adaptation phase: give the controller a few cadence
+        # windows to converge, then measure steady state
+        closed_loop(max(seconds * 0.6, 0.2))
+        ops, elapsed = closed_loop(max(seconds, 1e-3))
+        faults.clear()
+        journal = svc.controller.journal.snapshot()
+        final = {"pipeline_depth": svc.pipeline_depth,
+                 "repl_window": svc.repl_window}
+        # the acceptance property: the journal ALONE reconstructs
+        # the live knobs, and the gauges tell the same story
+        reconstructed = replay(
+            [ev for ev in journal
+             if ev.get("knob") in ("pipeline_depth", "repl_window")],
+            initial)
+        assert reconstructed == final, (reconstructed, final, journal)
+        snap = svc.obs_registry.snapshot()
+        assert snap["retpu_autotune_pipeline_depth"] \
+            == final["pipeline_depth"], snap
+        assert snap["retpu_autotune_repl_window"] \
+            == final["repl_window"], snap
+        assert snap["retpu_autotune_decisions_total"] \
+            == svc.controller.journal.total
+        out = {
+            "ops_per_sec": round(ops / elapsed, 1),
+            "final": final,
+            "decisions": journal,
+            "journal_reconstructed": True,
+        }
+        svc.stop()
+        svc = None
+        return out
+    finally:
+        faults.clear()
+        if svc is not None:
+            try:
+                svc.stop()
+            except Exception:
+                pass
+        if server is not None:
+            server.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _autotune_guard_arm(n_ens: int, n_slots: int, k: int,
+                        seconds: float) -> dict:
+    """The tenant-guard rung: the PR 9 noisy-tenant shape with the
+    controller's admission guard armed vs the unguarded baseline.
+    The guard must journal an admission decision against the hot
+    tenant, and the quiet tenants' p99 must not degrade under it."""
+    base = _noisy_tenant_arm(n_ens, n_slots, k, seconds,
+                             compact=True)
+    guarded = _noisy_tenant_arm(n_ens, n_slots, k, seconds,
+                                compact=True, guard=True)
+    assert guarded["guard_decisions"], \
+        "tenant guard armed but never journaled a decision"
+    return {
+        "quiet_p99_ms_guarded": guarded["quiet_p99_ms"],
+        "quiet_p99_ms_unguarded": base["quiet_p99_ms"],
+        "quiet_p99_ratio": round(
+            guarded["quiet_p99_ms"]
+            / max(base["quiet_p99_ms"], 1e-9), 3),
+        "hot_ops_guarded": guarded["hot_ops"],
+        "hot_ops_unguarded": base["hot_ops"],
+        "ops_per_sec_guarded": guarded["ops_per_sec"],
+        "ops_per_sec_unguarded": base["ops_per_sec"],
+        "guard_decisions": guarded["guard_decisions"],
+        "throttled_rows": guarded["throttled_rows"],
+    }
 
 
 def _make_workload(n_ens: int, n_peers: int, n_slots: int, k: int):
@@ -2296,6 +2522,8 @@ def _stage_entry(args) -> None:
         out = run_repgroup(args.seconds, smoke=False)
     elif args.stage == "faultsweep":
         out = run_faultsweep(args.seconds, smoke=False)
+    elif args.stage == "autotune":
+        out = run_autotune(args.seconds, smoke=False)
     elif args.stage == "merkle":
         m = run_merkle(args.seconds, smoke=False)
         out = {"ladder_metric": m["metric"], "ladder_value": m["value"]}
@@ -2326,7 +2554,8 @@ def main() -> None:
     ap.add_argument("--stage",
                     choices=("kernel", "service", "merkle", "reconfig",
                              "probe", "stepprobe", "repgroup",
-                             "widecmp", "escale", "faultsweep"),
+                             "widecmp", "escale", "faultsweep",
+                             "autotune"),
                     help="internal: run one stage in-process")
     ap.add_argument("--n-ens", type=int, default=10_000)
     ap.add_argument("--n-peers", type=int, default=5)
@@ -2363,6 +2592,7 @@ def main() -> None:
         svc["kernel_rounds_per_sec"] = kernel_rounds
         svc.update(run_repgroup(secs, smoke=True))
         svc.update(run_faultsweep(secs, smoke=True))
+        svc.update(run_autotune(secs, smoke=True))
         svc["platform"] = "smoke"
         svc["bench_trend"] = trend
         label = "64_ens_5_peers_smoke"
@@ -2447,6 +2677,15 @@ def main() -> None:
             if r is not None:
                 svc.update({k: v for k, v in r.items()
                             if k.startswith("faultsweep")})
+            # autotune A/B (ARCHITECTURE §14): the controller arm vs
+            # the best static (depth, window) at 0/5 ms injected ack
+            # RTT, plus the tenant-guard rung — same socket/disk
+            # profile as the faultsweep, same platform rule
+            r = _run_stage("autotune", label, {}, args.seconds,
+                           560.0, force_cpu)
+            if r is not None:
+                svc.update({k: v for k, v in r.items()
+                            if k.startswith("autotune")})
             # E-scaling datapoints (ROADMAP carried debt item 2): the
             # 1k-ens CPU rung always rides the round JSON; the 2k-
             # and 4k-ens points land when the box completes them

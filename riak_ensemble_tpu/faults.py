@@ -54,7 +54,8 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 __all__ = ["FaultPlan", "LOCAL", "install", "clear", "plan",
-           "active_plan", "from_env", "fsync_sleep"]
+           "active_plan", "from_env", "fsync_sleep", "SoakSchedule",
+           "wedge_soak"]
 
 #: the local endpoint name a PeerLink uses for its own (leader) side
 LOCAL = "local"
@@ -433,3 +434,99 @@ def fsync_sleep() -> None:
     p = active_plan()
     if p is not None:
         p.sleep_fsync()
+
+
+# -- standing chaos: scheduled nemesis soaks ----------------------------------
+
+class SoakSchedule:
+    """Run a nemesis soak on a time schedule — chaos as a STANDING
+    gate, not a one-off test run.
+
+    The runtime controller's chaos actuator owns one of these: every
+    ``interval_s`` of clock time, ``maybe_run(now)`` invokes the
+    runner (default :func:`wedge_soak`) and retains its verdict.
+    ``interval_s <= 0`` disarms the schedule entirely (the default —
+    a soak injects real faults into a serving system, so it is armed
+    explicitly, never inherited).  The clock is injectable so tests
+    drive the schedule on virtual time."""
+
+    def __init__(self, interval_s: float,
+                 runner: Optional[Any] = None,
+                 clock: Optional[Any] = None) -> None:
+        self.interval_s = float(interval_s)
+        self.runner = runner if runner is not None else wedge_soak
+        self._clock = clock if clock is not None else time.monotonic
+        self._next_due = (self._clock() + self.interval_s
+                          if self.interval_s > 0 else float("inf"))
+        self.runs = 0
+        self.failures = 0
+        self.last: Optional[Dict[str, Any]] = None
+
+    def due(self, now: Optional[float] = None) -> bool:
+        if self.interval_s <= 0:
+            return False
+        return (self._clock() if now is None else now) >= self._next_due
+
+    def maybe_run(self, target: Any,
+                  now: Optional[float] = None
+                  ) -> Optional[Dict[str, Any]]:
+        """Run the soak against ``target`` if it is due; returns the
+        soak result dict (``ok``/``detect_s``/``bound_s``/...) or
+        None when not due.  A raising runner records a failed soak
+        instead of propagating — the soak gate must never take down
+        the serving loop it polices."""
+        now = self._clock() if now is None else now
+        if not self.due(now):
+            return None
+        self._next_due = now + self.interval_s
+        self.runs += 1
+        try:
+            result = self.runner(target)
+        except Exception as exc:  # noqa: BLE001 — verdict, not crash
+            result = {"ok": False, "error": repr(exc)}
+        if not result.get("ok"):
+            self.failures += 1
+        self.last = result
+        return result
+
+
+def wedge_soak(svc: Any) -> Dict[str, Any]:
+    """The default standing soak: a SILENT ack blackhole on every
+    replication link (``FaultPlan(silent=True)`` — true half-open
+    timing, nothing fails fast; the same mode the ``slow``-marked
+    nemesis sweeps run under via ``RETPU_FAULT_SILENT=1``), then one
+    :meth:`heartbeat` round.  The assertion is WEDGE DETECTION, the
+    PR 9 half-open bound: a leader whose acks silently vanish must
+    observe the lost quorum within ``2 x PeerLink.IO_TIMEOUT``, never
+    ride a dead link forever.  The previously-armed plan (an outer
+    nemesis) is restored afterward, rules healed, quorum re-confirmed
+    with a second heartbeat so the soak leaves the group exactly as
+    it found it.  Services without links (no group, or a replica
+    lane) report ``skipped`` — there is no ack path to wedge."""
+    links = getattr(svc, "_links", None)
+    if not links:
+        return {"ok": True, "skipped": "no replication links"}
+    bound_s = 2.0 * max(type(l).IO_TIMEOUT for l in links)
+    prev = plan()
+    soak = FaultPlan(silent=True)
+    for link in links:
+        # acks vanish, applies deliver: src = the peer's label, dst
+        # wildcard so a custom leader-side fault_label still matches
+        soak.drop(link.label, None)
+    install(soak)
+    t0 = time.monotonic()
+    try:
+        quorum_ok = bool(svc.heartbeat())
+        detect_s = time.monotonic() - t0
+    finally:
+        soak.heal()
+        install(prev)
+    healed_ok = bool(svc.heartbeat())
+    return {
+        "ok": (not quorum_ok) and detect_s <= bound_s and healed_ok,
+        "detect_s": round(detect_s, 6),
+        "bound_s": round(bound_s, 3),
+        "quorum_ok_under_blackhole": quorum_ok,
+        "healed_quorum_ok": healed_ok,
+        "dropped_frames": soak.dropped_frames,
+    }

@@ -40,6 +40,15 @@ stack reports into:
   a latency heuristic): warmup coverage gaps surface as
   ``retpu_compile_events_total{phase="serve"}`` instead of a
   dispatch-p99 mystery.
+- :mod:`.controller` — the obs-ACTUATED runtime controller (round
+  12): consumes the surfaces above on a flush-count cadence and
+  drives ``pipeline_depth``/``repl_window``/tenant admission, with a
+  bounded decision journal exported back through this same plane
+  (``retpu_autotune_*`` gauges, the ``health()`` ``controller``
+  section, flight-dump ``controller_decisions``, Chrome-trace export
+  via ``tools/trace_export.py``).  ``RETPU_AUTOTUNE=0`` (the default)
+  keeps it observe-only-constructed and bit-identical to the
+  pre-controller service.
 
 Knobs: ``RETPU_OBS=0`` disables hot-path recording (instruments stay
 constructed; record calls short-circuit — the bench's A/B arm);
@@ -56,6 +65,8 @@ import os
 
 from riak_ensemble_tpu.obs.compilewatch import (COMPILE_EVENTS,
                                                 CompileWatch)
+from riak_ensemble_tpu.obs.controller import (DecisionJournal,
+                                              RuntimeController)
 from riak_ensemble_tpu.obs.fingerprint import box_fingerprint
 from riak_ensemble_tpu.obs.flightrec import FlightRecorder
 from riak_ensemble_tpu.obs.opslo import OpSloRing
@@ -68,7 +79,8 @@ from riak_ensemble_tpu.obs.spans import (SPANS, SpanStore,
 __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
            "MS_BUCKETS", "FlightRecorder", "SpanStore", "SPANS",
            "next_flush_id", "timeline", "box_fingerprint", "enabled",
-           "dump_dir", "OpSloRing", "CompileWatch", "COMPILE_EVENTS"]
+           "dump_dir", "OpSloRing", "CompileWatch", "COMPILE_EVENTS",
+           "RuntimeController", "DecisionJournal"]
 
 
 def enabled() -> bool:

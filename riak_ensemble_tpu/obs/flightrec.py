@@ -22,6 +22,7 @@ from __future__ import annotations
 import json
 import os
 import time
+from bisect import bisect_left, insort
 from collections import deque
 from typing import Any, Dict, List, Optional
 
@@ -30,13 +31,16 @@ from riak_ensemble_tpu.obs.fingerprint import box_fingerprint
 __all__ = ["FlightRecorder", "DUMP_SCHEMA", "META_FIELDS",
            "DERIVED_MARKS"]
 
-#: v2 adds the per-op SLO ring tail (``slow_ops``: the slowest acked
+#: v2 added the per-op SLO ring tail (``slow_ops``: the slowest acked
 #: ops with their stage splits), the service's recent
 #: ``compile_events``, and the active fault-injection plan
 #: (``injected_faults`` — so an anomaly captured mid-nemesis indicts
-#: the nemesis) — all from the recorder's ``extras`` callback (empty
-#: when no extras provider is attached)
-DUMP_SCHEMA = "retpu-flight-dump-v2"
+#: the nemesis); v3 adds the runtime controller's recent
+#: ``controller_decisions`` (so a dump captured while the controller
+#: was moving knobs shows WHICH knob moved and why) — all from the
+#: recorder's ``extras`` callback (empty when no extras provider is
+#: attached)
+DUMP_SCHEMA = "retpu-flight-dump-v3"
 
 #: DERIVED latency marks — sums/subdivisions of other marks
 #: ('enqueue' = h2d + dispatch; resolve_native/resolve_fallback =
@@ -63,9 +67,17 @@ META_FIELDS = ("k", "total") + DERIVED_MARKS + (
 class FlightRecorder:
     """Per-service flush ring + anomaly dumps.
 
-    ``record`` cost: one deque append, one p50-cache check (the p50
-    itself is recomputed every ``refresh_every`` records over a
-    bounded window — never per record), one comparison.
+    ``record`` cost: one deque append, one bisect-maintained sorted
+    window update (O(window) list shift worst case, window = 128),
+    one comparison.  The trigger baseline is the EXACT median of the
+    last ``window`` totals — recomputed per record, not on a
+    ``refresh_every`` cadence: the old cached p50 lagged a load shift
+    by up to a full refresh period, so the quiet stretch after a
+    slow-flush spike kept comparing against the spike's inflated
+    baseline and real 5x anomalies in that window never armed.  With
+    the windowed median the threshold re-arms as fast as the window
+    slides (``refresh_every`` is accepted for constructor
+    compatibility and ignored).
     """
 
     def __init__(self, capacity: int = 256, window: int = 128,
@@ -79,7 +91,6 @@ class FlightRecorder:
         self.records: "deque[Dict[str, Any]]" = deque(maxlen=capacity)
         self.trigger_ratio = float(trigger_ratio)
         self.min_samples = int(min_samples)
-        self.refresh_every = int(refresh_every)
         self.min_dump_interval_s = float(min_dump_interval_s)
         self.name = name
         self._dump_dir = dump_dir
@@ -89,8 +100,9 @@ class FlightRecorder:
         #: a test-replaced recorder still gets the sections
         self.extras = extras
         self._totals: "deque[float]" = deque(maxlen=window)
-        self._p50 = 0.0
-        self._since_refresh = 0
+        #: the same window, sorted (bisect-maintained) — the median
+        #: read is one index access
+        self._sorted: List[float] = []
         #: anomaly observability: trigger count and the retained
         #: snapshots (bounded; a pathological box must not hoard
         #: rings), newest last
@@ -110,19 +122,19 @@ class FlightRecorder:
         None."""
         total = float(rec.get("total", 0.0))
         self.records.append(rec)
+        p50 = self._p50
         armed = (len(self._totals) >= self.min_samples
-                 and self._p50 > 0.0
-                 and total > self.trigger_ratio * self._p50)
+                 and p50 > 0.0
+                 and total > self.trigger_ratio * p50)
         # the slow flush itself joins the window AFTER the check, so
         # a burst of slow flushes keeps triggering against the
-        # healthy baseline instead of normalizing itself away within
-        # one refresh period
+        # still-windowed baseline rather than instantly normalizing
+        # itself away
+        if len(self._totals) == self._totals.maxlen:
+            old = self._totals[0]
+            del self._sorted[bisect_left(self._sorted, old)]
         self._totals.append(total)
-        self._since_refresh += 1
-        if self._since_refresh >= self.refresh_every or not self._p50:
-            self._since_refresh = 0
-            s = sorted(self._totals)
-            self._p50 = s[len(s) // 2] if s else 0.0
+        insort(self._sorted, total)
         if not armed:
             return None
         # count EVERY trigger firing (the anomaly metric's contract);
@@ -135,6 +147,13 @@ class FlightRecorder:
             return None
         self._last_dump_t = now
         return self._dump(rec, total)
+
+    @property
+    def _p50(self) -> float:
+        """Exact median of the current window (index access into the
+        bisect-maintained sorted copy)."""
+        s = self._sorted
+        return s[len(s) // 2] if s else 0.0
 
     def _dump(self, rec: Dict[str, Any],
               total: float) -> Dict[str, Any]:
@@ -156,11 +175,13 @@ class FlightRecorder:
             },
             "ring": [dict(r) for r in self.records],
             "box": box_fingerprint(),
-            # per-op tail + compile-event + injected-fault sections
-            # (schema v2): empty when no extras provider is attached
+            # per-op tail + compile-event + injected-fault +
+            # controller-decision sections (schema v3): empty when no
+            # extras provider is attached
             "slow_ops": [],
             "compile_events": [],
             "injected_faults": {},
+            "controller_decisions": [],
         }
         if self.extras is not None:
             try:

@@ -107,6 +107,14 @@ class Counter:
                 self.name, self.help, self.label_name)
         return child
 
+    def remove_label(self, label: str) -> bool:
+        """Drop one labeled child series (tenant recycle: the label's
+        owner is gone, and a retained child would keep exporting a
+        dead tenant's counts forever)."""
+        if self._children is None:
+            return False
+        return self._children.pop(label, None) is not None
+
     def _samples(self):
         if self._children:
             for label, child in self._children.items():
@@ -179,6 +187,12 @@ class Histogram:
                 self.name, self.help, self.buckets, self.label_name)
         return child
 
+    def remove_label(self, label: str) -> bool:
+        """Drop one labeled child series (see Counter.remove_label)."""
+        if self._children is None:
+            return False
+        return self._children.pop(label, None) is not None
+
     def percentile(self, q: float) -> float:
         """Bucket-resolution estimate of the q-quantile (0 < q <= 1);
         see :func:`percentile_from_counts`."""
@@ -237,6 +251,22 @@ class MetricsRegistry:
 
     def collect(self, fn: Callable[[], Dict[str, Any]]) -> None:
         self._collectors.append(fn)
+
+    def remove_labeled(self, label: str) -> int:
+        """Drop every labeled child series recorded under ``label``
+        across all counters and histograms — the ensemble-row recycle
+        hook: a recycled tenant's ledger row is zeroed, and any
+        labeled series created under its label must go with it, or
+        the registry keeps exporting (and a successor tenant reusing
+        the label inherits) a dead tenant's samples.  Collector
+        families are untouched — they re-derive their label sets at
+        export time.  Returns how many series were dropped."""
+        dropped = 0
+        for c in self._counters.values():
+            dropped += c.remove_label(label)
+        for h in self._hists.values():
+            dropped += h.remove_label(label)
+        return dropped
 
     # -- export -------------------------------------------------------------
 

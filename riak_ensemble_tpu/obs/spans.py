@@ -90,6 +90,26 @@ class SpanStore:
         with self._lock:
             return list(self._flushes)
 
+    def span_values(self, flush_ids, role: str,
+                    name: str) -> List[float]:
+        """Every recorded duration (seconds) of span ``name`` under
+        ``role`` across ``flush_ids``, one lock acquisition for the
+        whole batch — the runtime controller's bulk read (e.g. the
+        ``repl_ack`` samples of the last cadence window's flushes).
+        Missing flushes/roles/spans contribute nothing: a flush whose
+        ack is still pending simply isn't a sample yet."""
+        out: List[float] = []
+        with self._lock:
+            for fid in flush_ids:
+                rec = self._flushes.get(fid)
+                if rec is None:
+                    continue
+                side = rec.get(role)
+                if side is None:
+                    continue
+                out.extend(d for n, d in side["spans"] if n == name)
+        return out
+
 
 #: the process-global store every service records into
 SPANS = SpanStore()

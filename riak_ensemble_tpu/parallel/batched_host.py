@@ -1021,6 +1021,21 @@ class BatchedEnsembleService:
         self._lat_edges = np.asarray(obs.MS_BUCKETS)
         self._tenant_labels: Dict[int, Any] = {}
         self._launches_total = 0
+        #: tenant-guard flush admission (docs/ARCHITECTURE.md §14):
+        #: None = no caps installed (the bit-identical default path —
+        #: flush() takes one falsy test); else {row: rounds-per-flush
+        #: cap} with a per-row token bucket (refill = cap per flush,
+        #: burst 2x) so a capped tenant keeps steady throughput while
+        #: its queue stops forcing every flush to its own batch depth
+        self._admission_caps: Optional[Dict[int, int]] = None
+        self._admission_tokens: Dict[int, float] = {}
+        #: the obs-actuated runtime controller (obs/controller.py):
+        #: ALWAYS constructed so its retpu_autotune_* gauge family
+        #: registers (zeros while off); it only acts when
+        #: RETPU_AUTOTUNE=1 — cached here so the off arm pays one
+        #: attribute test per settled flush
+        self.controller = obs.RuntimeController(self)
+        self._autotune = self.controller.enabled
         self._register_obs_metrics()
         self._schedule()
 
@@ -1081,6 +1096,10 @@ class BatchedEnsembleService:
         row = self._ens_names.pop(name, None)
         if row is None:
             return False
+        # the name label dies with the tenant (the row-reset below
+        # only sees the post-delete fallback label) — unless a
+        # sibling row still serves under it
+        self._drop_tenant_series(row)
         del self._row_name[row]
         for op in self.queues[row]:
             self._fail_entry(row, op)
@@ -1144,7 +1163,14 @@ class BatchedEnsembleService:
         self.up[row] = True
         self._up_dev = None
         # per-tenant attribution must not leak across row recycles —
-        # the new tenant starts with a clean ledger
+        # the new tenant starts with a clean ledger, and any LABELED
+        # registry series recorded under the old tenant's label go
+        # with it (labeled children otherwise persist forever — a
+        # successor tenant reusing the label would inherit a dead
+        # tenant's samples; registry.remove_labeled is the hook).
+        # Read the label BEFORE the ledger row is zeroed; a label a
+        # sibling row still serves under survives the recycle.
+        self._drop_tenant_series(row)
         self.tenant_ops[row] = 0
         self.tenant_commits[row] = 0
         self.tenant_bytes[row] = 0
@@ -1967,6 +1993,67 @@ class BatchedEnsembleService:
                             fut, [pos], [r2], self._safe_resolve))
             inner.add_waiter(on_batch)
         return fut
+
+    # -- runtime-controller actuation points (ARCHITECTURE §14) -------------
+
+    def set_pipeline_depth(self, depth: int) -> int:
+        """Retune the launch pipeline depth at runtime (the ack-RTT
+        actuator's knob; also callable by an operator).  Settles
+        every in-flight launch first so no launch ever observes a
+        depth change mid-stream — submission order, the WAL barrier
+        and rollback snapshots are exactly as at construction.
+        Returns the previous depth."""
+        depth = max(1, int(depth))
+        old = self.pipeline_depth
+        if depth != old:
+            self._drain_launches()
+            self.pipeline_depth = depth
+            self._emit("svc_autotune",
+                       {"knob": "pipeline_depth", "old": old,
+                        "new": depth})
+        return old
+
+    def set_admission_caps(self,
+                           caps: Optional[Dict[int, int]]) -> None:
+        """Install (or clear, with None) per-row flush-admission
+        round caps — the tenant guard's knob.  Each capped row gets
+        a token bucket: refill = cap per flush, burst 2x cap, so a
+        capped tenant's flush share is bounded without starving it.
+        ``None``/empty restores the exact uncapped take path."""
+        caps = {int(e): max(1, int(c))
+                for e, c in caps.items()} if caps else None
+        self._admission_caps = caps
+        # fresh buckets start full: the first capped flush admits a
+        # full cap rather than zero (no spurious stall on install)
+        self._admission_tokens = (
+            {e: float(c) for e, c in caps.items()} if caps else {})
+        self._emit("svc_autotune",
+                   {"knob": "admission_caps",
+                    "new": dict(caps) if caps else None})
+
+    def set_autotune(self, enabled: bool) -> None:
+        """Arm/disarm the runtime controller for THIS service (the
+        programmatic form of ``RETPU_AUTOTUNE``; svcnode's
+        ``--autotune``).  Disarming also clears any installed
+        admission caps so the service returns to the exact
+        pre-controller take path."""
+        enabled = bool(enabled)
+        if enabled == self._autotune:
+            return
+        self._autotune = enabled
+        self.controller.enabled = enabled
+        if enabled:
+            # re-anchor the heal target at ARM time: the operator may
+            # have moved the knobs since construction, and the tuner
+            # must never walk below the configuration it was armed on
+            self._autotune_base_depth = int(self.pipeline_depth)
+            self._autotune_base_window = int(
+                getattr(self, "repl_window", 1))
+        if not enabled and self._admission_caps:
+            self.controller.guard.throttled.clear()
+            self.set_admission_caps(None)
+        self._emit("svc_autotune",
+                   {"knob": "autotune", "new": enabled})
 
     # -- lease-protected read fast path -------------------------------------
 
@@ -3770,6 +3857,11 @@ class BatchedEnsembleService:
             "live_payloads": len(self.values),
             "flushes": int(self.flushes),
             "ops_served": int(self.ops_served),
+            # the runtime controller's section (ARCHITECTURE §14):
+            # always present — `enabled: false` on a stock service —
+            # so a dashboard's queries keep their shape when the
+            # controller arms, the fault-gauge discipline
+            "controller": self.controller.health_section(),
         }
         if fp is not None:
             # active fault-injection plan (docs/ARCHITECTURE.md §13):
@@ -3794,6 +3886,7 @@ class BatchedEnsembleService:
         self.obs_registry.collect(self._obs_tenant_collect)
         self.obs_registry.collect(self._obs_cost_collect)
         self.obs_registry.collect(self._obs_fault_collect)
+        self.obs_registry.collect(self.controller.collect)
         # live backend memory (device plane telemetry): reads the
         # default device's allocator stats at export time; backends
         # without memory_stats (CPU) export None/NaN rather than 0
@@ -3867,6 +3960,10 @@ class BatchedEnsembleService:
             "compile_events": list(self._compile_log),
             "injected_faults": (fp.describe()
                                 if fp is not None else {}),
+            # the controller's newest journaled decisions: an anomaly
+            # captured while the control loop was moving knobs shows
+            # WHICH knob moved, and why, next to the flush it hit
+            "controller_decisions": self.controller.flight_section(),
         }
 
     def _obs_service_collect(self) -> Dict[str, Any]:
@@ -3945,6 +4042,19 @@ class BatchedEnsembleService:
         if lbl is None:
             lbl = self._row_name.get(ens)
         return str(lbl) if lbl is not None else f"ens{ens}"
+
+    def _drop_tenant_series(self, row: int) -> None:
+        """Drop ``row``'s labeled registry series on recycle — unless
+        another row still serves under the same label.  A tenant
+        spanning several ensemble rows is ONE tenant in every export
+        (``_tenant_groups``), so recycling one of its rows must not
+        reset the survivors' live counters."""
+        lbl = self.tenant_label(row)
+        for e in set(self._tenant_labels) | set(self._row_name):
+            if e != row and 0 <= e < self.n_ens \
+                    and self.tenant_label(e) == lbl:
+                return
+        self.obs_registry.remove_labeled(lbl)
 
     def _tenant_groups(self, top: int = 16
                        ) -> "List[Tuple[str, List[int]]]":
@@ -4197,6 +4307,13 @@ class BatchedEnsembleService:
                                  for e in self._active),
             "in_flight": len(self._inflight_launches),
             **rec})
+        if self._autotune:
+            # the runtime controller's cadence: one counted flush,
+            # one integer compare; evaluations run every
+            # RETPU_AUTOTUNE_CADENCE settled flushes (§14).  Inside
+            # the obs-gated settle hook on purpose: the controller
+            # CONSUMES the obs plane, so RETPU_OBS=0 starves it too.
+            self.controller.tick(fl.flush_id)
 
     # -- (K, A)-grid pre-compile --------------------------------------------
 
@@ -4566,9 +4683,35 @@ class BatchedEnsembleService:
         self._flush_calls += 1
         self._run_due_retries()
         active = self._active
-        k = min(self.max_k,
-                max((self._queue_rounds[e] for e in active),
-                    default=0))
+        caps = self._admission_caps
+        admit: Optional[Dict[int, int]] = None
+        if not caps:
+            k = min(self.max_k,
+                    max((self._queue_rounds[e] for e in active),
+                        default=0))
+        else:
+            # tenant-guard flush admission (ARCHITECTURE §14): a
+            # capped row contributes at most its token-bucket
+            # allowance (refill = cap/flush, burst 2x) both to the
+            # batch-depth choice and to the take loop below — a hot
+            # tenant's queue stops forcing every flush to its own
+            # max depth, which is exactly what the quiet tenants'
+            # p99 was paying for
+            tokens = self._admission_tokens
+            admit = {}
+            k = 0
+            for e in active:
+                qr = self._queue_rounds[e]
+                cap = caps.get(e)
+                if cap is not None:
+                    t = min(tokens.get(e, float(cap)) + cap,
+                            2.0 * cap)
+                    tokens[e] = t
+                    qr = min(qr, int(t))
+                admit[e] = qr
+                if qr > k:
+                    k = qr
+            k = min(self.max_k, k)
         served = 0
         if k == 0:
             # Idle flush: settle the launch pipeline first (callers
@@ -4640,25 +4783,32 @@ class BatchedEnsembleService:
         tenq_l: List[float] = []
         for e in sorted(active):
             q = self.queues[e]
+            # limit == k on the uncapped path; a tenant-guard cap
+            # lowers it to the row's admitted allowance
+            limit = k if admit is None else min(k, admit.get(e, k))
             ops: List[Any] = []
             rounds = idx = 0
-            while idx < len(q) and rounds < k:
+            while idx < len(q) and rounds < limit:
                 op = q[idx]
-                if rounds + op.n <= k:
+                if rounds + op.n <= limit:
                     ops.append(op)
                     rounds += op.n
                     idx += 1
                 else:
-                    # K cap lands inside a batch: take the head rounds
-                    # now; the tail (same Future/accumulator) leads
-                    # the next flush.
-                    head, tail = op.split(k - rounds)
+                    # K cap (or the row's admission limit) lands
+                    # inside a batch: take the head rounds now; the
+                    # tail (same Future/accumulator) leads the next
+                    # flush.
+                    head, tail = op.split(limit - rounds)
                     ops.append(head)
-                    rounds = k
+                    rounds = limit
                     q[idx] = tail
                     break
             self.queues[e] = q[idx:]
             self._queue_rounds[e] -= rounds
+            if admit is not None and e in self._admission_tokens:
+                self._admission_tokens[e] = max(
+                    0.0, self._admission_tokens[e] - rounds)
             if self.queues[e]:
                 still_active.add(e)
             if ops:

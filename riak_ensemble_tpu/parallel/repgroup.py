@@ -2185,6 +2185,10 @@ class ReplicatedService(BatchedEnsembleService):
         #: futures resolve only at settlement).  Distinct from the
         #: base service's pipeline_depth (the DEVICE launch pipeline).
         self.repl_window = max(1, int(repl_window))
+        # the runtime controller was constructed in the base __init__
+        # before this attribute existed: its heal target for the
+        # window knob is THIS constructor-configured value
+        self._autotune_base_window = self.repl_window
         self._pending_flushes: "deque[_PendingShip]" = deque()
         self._unclaimed: Optional[_PendingEntry] = None
         #: resolved entries awaiting their coalesced ship (all the
@@ -3256,6 +3260,24 @@ class ReplicatedService(BatchedEnsembleService):
             finally:
                 self._in_save = False
         super().save(path)
+
+    def set_repl_window(self, window: int) -> int:
+        """Retune the replication ack window at runtime (the ack-RTT
+        actuator's second knob).  Shrinking first settles pending
+        ship batches down to the new bound, so the per-flush quorum
+        barrier and FIFO settle order are untouched — only how many
+        resolved-but-unsettled flushes may coalesce ahead of the head
+        batch changes.  Returns the previous window."""
+        window = max(1, int(window))
+        old = self.repl_window
+        if window != old:
+            if window < old:
+                self._drain_pending(down_to=window)
+            self.repl_window = window
+            self._emit("svc_autotune",
+                       {"knob": "repl_window", "old": old,
+                        "new": window})
+        return old
 
     def heartbeat(self) -> bool:
         """Drive replication liveness without client load: an empty

@@ -33,6 +33,11 @@ requests and correlate out-of-order completions:
                                      -> [per-key results, in order]
 
     ("stats",)                       -> dict
+    ("controller",)                  -> dict: the runtime controller's
+                                       health section + its full
+                                       retained decision journal
+                                       (docs/ARCHITECTURE.md §14;
+                                       `--autotune` arms actuation)
     ("metrics",)                     -> dict: the service's full obs
                                        registry snapshot (counters,
                                        gauges, histograms, per-tenant
@@ -321,6 +326,18 @@ class ServiceServer:
                     else:
                         send(req_id, self.svc.obs_registry.snapshot())
                     continue
+                if op == "controller":
+                    # runtime-controller verb (ARCHITECTURE §14):
+                    # the health section plus the full retained
+                    # decision journal — how an operator audits the
+                    # self-tuning without grepping dumps
+                    send(req_id, {
+                        "controller":
+                            self.svc.controller.health_section(),
+                        "decisions":
+                            self.svc.controller.journal.snapshot(),
+                    })
+                    continue
                 if op == "health":
                     # ensemble-health verb (the cluster-status
                     # analog): host-mirror-sourced, zero device
@@ -579,6 +596,13 @@ class ServiceClient:
             return await self.call("health", **kw)
         return await self.call("health", ens, **kw)
 
+    async def controller(self, **kw):
+        """Runtime-controller audit verb (docs/ARCHITECTURE.md §14):
+        the ``health()`` controller section plus the full retained
+        decision journal (cause metric, observed value, old→new knob,
+        flush id per decision)."""
+        return await self.call("controller", **kw)
+
     async def create_ensemble(self, name, view=None, **kw):
         return await self.call("create_ensemble", name, view, **kw)
 
@@ -596,7 +620,8 @@ async def serve(n_ens: int, n_peers: int, n_slots: int,
                 engine: Any = None, dynamic: Optional[bool] = None,
                 data_dir: Optional[str] = None,
                 warm: bool = False,
-                fast_reads: Optional[bool] = None) -> ServiceServer:
+                fast_reads: Optional[bool] = None,
+                autotune: Optional[bool] = None) -> ServiceServer:
     """Bring up runtime + service + server; returns the started
     server (call ``await server.stop()`` to tear down).
 
@@ -635,6 +660,10 @@ async def serve(n_ens: int, n_peers: int, n_slots: int,
             engine=engine, dynamic=bool(dynamic), data_dir=data_dir)
     if fast_reads is not None:
         svc.set_fast_reads(fast_reads)
+    if autotune is not None:
+        # tri-state like fast_reads: None keeps the service default
+        # (the RETPU_AUTOTUNE env knob, off for one release)
+        svc.set_autotune(autotune)
     if warm:
         # pre-compile the (K, A) bucket grid — pow2 flush depths x
         # pow2 active-column widths, both want_vsn pack variants
@@ -677,6 +706,14 @@ def main(argv=None) -> int:
                     help="disable the lease-protected read fast path "
                          "(every read takes a device round; same as "
                          "RETPU_FAST_READS=0)")
+    ap.add_argument("--autotune", action="store_true", default=None,
+                    help="arm the obs-actuated runtime controller "
+                         "(same as RETPU_AUTOTUNE=1): auto-tunes the "
+                         "launch/replication pipeline knobs and the "
+                         "tenant-admission guard from the measured "
+                         "obs plane, every decision journaled "
+                         "(docs/ARCHITECTURE.md §14; audit via the "
+                         "('controller',) verb)")
     args = ap.parse_args(argv)
 
     async def run() -> None:
@@ -686,7 +723,8 @@ def main(argv=None) -> int:
             config=fast_test_config() if args.fast else None,
             dynamic=args.dynamic, data_dir=args.data_dir,
             warm=args.warm,
-            fast_reads=False if args.no_fast_reads else None)
+            fast_reads=False if args.no_fast_reads else None,
+            autotune=args.autotune)
         print(f"svcnode serving {args.n_ens} ensembles on "
               f"{server.host}:{server.port}", flush=True)
         fp = faults.active_plan()

@@ -346,3 +346,47 @@ def test_faultsweep_smoke():
     assert nt["quiet_p99_ratio"] > 0
     assert fs["fault_config"]["fsync_ms"] == 2.0
     assert out["faultsweep_depth2_speedup"] is not None
+
+
+def test_autotune_guard_arm_smoke():
+    """Tier-1 tripwire for the controller's tenant-guard plumbing at
+    the cheap end (ARCHITECTURE §14): the guarded noisy-tenant arm
+    must journal a real admission decision against the hot tenant
+    and report both tenants' latencies.  The RTT convergence arms
+    spin up replica groups (seconds each) — slow lane + round time."""
+    nt = bench._noisy_tenant_arm(16, 8, 8, 0.3, compact=True,
+                                 guard=True)
+    assert nt["ops_per_sec"] > 0
+    assert nt["hot_ops"] > nt["quiet_ops"] > 0
+    assert nt["guard_decisions"], "guard armed but never decided"
+    ev = nt["guard_decisions"][0]
+    assert ev["actuator"] == "tenant_guard"
+    assert ev["cause"] == "tenant_ops_share"
+    assert ev["observed"] >= 0.7
+    assert nt["throttled_rows"].get("hot"), nt["throttled_rows"]
+
+
+@pytest.mark.slow
+def test_autotune_smoke():
+    """The full autotune A/B runner (ARCHITECTURE §14): static and
+    controller arms run at both smoke RTT points, the journal
+    reconstruction holds (asserted INSIDE the runner per arm), and
+    the guard rung reports both arms.  Ratio bounds stay loose —
+    smoke shapes on a CI box measure noise; the within-5%-of-best-
+    static acceptance is pinned at round time on the full shape."""
+    from riak_ensemble_tpu import faults
+
+    out = bench.run_autotune(0.4, smoke=True)
+    assert faults.active_plan() is None  # the arms clean up
+    at = out["autotune"]
+    assert [p["rtt_ms"] for p in at["points"]] == [0.0, 2.0]
+    for p in at["points"]:
+        assert p["controller_ops_per_sec"] > 0
+        assert all(v > 0 for v in p["static_ops_per_sec"].values())
+        assert p["journal_reconstructed"] is True
+        assert p["vs_best_static"] > 0.3, p
+    assert out["autotune_vs_best_static"] > 0.3
+    tg = at["tenant_guard"]
+    assert tg["guard_decisions"]
+    assert tg["quiet_p99_ms_guarded"] > 0
+    assert tg["quiet_p99_ms_unguarded"] > 0

@@ -48,6 +48,16 @@ def test_svcnode_end_to_end():
         st = await c.stats()
         assert st["ops_served"] > 0 and st["ensembles_with_leader"] >= 1
 
+        # the runtime-controller audit verb (ARCHITECTURE §14): the
+        # health section + the decision journal, wire-encodable; a
+        # stock boot is observe-only with an empty journal
+        ctl = await c.controller()
+        assert ctl["controller"]["enabled"] is False
+        assert ctl["controller"]["pipeline_depth"] >= 1
+        assert ctl["decisions"] == []
+        h = await c.health()
+        assert h["controller"] == ctl["controller"]
+
         # unknown op answers, connection stays usable
         assert await c.call("bogus-op") == ("error", "unknown-op")
         assert await c.kget(1, "p0") == ("ok", b"x0")

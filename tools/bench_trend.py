@@ -69,6 +69,11 @@ COLUMNS: Tuple[Tuple[str, str], ...] = (
     # per-link RTT point (>=1 ms; bench --stage faultsweep.  >=1.0 =
     # the pipelining claim holds against an adversarially slow link)
     ("faultsweep_depth2_speedup", "fault_x"),
+    # controller arm vs the best static (depth, window) across the
+    # autotune A/B's injected-RTT points (bench --stage autotune;
+    # >=0.95 = the controller converged within the 5% acceptance
+    # band at every link it was measured on)
+    ("autotune_vs_best_static", "autotune_x"),
 )
 
 
