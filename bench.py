@@ -1555,6 +1555,102 @@ def _repgroup_arm(seconds: float, smoke: bool, n_ens: int,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_fleet_obs_overhead(seconds: float, n_ens: int = 16,
+                           n_slots: int = 16, k: int = 8,
+                           rounds: int = 3) -> dict:
+    """Fleet-federation overhead A/B on the replicated smoke rung
+    (acceptance bound: federation pull ON within 2% of OFF — the
+    PR 8 op-trace bar).
+
+    The standing watchdog pull is the only fleet-obs cost a serving
+    leader pays continuously: every cadence it posts one ``obsq``
+    timeline request per link (riding the SAME FIFO socket as the
+    apply stream) and harvests the previous window's responses.  The
+    A/B: two identical in-process 3-host groups, the ON arm with
+    ``RETPU_WATCHDOG=1`` and a deliberately aggressive cadence (8
+    flushes — serving defaults evaluate 8x less often, so the bound
+    measured here is conservative), the OFF arm ``RETPU_WATCHDOG=0``;
+    one long interleaved stream of settled keyed rounds at batch
+    granularity with the pair order flipping (the PR 6 methodology —
+    window estimators lie on a small box), per-arm medians."""
+    import shutil
+    import tempfile
+
+    from riak_ensemble_tpu.config import fast_test_config
+    from riak_ensemble_tpu.parallel import repgroup
+    from riak_ensemble_tpu.parallel.batched_host import WallRuntime
+
+    tmp = tempfile.mkdtemp(prefix="bench_fleetobs_")
+    packs = []
+    keys = [f"key{j}" for j in range(k)]
+    vals = [b"v%d" % j for j in range(k // 2)]
+
+    def make(tag: str, env: str):
+        servers = [repgroup.ReplicaServer(
+            n_ens, 3, n_slots, data_dir=f"{tmp}/{tag}_r{i}",
+            config=fast_test_config()) for i in (1, 2)]
+        svc = _env_scoped(
+            "RETPU_WATCHDOG", env,
+            lambda: repgroup.ReplicatedService(
+                WallRuntime(), n_ens, 1, n_slots, group_size=3,
+                peers=[("127.0.0.1", s.repl_port) for s in servers],
+                ack_timeout=60.0, max_ops_per_tick=k,
+                config=fast_test_config(),
+                data_dir=f"{tmp}/{tag}_leader"))
+        repgroup.warmup_kernels(svc)
+        assert svc.takeover(), "fleet-obs bench: takeover failed"
+        if env == "1":
+            # aggressive cadence: the measured arm pulls 8x more
+            # often than the serving default — the bound stays
+            # conservative
+            svc.watchdog.cadence = 8
+        pack = {"svc": svc, "servers": servers}
+        packs.append(pack)
+        batch(pack)  # warm: slots, remote compile, first sync
+        svc.ack_timeout = 10.0
+        return pack
+
+    def batch(pack) -> float:
+        svc = pack["svc"]
+        t0 = time.perf_counter()
+        futs = []
+        for e in range(n_ens):
+            futs.append(svc.kput_many(e, keys[:k // 2], vals))
+            futs.append(svc.kget_many(e, keys[k // 2:]))
+        while any(svc.queues):
+            svc.flush()
+        assert all(f.done for f in futs), "fleet-obs A/B: unsettled"
+        return time.perf_counter() - t0
+
+    try:
+        on_pack, off_pack = make("on", "1"), make("off", "0")
+        on_t, off_t, n = _interleaved_ab(on_pack, off_pack, batch,
+                                         seconds, rounds)
+        on_svc, off_svc = on_pack["svc"], off_pack["svc"]
+        out = _ab_scores("fleet_obs", on_t, off_t, n, k * n_ens)
+        # sanity: the ON arm really pulled (posted obsq sidebands and
+        # refreshed at least one link's clock estimate), the OFF arm
+        # really didn't — otherwise the A/B measured nothing
+        out["fleet_obs_pulls"] = int(on_svc.watchdog.pulls)
+        out["fleet_obs_watchdog_evals"] = int(on_svc.watchdog.evals)
+        clk = [l.clock.samples for l in on_svc._links]
+        out["fleet_obs_clock_samples"] = int(sum(clk))
+        assert on_svc.watchdog.pulls > 0, \
+            "fleet-obs ON arm never pulled — cadence plumbing broken"
+        assert off_svc.watchdog.pulls == 0, \
+            "fleet-obs OFF arm pulled despite RETPU_WATCHDOG=0"
+        return out
+    finally:
+        for pack in packs:
+            try:
+                pack["svc"].stop()
+            except Exception:
+                pass
+            for s in pack["servers"]:
+                s.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_faultsweep(seconds: float, smoke: bool) -> dict:
     """Adversarial fault-injection rungs (docs/ARCHITECTURE.md §13):
     what the system does when the NETWORK or the DISK misbehaves,
@@ -2524,6 +2620,8 @@ def _stage_entry(args) -> None:
         out = run_faultsweep(args.seconds, smoke=False)
     elif args.stage == "autotune":
         out = run_autotune(args.seconds, smoke=False)
+    elif args.stage == "fleetobs":
+        out = run_fleet_obs_overhead(args.seconds)
     elif args.stage == "merkle":
         m = run_merkle(args.seconds, smoke=False)
         out = {"ladder_metric": m["metric"], "ladder_value": m["value"]}
@@ -2555,7 +2653,7 @@ def main() -> None:
                     choices=("kernel", "service", "merkle", "reconfig",
                              "probe", "stepprobe", "repgroup",
                              "widecmp", "escale", "faultsweep",
-                             "autotune"),
+                             "autotune", "fleetobs"),
                     help="internal: run one stage in-process")
     ap.add_argument("--n-ens", type=int, default=10_000)
     ap.add_argument("--n-peers", type=int, default=5)
@@ -2593,6 +2691,7 @@ def main() -> None:
         svc.update(run_repgroup(secs, smoke=True))
         svc.update(run_faultsweep(secs, smoke=True))
         svc.update(run_autotune(secs, smoke=True))
+        svc.update(run_fleet_obs_overhead(secs))
         svc["platform"] = "smoke"
         svc["bench_trend"] = trend
         label = "64_ens_5_peers_smoke"
@@ -2686,6 +2785,14 @@ def main() -> None:
             if r is not None:
                 svc.update({k: v for k, v in r.items()
                             if k.startswith("autotune")})
+            # fleet-federation overhead A/B (ARCHITECTURE §11): the
+            # standing watchdog pull on vs off over an in-process
+            # 3-host group — bound < 2%, the PR 8 op-trace bar
+            r = _run_stage("fleetobs", label, {}, args.seconds,
+                           420.0, force_cpu)
+            if r is not None:
+                svc.update({k: v for k, v in r.items()
+                            if k.startswith("fleet_obs")})
             # E-scaling datapoints (ROADMAP carried debt item 2): the
             # 1k-ens CPU rung always rides the round JSON; the 2k-
             # and 4k-ens points land when the box completes them

@@ -50,6 +50,17 @@ stack reports into:
   keeps it observe-only-constructed and bit-identical to the
   pre-controller service.
 
+- :mod:`.fleet` — fleet-scope joining (round 13): per-link NTP-style
+  clock-offset estimation (every ``obsq`` sideband round-trip feeds
+  it), Prometheus multi-host merge under a ``host`` label, and the
+  clock-aligned cross-host timeline (``svc.fleet_timeline(fid)`` —
+  leader and replica spans on ONE axis, honest to the offset bound).
+- :mod:`.watchdog` — the standing anomaly watchdog (round 13):
+  leader-side, controller-cadence, walks pulled fleet timelines for
+  ack-before-apply skew, persistently slow replica spans, and clock
+  drift; findings journal through the PR 12 ``DecisionJournal``
+  export surfaces.  ``RETPU_WATCHDOG=0`` disarms the standing pull.
+
 Knobs: ``RETPU_OBS=0`` disables hot-path recording (instruments stay
 constructed; record calls short-circuit — the bench's A/B arm);
 ``RETPU_OBS_DUMP_DIR`` directs flight-recorder dumps (unset keeps
@@ -68,6 +79,8 @@ from riak_ensemble_tpu.obs.compilewatch import (COMPILE_EVENTS,
 from riak_ensemble_tpu.obs.controller import (DecisionJournal,
                                               RuntimeController)
 from riak_ensemble_tpu.obs.fingerprint import box_fingerprint
+from riak_ensemble_tpu.obs.fleet import (ClockOffset, align_timeline,
+                                         merge_prometheus)
 from riak_ensemble_tpu.obs.flightrec import FlightRecorder
 from riak_ensemble_tpu.obs.opslo import OpSloRing
 from riak_ensemble_tpu.obs.registry import (Counter, Gauge, Histogram,
@@ -75,12 +88,14 @@ from riak_ensemble_tpu.obs.registry import (Counter, Gauge, Histogram,
                                             MS_BUCKETS)
 from riak_ensemble_tpu.obs.spans import (SPANS, SpanStore,
                                          next_flush_id, timeline)
+from riak_ensemble_tpu.obs.watchdog import AnomalyWatchdog
 
 __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
            "MS_BUCKETS", "FlightRecorder", "SpanStore", "SPANS",
            "next_flush_id", "timeline", "box_fingerprint", "enabled",
            "dump_dir", "OpSloRing", "CompileWatch", "COMPILE_EVENTS",
-           "RuntimeController", "DecisionJournal"]
+           "RuntimeController", "DecisionJournal", "ClockOffset",
+           "align_timeline", "merge_prometheus", "AnomalyWatchdog"]
 
 
 def enabled() -> bool:

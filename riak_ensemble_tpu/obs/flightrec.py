@@ -29,18 +29,37 @@ from typing import Any, Dict, List, Optional
 from riak_ensemble_tpu.obs.fingerprint import box_fingerprint
 
 __all__ = ["FlightRecorder", "DUMP_SCHEMA", "META_FIELDS",
-           "DERIVED_MARKS"]
+           "DERIVED_MARKS", "dump_keep"]
+
+
+def dump_keep(default: int = 64) -> int:
+    """How many dump FILES a dump directory retains
+    (``RETPU_OBS_DUMP_KEEP``; <= 0 disables rotation).  Read per
+    dump, not cached: rotation is cold-path by construction (behind
+    the trigger's rate limit), and a soak harness lowering the cap
+    mid-run should win immediately.  Without this cap a long wedge
+    soak with a flapping trigger fills the disk — one dump file every
+    ``min_dump_interval_s`` forever."""
+    try:
+        return int(os.environ.get("RETPU_OBS_DUMP_KEEP", default))
+    except ValueError:
+        return default
 
 #: v2 added the per-op SLO ring tail (``slow_ops``: the slowest acked
 #: ops with their stage splits), the service's recent
 #: ``compile_events``, and the active fault-injection plan
 #: (``injected_faults`` — so an anomaly captured mid-nemesis indicts
-#: the nemesis); v3 adds the runtime controller's recent
+#: the nemesis); v3 added the runtime controller's recent
 #: ``controller_decisions`` (so a dump captured while the controller
-#: was moving knobs shows WHICH knob moved and why) — all from the
-#: recorder's ``extras`` callback (empty when no extras provider is
-#: attached)
-DUMP_SCHEMA = "retpu-flight-dump-v3"
+#: was moving knobs shows WHICH knob moved and why); v4 adds the
+#: FLEET sections — ``hosts`` (each replica's matching span records
+#: for the fids in this ring, pulled by the leader at trigger time),
+#: ``clock_offsets`` (the per-link offset estimates those records
+#: align under) and ``watchdog_findings`` — turning "the ack was
+#: slow" into "replica B's wal_sync held the quorum" in ONE file.
+#: All sections come from the recorder's ``extras`` callback (empty
+#: when no extras provider is attached / the service is standalone).
+DUMP_SCHEMA = "retpu-flight-dump-v4"
 
 #: DERIVED latency marks — sums/subdivisions of other marks
 #: ('enqueue' = h2d + dispatch; resolve_native/resolve_fallback =
@@ -176,12 +195,15 @@ class FlightRecorder:
             "ring": [dict(r) for r in self.records],
             "box": box_fingerprint(),
             # per-op tail + compile-event + injected-fault +
-            # controller-decision sections (schema v3): empty when no
-            # extras provider is attached
+            # controller-decision + fleet sections (schema v4): empty
+            # when no extras provider is attached
             "slow_ops": [],
             "compile_events": [],
             "injected_faults": {},
             "controller_decisions": [],
+            "hosts": {},
+            "clock_offsets": {},
+            "watchdog_findings": [],
         }
         if self.extras is not None:
             try:
@@ -206,9 +228,43 @@ class FlightRecorder:
                     json.dump(snap, f)
                 os.replace(tmp, path)  # atomic: a killed process
                 snap["path"] = path    # never leaves a torn dump
+                self._rotate(d)
             except OSError:
                 pass  # a full/readonly disk must not fail the flush
         return snap
+
+    @staticmethod
+    def _rotate(d: str) -> None:
+        """Oldest-first dump rotation: keep at most
+        :func:`dump_keep` ``flight_*.json`` files in the dump dir
+        (atomic per-file unlink — a reader holding an open fd keeps
+        its data; a concurrent writer's ``.tmp`` never matches).
+        Shared dirs rotate COLLECTIVELY: leader + subprocess-replica
+        recorders pointing at one directory enforce one cap, which is
+        exactly what bounds the disk."""
+        keep = dump_keep()
+        if keep <= 0:
+            return
+        try:
+            paths = [os.path.join(d, f) for f in os.listdir(d)
+                     if f.startswith("flight_") and f.endswith(".json")]
+        except OSError:
+            return
+        if len(paths) <= keep:
+            return
+
+        def age(p):
+            try:
+                return os.path.getmtime(p)
+            except OSError:
+                return 0.0  # racing unlink: treat as oldest
+
+        paths.sort(key=lambda p: (age(p), p))
+        for p in paths[:-keep]:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass  # a racing rotator already took it
 
     def marks_tail(self, n: int) -> List[Dict[str, Any]]:
         """The newest ``n`` records (oldest first) — the bench's

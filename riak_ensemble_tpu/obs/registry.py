@@ -316,9 +316,21 @@ class MetricsRegistry:
             out[name] = by_label(fam["values"])
         return out
 
-    def render_prometheus(self) -> str:
-        """Prometheus text exposition format, version 0.0.4."""
+    def render_prometheus(self, host: Optional[str] = None) -> str:
+        """Prometheus text exposition format, version 0.0.4.
+
+        ``host`` labels every sample with ``host="..."`` (the
+        fleet-scrape dimension: one leader scrape answering for the
+        whole group tells its hosts apart by this label —
+        ``obs.fleet.merge_prometheus`` applies the same injection to
+        replica-rendered texts, so local and pulled sections agree)."""
         lines: List[str] = []
+        if host is not None:
+            from riak_ensemble_tpu.obs import fleet as _fleet
+            plain = self.render_prometheus()
+            return "\n".join(
+                _fleet.inject_host_label(ln, host)
+                for ln in plain.splitlines()) + "\n"
 
         def head(name: str, typ: str, help: str) -> None:
             if help:

@@ -29,7 +29,7 @@ from __future__ import annotations
 import itertools
 import threading
 from collections import OrderedDict
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Tuple
 
 __all__ = ["next_flush_id", "SpanStore", "SPANS", "timeline"]
 
@@ -49,6 +49,15 @@ class SpanStore:
         self.max_flushes = max_flushes
         self._lock = threading.Lock()
         self._flushes: "OrderedDict[int, Dict[str, Any]]" = OrderedDict()
+        #: highest flush id the ring has ever evicted — the
+        #: evicted/unknown miss boundary (a fid at or below it that
+        #: is absent ROLLED OFF; above it, it was never recorded
+        #: here — "the replica hasn't seen it yet" vs "too late")
+        self._evict_high = 0
+        #: lookup misses by reason (exported as
+        #: ``retpu_span_misses_total{reason=...}``) — the fleet
+        #: puller's signal for distinguishing lag from loss
+        self.misses: Dict[str, int] = {"evicted": 0, "unknown": 0}
 
     def record(self, flush_id: int, role: str,
                spans: List[Tuple[str, float]],
@@ -64,21 +73,40 @@ class SpanStore:
             if rec is None:
                 rec = self._flushes[flush_id] = {}
                 while len(self._flushes) > self.max_flushes:
-                    self._flushes.popitem(last=False)
+                    old_fid, _old = self._flushes.popitem(last=False)
+                    if old_fid > self._evict_high:
+                        self._evict_high = old_fid
             side = rec.setdefault(role, {"spans": []})
             side["spans"].extend(
                 (str(n), float(d)) for n, d in spans)
             for k, v in info.items():
                 side[k] = v
 
-    def timeline(self, flush_id: int) -> Optional[Dict[str, Any]]:
+    def _miss_reason(self, flush_id: int) -> str:
+        """Why a lookup missed (call under the lock): ``evicted`` for
+        ids at or below the ring's eviction high-water (recorded once,
+        rolled off — includes never-recorded ids in that range, the
+        honest limit of a bounded ring), ``unknown`` above it (never
+        seen HERE — on a replica that usually means "hasn't arrived
+        yet")."""
+        reason = ("evicted" if 0 < flush_id <= self._evict_high
+                  else "unknown")
+        self.misses[reason] += 1
+        return reason
+
+    def timeline(self, flush_id: int) -> Dict[str, Any]:
         """The joined per-flush record: ``{"flush_id": N, "leader":
-        {...}, "replica": {...}}`` with per-role span lists, or None
-        if the flush aged out of the ring (or never recorded)."""
+        {...}, "replica": {...}}`` with per-role span lists.  A flush
+        the store cannot answer returns a STRUCTURED miss —
+        ``{"flush_id": N, "miss": "evicted"|"unknown"}`` — instead of
+        bare None, and counts into :attr:`misses`: the fleet puller
+        must distinguish "rolled off the ring" from "this host never
+        saw it"."""
         with self._lock:
             rec = self._flushes.get(flush_id)
             if rec is None:
-                return None
+                return {"flush_id": int(flush_id),
+                        "miss": self._miss_reason(flush_id)}
             out: Dict[str, Any] = {"flush_id": flush_id}
             for role, side in rec.items():
                 out[role] = {"spans": list(side["spans"]),
@@ -96,13 +124,17 @@ class SpanStore:
         ``role`` across ``flush_ids``, one lock acquisition for the
         whole batch — the runtime controller's bulk read (e.g. the
         ``repl_ack`` samples of the last cadence window's flushes).
-        Missing flushes/roles/spans contribute nothing: a flush whose
-        ack is still pending simply isn't a sample yet."""
+        Missing roles/spans contribute nothing: a flush whose ack is
+        still pending simply isn't a sample yet.  A flush id entirely
+        absent from the store counts a structured miss (evicted vs
+        unknown) like :meth:`timeline` — and still contributes no
+        sample."""
         out: List[float] = []
         with self._lock:
             for fid in flush_ids:
                 rec = self._flushes.get(fid)
                 if rec is None:
+                    self._miss_reason(fid)
                     continue
                 side = rec.get(role)
                 if side is None:
@@ -115,6 +147,7 @@ class SpanStore:
 SPANS = SpanStore()
 
 
-def timeline(flush_id: int) -> Optional[Dict[str, Any]]:
-    """Module-level convenience over the global store."""
+def timeline(flush_id: int) -> Dict[str, Any]:
+    """Module-level convenience over the global store (misses come
+    back structured — check ``tl.get("miss")``, not ``is None``)."""
     return SPANS.timeline(flush_id)

@@ -4030,7 +4030,64 @@ class BatchedEnsembleService:
             "retpu_ensembles_with_leader": fam(
                 "gauge", "rows with a live leader",
                 int((self.leader_np >= 0).sum())),
+            # process-global by construction (the span store is):
+            # every service in the process exports the same counts,
+            # which is what a scrape of any one of them should see
+            "retpu_span_misses_total": obs.registry.family(
+                "counter", "span-store lookups that missed, by "
+                "reason (evicted = rolled off the bounded ring; "
+                "unknown = this process never recorded the fid)",
+                dict(obs.SPANS.misses), label="reason"),
         }
+
+    # -- fleet-scope surfaces (docs/ARCHITECTURE.md §11) --------------------
+
+    def _fleet_self_label(self) -> str:
+        """This service's host label in fleet answers: the group
+        identity peers dial it by when one exists, else
+        hostname:pid — stable within a process, distinct across the
+        fleet."""
+        addr = getattr(self, "self_addr", None)
+        if addr:
+            return f"{addr[0]}:{addr[1]}"
+        import socket as _socket
+        return f"{_socket.gethostname()}:{os.getpid()}"
+
+    def fleet_metrics(self, fmt: Optional[str] = None):
+        """Fleet metrics: every host's registry under ``host``
+        labels.  On a standalone service the fleet is this host
+        alone; :class:`~riak_ensemble_tpu.parallel.repgroup.
+        ReplicatedService` overrides the pull to cover its links.
+        ``fmt="prometheus"`` answers ONE merged scrape document."""
+        label = self._fleet_self_label()
+        if fmt == "prometheus":
+            return obs.merge_prometheus(
+                {label: self.obs_registry.render_prometheus()})
+        return {"schema": "retpu-fleet-metrics-v1",
+                "hosts": {label: self.obs_registry.snapshot()},
+                "clock": {}}
+
+    def fleet_health(self) -> Dict[str, Any]:
+        """Fleet health: every host's ``health()`` section keyed by
+        host label (standalone: this host alone)."""
+        return {"schema": "retpu-fleet-health-v1",
+                "hosts": {self._fleet_self_label(): self.health()},
+                "clock": {}}
+
+    def fleet_timeline(self, flush_id: int) -> Dict[str, Any]:
+        """Clock-aligned cross-host ``obs.timeline``: on a standalone
+        service the local record on a trivial axis (in-process
+        replica lanes share the store, so their roles ride along);
+        the replicated override pulls subprocess replicas' records
+        and maps them through the per-link offsets."""
+        tl = obs.SPANS.timeline(int(flush_id))
+        sides = {} if (not tl or tl.get("miss")) else \
+            {r: s for r, s in tl.items() if r != "flush_id"}
+        out = obs.align_timeline(int(flush_id), sides, {},
+                                 self._fleet_self_label())
+        if tl and tl.get("miss"):
+            out["miss"] = tl["miss"]
+        return out
 
     def set_tenant_label(self, ens: int, label: Any) -> None:
         """Name a row for per-tenant attribution (dynamic rows are
@@ -4298,7 +4355,12 @@ class BatchedEnsembleService:
             [(c, v) for c, v in rec.items()
              if c not in obs.flightrec.META_FIELDS],
             k=fl.k, a_width=fl.a_width, total_s=total,
-            payload_bytes=fl.payload_nbytes)
+            payload_bytes=fl.payload_nbytes,
+            # the fleet-timeline alignment anchor: this role's spans
+            # lay out sequentially ENDING here (record time on THIS
+            # process's monotonic clock — the clock the per-link
+            # offset estimates map between)
+            t_mono=time.monotonic())
         self.flight.record({
             "flush_id": fl.flush_id, "t": time.time(),
             "k": fl.k, "a_width": fl.a_width,

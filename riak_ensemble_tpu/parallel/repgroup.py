@@ -1109,6 +1109,10 @@ class ReplicaCore:
             # charged to the run and marked with its size.
             t_wal = time.perf_counter() - t_scattered
             n_run = len(decoded)
+            # fleet alignment anchor: spans lay out ENDING at this
+            # record-time stamp on THIS host's monotonic clock (the
+            # clock the leader's per-link offset estimate maps from)
+            t_mono = time.monotonic()
             for (seq, _c, _cols, _cnt, _jj, _s, _v, _r, _q, _m,
                  fid) in decoded:
                 obs.SPANS.record(
@@ -1118,7 +1122,8 @@ class ReplicaCore:
                      ("scatter", marks.get("scatter", 0.0)),
                      ("rebuild", marks.get("rebuild", 0.0)),
                      ("wal_sync", t_wal)],
-                    seq=seq, run_entries=n_run, kind="delta")
+                    seq=seq, run_entries=n_run, kind="delta",
+                    t_mono=t_mono)
         return crcs
 
     def _apply_full_entry(self, ge: int, ent: Tuple) -> int:
@@ -1203,7 +1208,7 @@ class ReplicaCore:
                 fid, self._obs_role(),
                 [("apply", t_applied - t_start),
                  ("wal_sync", time.perf_counter() - t_applied)],
-                seq=int(seq), kind="full")
+                seq=int(seq), kind="full", t_mono=time.monotonic())
         return crc
 
     def _mirror_write(self, e: int, key: Any, slot: int, handle: int,
@@ -1548,7 +1553,7 @@ def _send_parts(sock: socket.socket, parts) -> None:
 
 
 class _Ticket:
-    __slots__ = ("event", "result", "posted", "on_done")
+    __slots__ = ("event", "result", "posted", "fired", "on_done")
 
     def __init__(self, on_done=None) -> None:
         self.event = threading.Event()
@@ -1558,12 +1563,18 @@ class _Ticket:
         #: a genuinely-overdue response (posted >= IO_TIMEOUT ago)
         #: from a request that arrived DURING the blocked recv
         self.posted = time.monotonic()
+        #: completion time, stamped in _fire — the (posted, fired)
+        #: pair brackets the remote's handling stamp, which is what
+        #: the fleet plane's NTP-midpoint clock-offset estimation
+        #: consumes (obs.fleet.ClockOffset; zero until fired)
+        self.fired = 0.0
         #: completion hook (the batch settle's shared condition),
         #: attached at creation — BEFORE the frame can complete, so a
         #: wakeup can never be missed
         self.on_done = on_done
 
     def _fire(self) -> None:
+        self.fired = time.monotonic()
         self.event.set()
         cb = self.on_done
         if cb is not None:
@@ -1737,6 +1748,11 @@ class PeerLink:
         #: matching ack would be discounted (one redundant full
         #: re-sync per occurrence)
         self.install_barrier = 0
+        #: per-link clock-offset estimator (the fleet plane): every
+        #: obsq sideband round-trip feeds it (posted/remote/fired
+        #: stamps), and fleet timelines/dumps read the estimate +
+        #: bound to place this replica's spans on the leader's axis
+        self.clock = obs.ClockOffset()
         #: in-flight tree-diff catch-up (probe thread output)
         self.sync: Optional["_TreeSync"] = None
         #: one tree-diff attempt per connection: a failed patch falls
@@ -2224,6 +2240,21 @@ class ReplicatedService(BatchedEnsembleService):
         # group-level metrics join the service's registry (the
         # svcnode `metrics` verb and the docs ratchet see one plane)
         self.obs_registry.collect(self._obs_group_collect)
+        #: the standing fleet anomaly watchdog (obs/watchdog.py):
+        #: ALWAYS constructed so the retpu_watchdog_*/clock-offset
+        #: gauge families register; it TICKS only while armed
+        #: (RETPU_WATCHDOG, default on) AND this lane leads with
+        #: links — the bench's fleet_obs_overhead off arm flips the
+        #: knob and builds a fresh service, like every obs knob
+        self.watchdog = obs.AnomalyWatchdog(self)
+        self._watchdog_armed = self.watchdog.enabled and self._obs
+        #: one-off obsq pulls (fleet verbs + correlated dumps) —
+        #: counted apart from the watchdog's STANDING pulls, so a
+        #: triggered dump on a RETPU_WATCHDOG=0 service never reads
+        #: as the walker having run (source="verb" vs "watchdog")
+        self.fleet_verb_pulls = 0
+        self.fleet_verb_pull_failures = 0
+        self.obs_registry.collect(self.watchdog.collect)
 
     def _obs_group_collect(self) -> Dict[str, Any]:
         def fam(typ, help, val):
@@ -2256,6 +2287,149 @@ class ReplicatedService(BatchedEnsembleService):
                 "counter",
                 "replication group stat (see stats()['group'])",
                 round(val, 6) if isinstance(val, float) else val)
+        return out
+
+    # -- fleet-scope observability (docs/ARCHITECTURE.md §11) ---------------
+
+    #: bounded budget for a synchronous fleet pull (the verbs and the
+    #: correlated-dump hook; the watchdog's standing pulls are
+    #: harvest-next-window and never wait at all)
+    FLEET_PULL_TIMEOUT = 2.0
+
+    def _obsq_result(self, link: PeerLink, ticket: _Ticket):
+        """Unwrap one completed obsq ticket: feeds the link's clock
+        estimator from the (posted, remote, fired) stamp triple and
+        returns the payload — None for drops/timeouts/non-answers."""
+        r = ticket.result
+        if (isinstance(r, tuple) and len(r) >= 3
+                and r[0] == "obsr"):
+            if ticket.fired:
+                link.clock.update(ticket.posted, float(r[1]),
+                                  ticket.fired)
+            return r[2]
+        return None
+
+    def _fleet_pull(self, subop: str, *args,
+                    deadline_s: Optional[float] = None
+                    ) -> Dict[str, Any]:
+        """Post one ``obsq`` sideband request to EVERY link and wait
+        (bounded) for the answers: ``{host_label: payload-or-None}``.
+        The request rides the link's FIFO window behind any
+        outstanding applies — ordered like everything else on the
+        wire, no second connection, no second trust model."""
+        tickets = [(link, link.post(("obsq", subop) + args))
+                   for link in self._links]
+        deadline = time.monotonic() + (
+            self.FLEET_PULL_TIMEOUT if deadline_s is None
+            else deadline_s)
+        out: Dict[str, Any] = {}
+        for link, t in tickets:
+            PeerLink.wait(t, deadline)
+            payload = self._obsq_result(link, t) \
+                if t.event.is_set() else None
+            if payload is None:
+                self.fleet_verb_pull_failures += 1
+            out[link.label] = payload
+        self.fleet_verb_pulls += len(tickets)
+        return out
+
+    def _clock_section(self) -> Dict[str, Any]:
+        return {l.label: l.clock.section() for l in self._links}
+
+    def fleet_metrics(self, fmt: Optional[str] = None):
+        """One answer for the whole group: every replica's registry
+        pulled over the obsq sideband next to this leader's own.
+        ``fmt="prometheus"`` merges the per-host renders into ONE
+        scrape document with ``host`` labels (§11) — the federated
+        scrape; otherwise a dict of per-host snapshots plus the
+        clock-offset estimates the pull refreshed."""
+        if not self._links:
+            return super().fleet_metrics(fmt)
+        label = self._fleet_self_label()
+        if fmt == "prometheus":
+            sections = {label: self.obs_registry.render_prometheus()}
+            sections.update(self._fleet_pull("prometheus"))
+            return obs.merge_prometheus(sections)
+        hosts = {label: self.obs_registry.snapshot()}
+        for h, snap in self._fleet_pull("metrics").items():
+            hosts[h] = snap
+        return {"schema": "retpu-fleet-metrics-v1", "hosts": hosts,
+                "clock": self._clock_section()}
+
+    def fleet_health(self) -> Dict[str, Any]:
+        if not self._links:
+            return super().fleet_health()
+        hosts = {self._fleet_self_label(): self.health()}
+        hosts.update(self._fleet_pull("health"))
+        return {"schema": "retpu-fleet-health-v1", "hosts": hosts,
+                "clock": self._clock_section()}
+
+    def fleet_timeline(self, flush_id: int) -> Dict[str, Any]:
+        """The clock-aligned cross-host timeline: this process's
+        record for ``flush_id`` merged with every replica's pulled
+        record, each role's spans placed on the LEADER's monotonic
+        axis through the per-link offset estimates (honest to the
+        per-role ``bound_ms``)."""
+        if not self._links:
+            return super().fleet_timeline(flush_id)
+        fid = int(flush_id)
+        sides: Dict[str, Any] = {}
+        local = obs.SPANS.timeline(fid)
+        missing = True
+        if local and not local.get("miss"):
+            missing = False
+            sides.update({r: s for r, s in local.items()
+                          if r != "flush_id"})
+        for _host, payload in self._fleet_pull(
+                "timeline", [fid]).items():
+            tl = payload.get(fid) if isinstance(payload, dict) \
+                else None
+            if not isinstance(tl, dict) or tl.get("miss"):
+                continue
+            missing = False
+            for role, side in tl.items():
+                if role != "flush_id" and role not in sides:
+                    sides[role] = side
+        out = obs.align_timeline(fid, sides, self._clock_section(),
+                                 self._fleet_self_label())
+        if missing and local and local.get("miss"):
+            out["miss"] = local["miss"]
+        return out
+
+    def _obs_flush_settled(self, fl) -> None:
+        super()._obs_flush_settled(fl)
+        # the standing watchdog rides the same settle hook as the
+        # controller (observe-only sibling): leader-with-links only —
+        # a replica lane has no links to pull and no acks to audit
+        if self._watchdog_armed and self._links and self.is_leader:
+            self.watchdog.tick(fl.flush_id)
+
+    def _flight_extras(self) -> Dict[str, Any]:
+        """Correlated flight dumps (schema v4): on top of the base
+        sections, pull every replica's span records for the fids in
+        THIS ring (bounded wait — dump writes are already rate-
+        limited to one per ``min_dump_interval_s``) so the dump that
+        says "this flush was 8× p50" also says which host's
+        wal_sync/apply held it, on one aligned clock."""
+        out = super()._flight_extras()
+        out["watchdog_findings"] = self.watchdog.flight_section()
+        if not (self._links and self.is_leader):
+            return out
+        fids = [int(r["flush_id"]) for r in self.flight.records
+                if r.get("flush_id")][-32:]
+        if not fids:
+            return out
+        hosts: Dict[str, Any] = {}
+        for host, payload in self._fleet_pull(
+                "timeline", fids,
+                deadline_s=self.FLEET_PULL_TIMEOUT).items():
+            if isinstance(payload, dict):
+                hosts[host] = {"spans": {int(f): tl for f, tl
+                                         in payload.items()}}
+            else:
+                hosts[host] = {"unreachable": True}
+        out["hosts"] = hosts
+        out["clock_offsets"] = self._clock_section()
         return out
 
     # -- leadership ---------------------------------------------------------
@@ -3466,6 +3640,11 @@ class ReplicatedService(BatchedEnsembleService):
             "depositions": int(
                 self.group_stats.get("depositions", 0)),
         }
+        # the fleet watchdog's section (§11): always present on a
+        # grouped service — `enabled: false` when disarmed — so a
+        # dashboard's queries keep their shape, the controller-
+        # section discipline
+        out["watchdog"] = self.watchdog.health_section()
         return out
 
     def stop(self) -> None:
@@ -3602,6 +3781,16 @@ class ReplicaServer:
                     # by the campaign flag (busy-nacks) instead.
                     peers = [(str(h), int(p)) for h, p in frame[1]]
                     resp = self._promote(peers)
+                elif frame and frame[0] == "obsq":
+                    # fleet sideband: answered OUTSIDE the big lock —
+                    # the payloads read thread-safe stores (the span
+                    # store has its own lock) or monitoring-grade
+                    # snapshots, and an obs pull must never queue
+                    # behind a slow apply holding the lock (the
+                    # response's monotonic stamp feeds the leader's
+                    # clock-offset estimate; lock dwell would inflate
+                    # the round-trip bound for nothing)
+                    resp = self._handle_obsq(frame)
                 else:
                     with self._lock:
                         resp = self._handle_repl(frame)
@@ -3710,6 +3899,36 @@ class ReplicaServer:
                  list(l.remote_state))
                 for l in self.svc._links])
         return ("error", "unknown-op")
+
+    def _handle_obsq(self, frame: Tuple) -> Tuple:
+        """The fleet sideband (docs/ARCHITECTURE.md §11): one
+        ``("obsq", kind, ...)`` request per pull, answered
+        ``("obsr", t_mono, payload)`` — the monotonic stamp is this
+        HOST's clock while handling, the middle leg of the leader's
+        NTP-midpoint offset estimate.  Every payload is
+        read-only/monitoring-grade: registry snapshot or Prometheus
+        text, the health section, or span-store records by flush id
+        (structured misses included — "hasn't arrived yet" vs
+        "rolled off"; a replica's per-fid evidence lives in its span
+        store, since delta applies never ride its own launch path or
+        flight ring)."""
+        kind = frame[1]
+        svc = self.svc
+        try:
+            if kind == "metrics":
+                payload: Any = svc.obs_registry.snapshot()
+            elif kind == "prometheus":
+                payload = svc.obs_registry.render_prometheus()
+            elif kind == "health":
+                payload = svc.health()
+            elif kind == "timeline":
+                fids = [int(f) for f in frame[2]]
+                payload = {f: obs.SPANS.timeline(f) for f in fids}
+            else:
+                return ("error", "unknown-op")
+        except Exception:
+            return ("error", "internal")
+        return ("obsr", time.monotonic(), payload)
 
     def _apply_cfg(self, cfg) -> None:
         """Mirror a committed group config into this server's
@@ -3932,6 +4151,30 @@ class ReplicaServer:
                 with self._lock:
                     send(req_id, ("ok",
                                   self.svc.membership_status()))
+                continue
+            if op == "fleet":
+                # fleet verbs (leader-routed like ops: only the
+                # leader holds links to pull) — svcnode's frame
+                # grammar, so GroupClient/ServiceClient speak it
+                # against a promoted replica too.  NO big lock: the
+                # pull blocks on replica round-trips, and holding the
+                # lock would stall the flush loop behind it.
+                try:
+                    sub = args[0] if args else "health"
+                    if sub == "metrics":
+                        fmt = args[1] if len(args) > 1 else None
+                        resp = self.svc.fleet_metrics(
+                            "prometheus" if fmt == "prometheus"
+                            else None)
+                    elif sub == "health":
+                        resp = self.svc.fleet_health()
+                    elif sub == "timeline":
+                        resp = self.svc.fleet_timeline(int(args[1]))
+                    else:
+                        resp = ("error", "bad-request")
+                except Exception:
+                    resp = ("error", "failed")
+                send(req_id, resp)
                 continue
             if op in ("create_ensemble", "destroy_ensemble",
                       "resolve_ensemble"):

@@ -65,6 +65,23 @@ requests and correlate out-of-order completions:
                                        election churn, corrupt flag,
                                        committed (epoch, seq) high-
                                        water, queue/pending depths
+    ("fleet", "metrics")             -> dict: every group host's
+                                       registry snapshot under host
+                                       labels + per-link clock-offset
+                                       estimates (leader pulls its
+                                       replicas over the obsq
+                                       sideband; ARCHITECTURE §11)
+    ("fleet", "metrics",
+     "prometheus")                   -> str: ONE merged Prometheus
+                                       scrape for the whole fleet,
+                                       every sample host-labeled
+    ("fleet", "health")              -> dict: every host's health
+                                       section, host-labeled
+    ("fleet", "timeline", fid)       -> dict: the clock-aligned
+                                       cross-host timeline of one
+                                       flush — leader and replica
+                                       spans on ONE axis, honest to
+                                       the estimated offset bounds
 
 Reads (``kget``/``kget_vsn``/``kget_many``) are served through the
 service's lease-protected fast path when its conditions hold — the
@@ -353,6 +370,44 @@ class ServiceServer:
                     except Exception:
                         send(req_id, ("error", "bad-request"))
                     continue
+                if op == "fleet":
+                    # fleet-scope obs verbs (docs/ARCHITECTURE.md
+                    # §11): one process answering for the whole
+                    # group — merged metrics/health under host
+                    # labels, clock-aligned cross-host timelines.
+                    # On a standalone service the fleet is this host
+                    # alone (same shapes, trivial clock section) and
+                    # answers instantly; a service whose fleet call
+                    # PULLS (a replicated leader fronted by this
+                    # server) blocks up to FLEET_PULL_TIMEOUT on
+                    # replica round-trips, so the call runs in the
+                    # default executor — never on the event loop,
+                    # where it would stall EVERY client's ops.
+                    try:
+                        sub = args[0] if args else "health"
+                        if sub == "metrics":
+                            fmt = args[1] if len(args) > 1 else None
+                            fn = (lambda f=("prometheus"
+                                            if fmt == "prometheus"
+                                            else None):
+                                  self.svc.fleet_metrics(f))
+                        elif sub == "health":
+                            fn = self.svc.fleet_health
+                        elif sub == "timeline":
+                            fid = args[1]
+                            if type(fid) is not int or fid <= 0:
+                                raise ValueError(fid)
+                            fn = (lambda f=fid:
+                                  self.svc.fleet_timeline(f))
+                        else:
+                            send(req_id, ("error", "bad-request"))
+                            continue
+                        result = await asyncio.get_running_loop() \
+                            .run_in_executor(None, fn)
+                        send(req_id, result)
+                    except Exception:
+                        send(req_id, ("error", "bad-request"))
+                    continue
                 if op in ("create_ensemble", "destroy_ensemble",
                           "resolve_ensemble"):
                     send(req_id, self._lifecycle(op, args))
@@ -602,6 +657,25 @@ class ServiceClient:
         decision journal (cause metric, observed value, old→new knob,
         flush id per decision)."""
         return await self.call("controller", **kw)
+
+    async def fleet_metrics(self, fmt: Optional[str] = None, **kw):
+        """Fleet metrics (docs/ARCHITECTURE.md §11): every group
+        host's registry under ``host`` labels — dict snapshots by
+        default, ``fmt="prometheus"`` for ONE merged scrape text."""
+        if fmt is None:
+            return await self.call("fleet", "metrics", **kw)
+        return await self.call("fleet", "metrics", fmt, **kw)
+
+    async def fleet_health(self, **kw):
+        """Every group host's health section, host-labeled, plus the
+        per-link clock-offset estimates."""
+        return await self.call("fleet", "health", **kw)
+
+    async def fleet_timeline(self, fid: int, **kw):
+        """The clock-aligned cross-host timeline of one flush:
+        leader and replica spans on ONE (leader-clock) axis, each
+        role honest to its link's estimated offset bound."""
+        return await self.call("fleet", "timeline", int(fid), **kw)
 
     async def create_ensemble(self, name, view=None, **kw):
         return await self.call("create_ensemble", name, view, **kw)

@@ -118,6 +118,23 @@ def test_op_trace_overhead_smoke():
             > 0.4 * out["op_trace_off_ops_per_sec"]), out
 
 
+def test_fleet_obs_overhead_smoke():
+    """The fleet-federation A/B (ARCHITECTURE §11): both replicated
+    arms run, the ON arm really posted obsq pulls and refreshed the
+    per-link clock estimates, the OFF arm pulled nothing.  The 2%
+    acceptance bound is pinned at round time on the real shape —
+    smoke batches on a CI box measure noise, so the tier-1 bound
+    stays loose."""
+    out = bench.run_fleet_obs_overhead(0.4)
+    assert out["fleet_obs_on_ops_per_sec"] > 0
+    assert out["fleet_obs_off_ops_per_sec"] > 0
+    assert out["fleet_obs_pulls"] > 0
+    assert out["fleet_obs_watchdog_evals"] > 0
+    assert out["fleet_obs_clock_samples"] > 0
+    assert (out["fleet_obs_on_ops_per_sec"]
+            > 0.4 * out["fleet_obs_off_ops_per_sec"]), out
+
+
 def test_bench_trend_check():
     """The bench-trend ratchet rides tier-1 (the CI/tooling
     satellite): a missing/malformed BENCH round JSON, an empty

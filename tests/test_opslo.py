@@ -88,9 +88,12 @@ def test_op_flush_join_survives_batch_split():
     assert len(fids) == 2, f"head and tail joined the same flush: {fids}"
     assert sum(int(ring.n[r]) for r in acked) == 4, \
         "op weight not conserved across the split"
-    # both halves' flushes are queryable timelines
+    # both halves' flushes are queryable timelines (a structured
+    # miss — the store's not-found shape since round 13 — would mean
+    # the join broke)
     for fid in fids:
-        assert obs.timeline(fid) is not None
+        tl = obs.timeline(fid)
+        assert tl and not tl.get("miss"), tl
     svc.stop()
 
 

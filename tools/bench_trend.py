@@ -29,6 +29,13 @@ hostnames are container-random, loadavg is weather.  Rounds captured
 before fingerprints existed (r1-r5) report key ``None`` and never
 match — the check then validates structure only, which is the honest
 claim for them.
+
+The trajectory is GROUPED by box fingerprint (:func:`box_groups`):
+the table draws an explicit boundary line wherever consecutive
+rounds changed boxes, and ``--check`` compares the newest round only
+against earlier rounds with the SAME fingerprint — it never ratchets
+across a fingerprint change (a faster/slower box is weather, not a
+regression; pinned by a two-synthetic-fingerprint regression test).
 """
 
 from __future__ import annotations
@@ -43,7 +50,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["TrendError", "load_rounds", "trajectory", "check",
            "fingerprint_key", "smoke_points", "smoke_best",
-           "render_table", "SMOKE_TREND_FILE"]
+           "render_table", "box_groups", "SMOKE_TREND_FILE"]
 
 ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 SMOKE_TREND_FILE = "BENCH_SMOKE_TREND.json"
@@ -142,7 +149,33 @@ def trajectory(rounds: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return rows
 
 
+def box_groups(rows: List[Dict[str, Any]]
+               ) -> List[Tuple[Optional[Tuple], List[Dict[str, Any]]]]:
+    """Consecutive runs of rounds sharing a box fingerprint, in
+    round order: ``[(box_key, [row, ...]), ...]``.  This is the unit
+    absolute-ms comparisons are valid WITHIN (ROADMAP: cross-round
+    absolute comparisons are box-bound); the table renderer draws an
+    explicit boundary between runs, and the ratchet never compares
+    across one."""
+    groups: List[Tuple[Optional[Tuple], List[Dict[str, Any]]]] = []
+    for row in rows:
+        key = row.get("box_key")
+        if groups and groups[-1][0] == key:
+            groups[-1][1].append(row)
+        else:
+            groups.append((key, [row]))
+    return groups
+
+
+def _box_label(key: Optional[Tuple]) -> str:
+    return "-" if key is None else f"cpu{key[0]}"
+
+
 def render_table(rows: List[Dict[str, Any]]) -> str:
+    """The trajectory table, with an EXPLICIT boundary line wherever
+    consecutive rounds ran on different box fingerprints — a reader
+    eyeballing a column must see where the box changed before
+    believing a delta (absolute-ms comparisons are box-bound)."""
     heads = ["rnd"] + [label for _k, label in COLUMNS] \
         + ["escale", "box"]
     table = [heads]
@@ -154,16 +187,29 @@ def render_table(rows: List[Dict[str, Any]]) -> str:
                 return f"{v:,.1f}" if abs(v) >= 100 else f"{v:g}"
             return str(v)
         esc = ",".join(f"{e}:{fmt(v)}" for e, v in row["escale"].items())
-        box = row["box_key"]
         table.append([str(row["round"])]
                      + [fmt(row[k]) for k, _l in COLUMNS]
-                     + [esc or "-",
-                        "-" if box is None else f"cpu{box[0]}"])
+                     + [esc or "-", _box_label(row["box_key"])])
     widths = [max(len(r[i]) for r in table)
               for i in range(len(heads))]
-    lines = ["  ".join(c.rjust(w) for c, w in zip(r, widths))
-             for r in table]
-    lines.insert(1, "  ".join("-" * w for w in widths))
+    body = ["  ".join(c.rjust(w) for c, w in zip(r, widths))
+            for r in table]
+    lines = body[:1]
+    lines.append("  ".join("-" * w for w in widths))
+    # stitch data lines back in with box boundaries between the
+    # fingerprint runs (rows and body[1:] are index-aligned)
+    i = 1
+    groups = box_groups(rows)
+    for gi, (key, grp) in enumerate(groups):
+        if gi:
+            prev = groups[gi - 1][0]
+            lines.append(
+                f"~~ box change: {_box_label(prev)} -> "
+                f"{_box_label(key)} (absolute ms not comparable "
+                f"across this line) ~~")
+        for _row in grp:
+            lines.append(body[i])
+            i += 1
     return "\n".join(lines)
 
 

@@ -34,7 +34,15 @@ Two entry points:
 
   renders the dump's per-flush ring records (their latency marks
   are the same spans, minus replica sides) and its
-  ``controller_decisions`` section.
+  ``controller_decisions`` section; a correlated (schema v4) dump's
+  per-host fleet sections render as additional per-host tracks.
+
+Round 13 adds the FLEET path: :func:`fleet_trace_events` (and the
+``--fleet-timelines`` CLI input) renders clock-ALIGNED fleet
+timelines — ``svc.fleet_timeline(fid)`` answers — as one merged
+trace with per-HOST tracks placed at their aligned leader-axis
+times (the one case where cross-track positions ARE wall-clock,
+honest to each role's ``bound_ms``).
 
 Load the output in Perfetto (ui.perfetto.dev) or chrome://tracing.
 """
@@ -82,8 +90,9 @@ def trace_events(flush_ids: Iterable[int],
     base = 0.0
     for fid in sorted(set(int(f) for f in flush_ids)):
         tl = store.timeline(fid)
-        if not tl:
-            continue
+        if not tl or tl.get("miss"):
+            continue  # evicted/unknown fid: a structured miss, not
+            #           a record (the store counted it)
         base_of[fid] = base
         widest = 0.0
         for role, side in tl.items():
@@ -144,6 +153,45 @@ def flight_dump_events(dump: Dict[str, Any],
     return events
 
 
+def fleet_trace_events(timelines: Iterable[Dict[str, Any]],
+                       pid_prefix: str = "") -> List[Dict[str, Any]]:
+    """Render ALIGNED fleet timelines (``svc.fleet_timeline(fid)``
+    dicts — the ``retpu-fleet-timeline-v1`` shape) as ONE merged
+    Chrome/Perfetto trace with per-HOST tracks.
+
+    Unlike :func:`trace_events`' ordinal layout, fleet timelines
+    carry absolute starts on the leader's clock (each role's spans
+    aligned through its link's offset estimate), so events here are
+    placed at their ALIGNED times: ``pid`` = host label (one Perfetto
+    track group per host), ``tid`` = role, and each role carries its
+    ``bound_ms`` in args so a reader knows how much to trust a
+    cross-track comparison.  Timelines of several flushes merge onto
+    one axis by their own ``base_s`` deltas (all bases are
+    leader-clock seconds)."""
+    events: List[Dict[str, Any]] = []
+    tls = [t for t in timelines
+           if isinstance(t, dict) and t.get("roles")]
+    if not tls:
+        return events
+    base0 = min(float(t.get("base_s", 0.0)) for t in tls)
+    for tl in tls:
+        fid = int(tl.get("flush_id", 0))
+        shift = (float(tl.get("base_s", 0.0)) - base0) * _US
+        for role, info in tl["roles"].items():
+            host = info.get("host") or "?"
+            pid = f"{pid_prefix}{host}"
+            for name, start_s, dur_s in info.get("spans", []):
+                events.append({
+                    "name": str(name), "ph": "X",
+                    "ts": shift + max(float(start_s), 0.0) * _US,
+                    "dur": max(float(dur_s), 0.0) * _US,
+                    "pid": pid, "tid": str(role),
+                    "args": {"flush_id": fid,
+                             "aligned": bool(info.get("aligned")),
+                             "bound_ms": info.get("bound_ms", 0.0)}})
+    return events
+
+
 def export(path: str, flush_ids: Iterable[int],
            decisions: Iterable[Dict[str, Any]] = (),
            store: Optional[Any] = None) -> Dict[str, Any]:
@@ -166,24 +214,80 @@ def export(path: str, flush_ids: Iterable[int],
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--flight-dump", required=True,
-                    help="a flight-recorder dump JSON "
-                         "(RETPU_OBS_DUMP_DIR file) to render")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--flight-dump",
+                     help="a flight-recorder dump JSON "
+                          "(RETPU_OBS_DUMP_DIR file) to render; a "
+                          "schema-v4 dump's per-host fleet sections "
+                          "render as additional per-host tracks")
+    src.add_argument("--fleet-timelines",
+                     help="a JSON file holding one (or a list of) "
+                          "clock-ALIGNED fleet timeline dict(s) — "
+                          "the ('fleet','timeline',fid) verb's "
+                          "answer — rendered with per-host tracks "
+                          "at aligned times")
     ap.add_argument("-o", "--out", default="trace.json",
                     help="output trace path (default trace.json)")
     args = ap.parse_args(argv)
+    path = args.flight_dump or args.fleet_timelines
     try:
-        with open(args.flight_dump, encoding="utf-8") as fh:
-            dump = json.load(fh)
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
     except (OSError, json.JSONDecodeError) as exc:
-        print(f"trace_export: unreadable dump: {exc}",
+        print(f"trace_export: unreadable input: {exc}",
               file=sys.stderr)
         return 1
-    doc = {
-        "traceEvents": flight_dump_events(dump),
-        "displayTimeUnit": "ms",
-        "otherData": {"source_dump_schema": dump.get("schema")},
-    }
+    if args.fleet_timelines:
+        tls = data if isinstance(data, list) else [data]
+        doc = {
+            "traceEvents": fleet_trace_events(tls),
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "source": "riak_ensemble_tpu tools/trace_export.py",
+                "timeline_semantics":
+                    "per-host tracks at clock-aligned leader-axis "
+                    "times; trust cross-track deltas to each role's "
+                    "bound_ms",
+            },
+        }
+    else:
+        events = flight_dump_events(data)
+        # a correlated (schema v4) dump carries per-host span
+        # sections: render them as their own host tracks next to the
+        # leader ring (ordinal layout — a dump has no aligned axis,
+        # only the clock_offsets section to read them against)
+        for host, section in (data.get("hosts") or {}).items():
+            if not isinstance(section, dict):
+                continue
+            hbase = 0.0
+            # JSON stringified the flush-id keys: order numerically
+            # (lexicographic would put fid 9 after 10); roles of one
+            # flush share its base like trace_events, and the base
+            # advances once per flush by its widest role
+            for fid, tl in sorted(
+                    (section.get("spans") or {}).items(),
+                    key=lambda kv: int(kv[0])):
+                if not isinstance(tl, dict) or tl.get("miss"):
+                    continue
+                widest = 0.0
+                for role, side in tl.items():
+                    if role == "flush_id" or not isinstance(side,
+                                                            dict):
+                        continue
+                    spans = side.get("spans", [])
+                    events.extend(_span_events(
+                        role, spans, hbase, int(fid), str(host)))
+                    widest = max(widest,
+                                 sum(max(float(d), 0.0)
+                                     for _n, d in spans))
+                hbase += max(widest * _US, 1.0) * 1.25
+        doc = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"source_dump_schema": data.get("schema"),
+                          "clock_offsets":
+                              data.get("clock_offsets") or {}},
+        }
     with open(args.out, "w", encoding="utf-8") as fh:
         json.dump(doc, fh)
     print(f"trace_export: {len(doc['traceEvents'])} events -> "
