@@ -2426,6 +2426,83 @@ def run_widecmp(n_ens: int, n_peers: int, n_slots: int, k: int,
     return out
 
 
+def run_recovery(seconds: float, smoke: bool) -> dict:
+    """``--stage recovery`` (docs/ARCHITECTURE.md §15): restart-to-
+    serving time at the 512-ens rung — the RTO half of the crash
+    contract, measured, not asserted.
+
+    Build a durable (fsync-WAL) service, ack a keyed working set,
+    checkpoint it, ack a WAL tail BEYOND the checkpoint, then release
+    the handles with no cleanup (the crash analog) and time the
+    restart: ``restore()`` (orbax checkpoint load + host-blob read +
+    WAL replay) and the first served read (first-flush warmup /
+    compile) are reported separately so a regression names its phase.
+    ``recovery_ms`` is the headline the round JSON and the
+    ``bench_trend`` ``recov_ms`` column carry.  ``seconds`` scales
+    the WAL-tail depth (~seconds/3 rounds of tail keys), so the
+    default 3 s budget reproduces the recorded shape exactly and a
+    deeper budget measures a deeper replay."""
+    import shutil
+    import tempfile
+
+    from riak_ensemble_tpu.parallel.batched_host import (
+        BatchedEnsembleService, WallRuntime,
+    )
+
+    n_ens, n_peers, n_slots, k = ((16, 3, 8, 4) if smoke
+                                  else (512, 5, 64, 16))
+    ckpt_keys = tail_keys = 2 if smoke else 16
+    # distinct keys per round; bounded by the slot grid (ckpt keys +
+    # tail rounds must all fit per ensemble)
+    tail_rounds = min(max(1, int(round(seconds / 3.0))),
+                      (n_slots - ckpt_keys) // tail_keys)
+    d = tempfile.mkdtemp(prefix="retpu_recovery_")
+    try:
+        svc = BatchedEnsembleService(WallRuntime(), n_ens, n_peers,
+                                     n_slots, tick=None,
+                                     max_ops_per_tick=k, data_dir=d)
+
+        def put_round(tag: str, n: int) -> None:
+            keys = [f"{tag}{j}" for j in range(n)]
+            vals = [b"v-%s-%d" % (tag.encode(), j) for j in range(n)]
+            futs = [svc.kput_many(e, keys, vals)
+                    for e in range(n_ens)]
+            while any(svc.queues):
+                svc.flush()
+            assert all(f.done for f in futs), "recovery: unsettled"
+
+        put_round("c", ckpt_keys)
+        svc.save()
+        for r in range(tail_rounds):
+            put_round("t" if r == 0 else f"t{r}x", tail_keys)
+        wal_records = svc._wal.count
+        svc.stop()
+        svc._wal.close()
+
+        t0 = time.perf_counter()
+        svc2 = BatchedEnsembleService.restore(
+            WallRuntime(), d, tick=None, max_ops_per_tick=k,
+            data_dir=d)
+        t_restore = time.perf_counter()
+        f = svc2.kget(0, "t0")
+        while not f.done:
+            svc2.flush()
+        t_serve = time.perf_counter()
+        assert f.value == ("ok", b"v-t-0"), f.value
+        svc2.stop()
+        return {
+            "recovery_ms": round((t_serve - t0) * 1e3, 3),
+            "recovery_restore_ms": round((t_restore - t0) * 1e3, 3),
+            "recovery_first_op_ms": round((t_serve - t_restore) * 1e3,
+                                          3),
+            "recovery_wal_records": int(wal_records),
+            "recovery_shape": {"n_ens": n_ens, "n_peers": n_peers,
+                               "n_slots": n_slots},
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def run_merkle(seconds: float, smoke: bool) -> dict:
     """BASELINE ladder #4: incremental updates into a 1M-segment
     Merkle tree (the always-up-to-date write-path hashing)."""
@@ -2622,6 +2699,8 @@ def _stage_entry(args) -> None:
         out = run_autotune(args.seconds, smoke=False)
     elif args.stage == "fleetobs":
         out = run_fleet_obs_overhead(args.seconds)
+    elif args.stage == "recovery":
+        out = run_recovery(args.seconds, smoke=False)
     elif args.stage == "merkle":
         m = run_merkle(args.seconds, smoke=False)
         out = {"ladder_metric": m["metric"], "ladder_value": m["value"]}
@@ -2653,7 +2732,7 @@ def main() -> None:
                     choices=("kernel", "service", "merkle", "reconfig",
                              "probe", "stepprobe", "repgroup",
                              "widecmp", "escale", "faultsweep",
-                             "autotune", "fleetobs"),
+                             "autotune", "fleetobs", "recovery"),
                     help="internal: run one stage in-process")
     ap.add_argument("--n-ens", type=int, default=10_000)
     ap.add_argument("--n-peers", type=int, default=5)
@@ -2692,6 +2771,7 @@ def main() -> None:
         svc.update(run_faultsweep(secs, smoke=True))
         svc.update(run_autotune(secs, smoke=True))
         svc.update(run_fleet_obs_overhead(secs))
+        svc.update(run_recovery(secs, smoke=True))
         svc["platform"] = "smoke"
         svc["bench_trend"] = trend
         label = "64_ens_5_peers_smoke"
@@ -2793,6 +2873,15 @@ def main() -> None:
             if r is not None:
                 svc.update({k: v for k, v in r.items()
                             if k.startswith("fleet_obs")})
+            # restart-to-serving rung (ARCHITECTURE §15): checkpoint
+            # restore + WAL replay + first-op warmup at the 512-ens
+            # shape — disk + host + compile, so it rides whatever
+            # platform the headline took
+            r = _run_stage("recovery", label, {}, args.seconds,
+                           420.0, force_cpu)
+            if r is not None:
+                svc.update({k: v for k, v in r.items()
+                            if k.startswith("recovery_")})
             # E-scaling datapoints (ROADMAP carried debt item 2): the
             # 1k-ens CPU rung always rides the round JSON; the 2k-
             # and 4k-ens points land when the box completes them

@@ -19,6 +19,27 @@ reference's EQC suite injects, plus the ones it could not:
   replica's seq discipline must nack and re-sync, never misapply);
 - **fsync delay** — a slow disk under the WAL's ack barrier.
 
+Round 15 extends the plane below the transports into the STORAGE
+stack (docs/ARCHITECTURE.md §15) — the reference's headline safety
+property is surviving a bad disk (synctree.erl:21-73), so the disk
+gets the same injection discipline as the network:
+
+- **per-path-class storage errors** — ``EIO``/``ENOSPC`` raised on
+  ``write`` or ``fsync`` for a path class (``wal`` / ``ckpt`` /
+  ``tree``), consulted by :mod:`..parallel.wal`, the checkpoint blob
+  writer (:mod:`..save`) and the synctree/treestore backends;
+- **torn writes** — the next write of a class is truncated at an
+  injected byte offset (then fails), leaving a genuinely torn
+  record for replay to detect;
+- **bit-flip read corruption** — store reads flip a seeded random
+  bit with a per-class probability, exercising every CRC gate;
+- **crash points** — ``RETPU_CRASHPOINT=<barrier>[:<nth>]``
+  terminates the process (``os._exit(CRASH_EXIT)``) at the nth hit
+  of a named durability barrier (``wal_append``,
+  ``wal_fsync_pre``/``post``, ``ckpt_tmp_write``, ``ckpt_rename``,
+  ``replica_apply_pre_ack``, ``tree_save``) — the kill -9 analog
+  aimed exactly at the protocol's recovery contract.
+
 Rules are keyed by ``(src, dst)`` endpoint names with ``"*"``
 wildcards.  The scalar runtimes use node names; a ``PeerLink`` is
 addressed as ``"host:port"`` with :data:`LOCAL` as the leader-side
@@ -26,7 +47,8 @@ name.  Faults are installed programmatically (:func:`install`, or a
 plan handed directly to a ``Network``) or via environment knobs —
 ``RETPU_FAULT_DROP``, ``RETPU_FAULT_RTT_MS``,
 ``RETPU_FAULT_RTT_JITTER_MS``, ``RETPU_FAULT_REORDER``,
-``RETPU_FAULT_FSYNC_MS``, ``RETPU_FAULT_SEED``,
+``RETPU_FAULT_FSYNC_MS``, ``RETPU_FAULT_STORAGE``,
+``RETPU_FAULT_TORN``, ``RETPU_FAULT_CORRUPT``, ``RETPU_FAULT_SEED``,
 ``RETPU_FAULT_SILENT`` (see the README knob table) — so a subprocess
 replica host can run under the same nemesis as its in-process leader.
 
@@ -46,6 +68,7 @@ timing: nothing fires, callers ride their own deadlines.
 
 from __future__ import annotations
 
+import errno as _errno
 import os
 import random
 import sys
@@ -55,15 +78,42 @@ from typing import Any, Dict, Optional, Tuple
 
 __all__ = ["FaultPlan", "LOCAL", "install", "clear", "plan",
            "active_plan", "from_env", "fsync_sleep", "SoakSchedule",
-           "wedge_soak"]
+           "wedge_soak", "storage_raise", "torn_limit", "read_filter",
+           "crashpoint", "CRASH_EXIT", "STORAGE_ERRNOS"]
 
 #: the local endpoint name a PeerLink uses for its own (leader) side
 LOCAL = "local"
+
+#: exit status of a process killed at an injected crash point — a
+#: parent driving a recovery sweep distinguishes "died exactly at the
+#: barrier" from any ordinary failure
+CRASH_EXIT = 86
+
+#: the storage errno names an injected storage error may carry (the
+#: two real bad-disk signals the degradation machinery reacts to)
+STORAGE_ERRNOS = {"EIO": _errno.EIO, "ENOSPC": _errno.ENOSPC}
+
+#: the path classes / ops the storage seams consult — rule setters
+#: validate against these so a typo'd class can never arm an
+#: injecting-nothing nemesis (worse than a crash at arm time)
+STORAGE_CLASSES = ("wal", "ckpt", "tree")
+STORAGE_OPS = ("write", "fsync")
 
 
 def _key(src: Optional[str], dst: Optional[str]) -> Tuple[str, str]:
     return (str(src) if src is not None else "*",
             str(dst) if dst is not None else "*")
+
+
+def _check_class(path_class: str, wild: bool = False) -> None:
+    """Reject unknown storage path classes at RULE-SET time — the
+    seams look classes up exactly (torn/corrupt) or by candidate
+    list (errors), so a typo would arm a rule nothing consults."""
+    ok = STORAGE_CLASSES + (("*",) if wild else ())
+    if str(path_class) not in ok:
+        raise ValueError(
+            f"storage path class must be one of {ok}, "
+            f"not {path_class!r}")
 
 
 class FaultPlan:
@@ -87,6 +137,15 @@ class FaultPlan:
         self._reorder: Dict[Tuple[str, str], float] = {}
         self.fsync_ms = 0.0
         self.fsync_jitter_ms = 0.0
+        # -- storage rules (docs/ARCHITECTURE.md §15) ----------------
+        #: (path_class, op) -> [errno, remaining count or None];
+        #: op in ("write", "fsync"), "*" wildcards on either field
+        self._storage_err: Dict[Tuple[str, str], list] = {}
+        #: path_class -> byte offset; ONE-SHOT: the next write of the
+        #: class truncates there (a torn record) and fails
+        self._torn: Dict[str, int] = {}
+        #: path_class -> probability a store read flips one bit
+        self._corrupt: Dict[str, float] = {}
         # -- counters (monotonic; per-link under the same keys) ------
         self.dropped_frames = 0
         self.delayed_frames = 0
@@ -94,6 +153,9 @@ class FaultPlan:
         self.reordered_frames = 0
         self.fsync_delays = 0
         self.fsync_delay_injected_ms = 0.0
+        self.storage_errors_injected = 0
+        self.torn_writes_injected = 0
+        self.corrupt_reads_injected = 0
         self._per_link: Dict[Tuple[str, str], Dict[str, Any]] = {}
 
     # -- rule surface ------------------------------------------------------
@@ -148,6 +210,57 @@ class FaultPlan:
             self.fsync_jitter_ms = max(float(jitter_ms), 0.0)
         return self
 
+    def set_storage_error(self, path_class: str, op: str,
+                          err: str = "EIO",
+                          count: Optional[int] = None) -> "FaultPlan":
+        """Raise ``err`` (an errno name from :data:`STORAGE_ERRNOS`)
+        on every ``op`` ("write"/"fsync", ``"*"`` = both) touching
+        ``path_class`` ("wal"/"ckpt"/"tree", ``"*"`` = all).
+        ``count`` bounds the injections (None = until healed)."""
+        code = STORAGE_ERRNOS.get(str(err).upper())
+        if code is None:
+            raise ValueError(
+                f"storage fault errno must be one of "
+                f"{sorted(STORAGE_ERRNOS)}, not {err!r}")
+        _check_class(path_class, wild=True)
+        if str(op) not in STORAGE_OPS + ("*",):
+            raise ValueError(
+                f"storage fault op must be one of "
+                f"{STORAGE_OPS + ('*',)}, not {op!r}")
+        if count is not None and int(count) < 1:
+            raise ValueError(
+                f"storage fault count must be >= 1, not {count!r} "
+                "(a zero-count rule would arm an armed-but-"
+                "injecting-nothing nemesis forever)")
+        with self._lock:
+            self._storage_err[(str(path_class), str(op))] = [
+                code, None if count is None else int(count)]
+        return self
+
+    def set_torn_write(self, path_class: str,
+                       offset: int) -> "FaultPlan":
+        """Tear the NEXT write of ``path_class`` at byte ``offset``
+        (the prefix lands on disk, the rest vanishes, the writer sees
+        EIO) — one shot, consumed by the write it hits."""
+        _check_class(path_class)
+        with self._lock:
+            self._torn[str(path_class)] = max(0, int(offset))
+        return self
+
+    def set_read_corruption(self, path_class: str,
+                            prob: float) -> "FaultPlan":
+        """Flip one seeded-random bit in each ``path_class`` store
+        read with probability ``prob`` (0 removes the rule) — the
+        silent-disk-corruption mode every CRC/synctree gate must
+        catch, never serve."""
+        _check_class(path_class)
+        with self._lock:
+            if prob <= 0.0:
+                self._corrupt.pop(str(path_class), None)
+            else:
+                self._corrupt[str(path_class)] = min(float(prob), 1.0)
+        return self
+
     def heal(self) -> None:
         """Clear every rule; counters (the evidence) survive."""
         with self._lock:
@@ -156,12 +269,20 @@ class FaultPlan:
             self._reorder.clear()
             self.fsync_ms = 0.0
             self.fsync_jitter_ms = 0.0
+            self._storage_err.clear()
+            self._torn.clear()
+            self._corrupt.clear()
 
     def active(self) -> bool:
         with self._lock:
-            return bool(self._drop or self._rtt or self._reorder
-                        or self.fsync_ms > 0.0
-                        or self.fsync_jitter_ms > 0.0)
+            return self._active_locked()
+
+    def _active_locked(self) -> bool:
+        return bool(self._drop or self._rtt or self._reorder
+                    or self.fsync_ms > 0.0
+                    or self.fsync_jitter_ms > 0.0
+                    or self._storage_err or self._torn
+                    or self._corrupt)
 
     # -- query surface (the transports call these per frame) ---------------
 
@@ -250,6 +371,55 @@ class FaultPlan:
         if d > 0.0:
             time.sleep(d)
 
+    # -- storage query surface (the stores call these per access) -----------
+
+    def storage_error(self, path_class: str,
+                      op: str) -> Optional[OSError]:
+        """The OSError an armed storage rule injects for this access
+        (None = clean).  Counted; a bounded rule decrements and
+        self-removes at zero."""
+        with self._lock:
+            for k in ((path_class, op), (path_class, "*"),
+                      ("*", op), ("*", "*")):
+                rule = self._storage_err.get(k)
+                if rule is None:
+                    continue
+                code, remaining = rule
+                if remaining is not None:
+                    if remaining <= 0:
+                        continue
+                    rule[1] = remaining - 1
+                    if rule[1] <= 0:
+                        self._storage_err.pop(k, None)
+                self.storage_errors_injected += 1
+                return OSError(
+                    code, f"injected {_errno.errorcode[code]} on "
+                          f"{path_class} {op}")
+        return None
+
+    def torn_limit(self, path_class: str) -> Optional[int]:
+        """Byte offset the next write of ``path_class`` must tear at
+        (None = no rule).  One-shot: consumes the rule, counts."""
+        with self._lock:
+            off = self._torn.pop(str(path_class), None)
+            if off is not None:
+                self.torn_writes_injected += 1
+            return off
+
+    def corrupt_read(self, path_class: str, data: bytes) -> bytes:
+        """Maybe flip one seeded-random bit of ``data`` (per the
+        class's read-corruption probability); counted when it fires."""
+        with self._lock:
+            prob = self._corrupt.get(str(path_class))
+            if not data or prob is None or self._rng.random() >= prob:
+                return data
+            i = self._rng.randrange(len(data))
+            bit = 1 << self._rng.randrange(8)
+            self.corrupt_reads_injected += 1
+        out = bytearray(data)
+        out[i] ^= bit
+        return bytes(out)
+
     # -- observability -----------------------------------------------------
 
     def describe(self) -> Dict[str, Any]:
@@ -258,9 +428,7 @@ class FaultPlan:
         dump section, and the bench's embedded fault config."""
         with self._lock:
             return {
-                "active": bool(self._drop or self._rtt or self._reorder
-                               or self.fsync_ms > 0.0
-                               or self.fsync_jitter_ms > 0.0),
+                "active": self._active_locked(),
                 "silent": self.silent,
                 "seed": self.seed,
                 "drop": sorted(f"{s}>{d}" for s, d in self._drop),
@@ -270,6 +438,12 @@ class FaultPlan:
                             in sorted(self._reorder.items())},
                 "fsync_ms": self.fsync_ms,
                 "fsync_jitter_ms": self.fsync_jitter_ms,
+                "storage": {
+                    f"{c}.{o}": [_errno.errorcode.get(code, code), n]
+                    for (c, o), (code, n)
+                    in sorted(self._storage_err.items())},
+                "torn": dict(sorted(self._torn.items())),
+                "corrupt": dict(sorted(self._corrupt.items())),
                 "counters": self.counters(),
             }
 
@@ -282,6 +456,9 @@ class FaultPlan:
             "fsync_delays": self.fsync_delays,
             "fsync_delay_injected_ms": round(
                 self.fsync_delay_injected_ms, 3),
+            "storage_errors_injected": self.storage_errors_injected,
+            "torn_writes_injected": self.torn_writes_injected,
+            "corrupt_reads_injected": self.corrupt_reads_injected,
         }
 
     def link_injected(self, src: str, dst: str) -> Dict[str, Any]:
@@ -343,13 +520,60 @@ def _parse_links(spec: str, with_value: bool = False,
     return out
 
 
+def _parse_storage(spec: str):
+    """``"wal.fsync=ENOSPC,ckpt.write=EIO:2"`` →
+    [("wal", "fsync", "ENOSPC", None), ("ckpt", "write", "EIO", 2)].
+    A malformed entry raises loudly (same contract as
+    :func:`_parse_links`: an armed-but-injecting-nothing nemesis is
+    worse than a crash at arm time)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        pc_op, sep, err = part.partition("=")
+        cls, dot, op = pc_op.partition(".")
+        count = None
+        if ":" in err:
+            err, _, n = err.partition(":")
+            count = int(n)
+        if not sep or not dot or err.upper() not in STORAGE_ERRNOS:
+            raise ValueError(
+                f"RETPU_FAULT_STORAGE: entry {part!r} must be "
+                f"<class>.<op>=<{'|'.join(sorted(STORAGE_ERRNOS))}>"
+                f"[:count]")
+        out.append((cls.strip() or "*", op.strip() or "*",
+                    err.upper(), count))
+    return out
+
+
+def _parse_class_values(spec: str, knob: str, conv):
+    """``"wal:100,tree:0.5"`` → [("wal", conv("100")), ...]."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        cls, sep, v = part.partition(":")
+        try:
+            val = conv(v) if sep else None
+        except ValueError:
+            val = None
+        if val is None:
+            raise ValueError(
+                f"{knob}: entry {part!r} needs <class>:<value>")
+        out.append((cls.strip(), val))
+    return out
+
+
 def from_env(environ=None) -> Optional[FaultPlan]:
     """Build a plan from the environment fault knobs; None when no
     knob is set (the common case costs one dict scan at arm time)."""
     env = os.environ if environ is None else environ
     keys = ("RETPU_FAULT_DROP", "RETPU_FAULT_RTT_MS",
             "RETPU_FAULT_RTT_JITTER_MS", "RETPU_FAULT_REORDER",
-            "RETPU_FAULT_FSYNC_MS")
+            "RETPU_FAULT_FSYNC_MS", "RETPU_FAULT_STORAGE",
+            "RETPU_FAULT_TORN", "RETPU_FAULT_CORRUPT")
     if not any(env.get(k) for k in keys):
         return None
     p = FaultPlan(seed=int(env.get("RETPU_FAULT_SEED", "0") or 0),
@@ -377,6 +601,16 @@ def from_env(environ=None) -> Optional[FaultPlan]:
     fs = env.get("RETPU_FAULT_FSYNC_MS", "").strip()
     if fs:
         p.set_fsync_delay(float(fs))
+    for cls, op, err, count in _parse_storage(
+            env.get("RETPU_FAULT_STORAGE", "")):
+        p.set_storage_error(cls, op, err, count)
+    for cls, off in _parse_class_values(
+            env.get("RETPU_FAULT_TORN", ""), "RETPU_FAULT_TORN", int):
+        p.set_torn_write(cls, off)
+    for cls, prob in _parse_class_values(
+            env.get("RETPU_FAULT_CORRUPT", ""), "RETPU_FAULT_CORRUPT",
+            float):
+        p.set_read_corruption(cls, prob)
     return p
 
 
@@ -434,6 +668,77 @@ def fsync_sleep() -> None:
     p = active_plan()
     if p is not None:
         p.sleep_fsync()
+
+
+# -- storage seams (stores call these; no-ops without an armed plan) ----------
+
+def storage_raise(path_class: str, op: str) -> None:
+    """Raise the active plan's injected storage error for this
+    access, if any — the one call every store write/fsync path makes
+    (None plan short-circuits to a single function call)."""
+    p = active_plan()
+    if p is not None:
+        err = p.storage_error(path_class, op)
+        if err is not None:
+            raise err
+
+
+def torn_limit(path_class: str) -> Optional[int]:
+    """Byte offset the next write must tear at (None = whole write).
+    One-shot against the active plan."""
+    p = active_plan()
+    return p.torn_limit(path_class) if p is not None else None
+
+
+def read_filter(path_class: str, data: bytes) -> bytes:
+    """Pass store-read bytes through the active plan's bit-flip
+    corruption rule (identity without one)."""
+    p = active_plan()
+    return data if p is None else p.corrupt_read(path_class, data)
+
+
+# -- crash-point scheduler (docs/ARCHITECTURE.md §15) -------------------------
+
+#: hits per barrier name this process has seen (the nth selector's
+#: state; reading it from a test is fine, the process usually dies
+#: before anyone can)
+CRASHPOINT_HITS: Dict[str, int] = {}
+
+
+def crashpoint(name: str) -> None:
+    """A named durability barrier: when ``RETPU_CRASHPOINT`` names
+    this barrier (``<name>`` or ``<name>:<nth>``), the nth hit
+    terminates the process via ``os._exit(CRASH_EXIT)`` — no atexit,
+    no flushes, no cleanup beyond draining std streams, exactly the
+    kill -9 a recovery sweep aims at the barrier.  Unarmed (the
+    normal case) this is one env read per barrier crossing, orders
+    of magnitude under the fsync it sits next to."""
+    spec = os.environ.get("RETPU_CRASHPOINT", "")
+    if not spec:
+        return
+    target, _, nth = spec.partition(":")
+    if target != name:
+        return
+    try:
+        need = int(nth) if nth else 1
+    except ValueError:
+        # malformed nth: the first consumer is a durability barrier
+        # inside the serving loop (WAL lock held) — shout and disarm
+        # rather than raise there, the plan()-knob discipline
+        print("riak_ensemble_tpu.faults: IGNORING malformed "
+              f"RETPU_CRASHPOINT={spec!r} (bad :nth)",
+              file=sys.stderr, flush=True)
+        os.environ.pop("RETPU_CRASHPOINT", None)
+        return
+    hits = CRASHPOINT_HITS.get(name, 0) + 1
+    CRASHPOINT_HITS[name] = hits
+    if hits >= need:
+        try:
+            sys.stdout.flush()
+            sys.stderr.flush()
+        except Exception:  # noqa: BLE001 — dying anyway
+            pass
+        os._exit(CRASH_EXIT)
 
 
 # -- standing chaos: scheduled nemesis soaks ----------------------------------

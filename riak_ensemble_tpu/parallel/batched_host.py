@@ -75,6 +75,7 @@ docs/ARCHITECTURE.md §9 "Lease-protected reads".
 
 from __future__ import annotations
 
+import errno
 import functools
 import operator
 import os
@@ -911,6 +912,17 @@ class BatchedEnsembleService:
         #: one-time flag: a WAL-enabled service served device-resident
         #: execute() calls (which skip the WAL — see execute())
         self._dev_exec_unlogged = False
+        #: graceful storage degradation (docs/ARCHITECTURE.md §15):
+        #: an EIO/ENOSPC surfacing from the WAL's durability barrier
+        #: flips the service READ-ONLY (writes fail fast at enqueue,
+        #: reads keep serving) instead of crashing the serving loop —
+        #: the decision record lands here, in health()["storage"],
+        #: in a trace event and in the retpu_recovery_* gauges.  A
+        #: replicated leader additionally steps down through the
+        #: group's existing depose machinery (repgroup override).
+        self._storage_degraded: Optional[Dict[str, Any]] = None
+        #: WAL OSErrors observed on the ack path (monotonic evidence)
+        self.wal_storage_errors = 0
         if data_dir is not None:
             from riak_ensemble_tpu import save as savelib
             from riak_ensemble_tpu.parallel.wal import ServiceWAL
@@ -2553,8 +2565,10 @@ class BatchedEnsembleService:
             "ens_names": self._ens_names,
         }
         savelib.write(os.path.join(d, "host"),
-                      pickle.dumps(host, protocol=4))
-        savelib.write(os.path.join(path, "CURRENT"), str(n).encode())
+                      pickle.dumps(host, protocol=4),
+                      crash_class="ckpt")
+        savelib.write(os.path.join(path, "CURRENT"), str(n).encode(),
+                      crash_class="ckpt")
         # Old checkpoints are garbage once CURRENT moved (best effort).
         import shutil
         for name in os.listdir(path):
@@ -2951,6 +2965,14 @@ class BatchedEnsembleService:
                     pw[s] += 1
             else:
                 self._note_write(ens, op.slot)
+            if self._storage_degraded is not None:
+                # read-only degradation (ARCHITECTURE §15): the WAL
+                # cannot take the durability barrier, so no write may
+                # queue toward an ack.  The entry fails through the
+                # normal path (notes just taken are un-noted, handles
+                # released, slots recycled); reads flow on.
+                self._fail_entry(ens, op)
+                return
             if self._obs and op.kind in (eng.OP_PUT, eng.OP_CAS):
                 self._obs_note_put_bytes(
                     ens, op.handle if isinstance(op, _PendingBatch)
@@ -3694,6 +3716,8 @@ class BatchedEnsembleService:
     def stats(self) -> Dict[str, Any]:
         """Observability snapshot (the get_info/count_quorum analog
         for the scale path)."""
+        wal_stats = (self._wal.stats() if self._wal is not None
+                     else None)
         return {
             "flushes": self.flushes,
             "ops_served": self.ops_served,
@@ -3734,6 +3758,13 @@ class BatchedEnsembleService:
                 "last_ms": round(self.wal_compaction_ms_last, 3),
                 "total_ms": round(self.wal_compaction_ms_total, 3),
             },
+            # storage-recovery plane (ARCHITECTURE §15): the WAL
+            # store's corruption-handling evidence plus the
+            # degradation decision — same payload as health().  One
+            # stats() call feeds both keys: each takes the WAL lock,
+            # which the flush path holds across the fsync barrier
+            "storage": self._storage_health_section(wal_stats),
+            "wal": wal_stats,
             # observability plane (docs/ARCHITECTURE.md §11): the
             # full registry exports via the svcnode `metrics` verb;
             # stats() carries the headline plus per-tenant
@@ -3862,6 +3893,10 @@ class BatchedEnsembleService:
             # so a dashboard's queries keep their shape when the
             # controller arms, the fault-gauge discipline
             "controller": self.controller.health_section(),
+            # storage-recovery plane (ARCHITECTURE §15): always
+            # present (degraded: false on a healthy disk) — same
+            # constant-shape discipline as the controller section
+            "storage": self._storage_health_section(),
         }
         if fp is not None:
             # active fault-injection plan (docs/ARCHITECTURE.md §13):
@@ -3871,6 +3906,30 @@ class BatchedEnsembleService:
             # no plan is armed — a clean box shows a clean verb.
             out["injected"] = fp.describe()
         return out
+
+    def _storage_health_section(self, wal_stats: Optional[Dict[str,
+                                Any]] = None) -> Dict[str, Any]:
+        """The health verb's storage-recovery section (§15): the
+        degradation decision (or its absence), WAL error counts and
+        the store's corruption-handling evidence — constant shape so
+        dashboard queries survive a disk incident arming it.
+        ``wal_stats`` lets a caller that already paid the WAL-lock
+        round (stats()) pass it in; the default path reads the
+        LOCK-FREE evidence counters so a health scrape never blocks
+        behind a flush's fsync barrier."""
+        if wal_stats is None:
+            wal_stats = (self._wal.evidence()
+                         if self._wal is not None else {})
+        wal_stats = wal_stats or {}
+        return {
+            "degraded": self._storage_degraded is not None,
+            "mode": (self._storage_degraded or {}).get("mode"),
+            "reason": (self._storage_degraded or {}).get("errno"),
+            "at_flush": (self._storage_degraded or {}).get("at_flush"),
+            "wal_errors": int(self.wal_storage_errors),
+            "wal_quarantines": int(wal_stats.get("quarantines", 0)),
+            "wal_truncations": int(wal_stats.get("truncations", 0)),
+        }
 
     # -- observability plane (docs/ARCHITECTURE.md §11) ---------------------
 
@@ -3945,6 +4004,30 @@ class BatchedEnsembleService:
             "retpu_fault_fsync_delay_injected_ms_total": fam(
                 "counter", "total injected fsync delay",
                 c.get("fsync_delay_injected_ms", 0.0)),
+            # storage fault plane + recovery evidence (§15): same
+            # always-registered discipline — zeros on a clean box
+            "retpu_fault_storage_errors_total": fam(
+                "counter", "injected EIO/ENOSPC storage errors "
+                "raised on write/fsync paths",
+                c.get("storage_errors_injected", 0)),
+            "retpu_fault_torn_writes_total": fam(
+                "counter", "injected torn (truncated mid-record) "
+                "writes", c.get("torn_writes_injected", 0)),
+            "retpu_fault_corrupt_reads_total": fam(
+                "counter", "injected bit-flip read corruptions",
+                c.get("corrupt_reads_injected", 0)),
+            "retpu_recovery_degraded": fam(
+                "gauge", "1 while the service is storage-degraded "
+                "(read-only / stepped down after WAL EIO/ENOSPC)",
+                int(self._storage_degraded is not None)),
+            "retpu_recovery_wal_errors_total": fam(
+                "counter", "WAL OSErrors observed on the ack path",
+                self.wal_storage_errors),
+            "retpu_recovery_wal_quarantined_total": fam(
+                "counter", "unreplayable WAL logs quarantined aside "
+                "(.corrupt.<n>)",
+                (self._wal.evidence().get("quarantines", 0)
+                 if self._wal is not None else 0)),
         }
 
     def _flight_extras(self) -> Dict[str, Any]:
@@ -4619,6 +4702,16 @@ class BatchedEnsembleService:
         if ((kind == eng.OP_PUT) & (val < 0)).any():
             raise ValueError("negative put payloads are not encodable "
                              "(int32 handles; 0 = tombstone/delete)")
+        if (self._wal is not None
+                and self._storage_degraded is not None
+                and (((kind == eng.OP_PUT) | (kind == eng.OP_CAS)
+                      | (kind == eng.OP_RMW))).any()):
+            # read-only (§15): on this path the RESULT is the ack,
+            # so a degraded service must refuse before the launch —
+            # it cannot make the writes durable
+            raise OSError(
+                errno.EIO, "service is read-only (storage degraded): "
+                "execute() writes cannot be made durable")
         k = int(kind.shape[0])
         slot = np.asarray(slot, np.int32)
         want_vsn = self._wal is not None
@@ -5029,7 +5122,12 @@ class BatchedEnsembleService:
         paths: WAL compaction past the record bound, and the periodic
         scrub against its flush-count watermark."""
         if (self._wal is not None and not self._in_save
+                and self._storage_degraded is None
                 and self._wal.count >= self.wal_compact_records):
+            # degraded gate: a read-only service must never compact —
+            # save() would write the same dead/full disk and the
+            # OSError would crash the flush loop the degradation
+            # exists to protect (reads must keep serving)
             # WAL grew past the compaction bound: fold it into a fresh
             # checkpoint (save() rotates the generation) — but OFF the
             # hot path.  save() is a full checkpoint (hundreds of ms);
@@ -5093,24 +5191,99 @@ class BatchedEnsembleService:
         got 'failed' — the allowed unacked-commit outcome — but the
         device/host bookkeeping stands), so later launches keep
         settling normally (abandoning them would release handles and
-        recycle slots the device still populates); the first disk
-        error re-raises to the flush driver after the drain."""
+        recycle slots the device still populates); after the drain,
+        a fatal-disk errno (EIO/ENOSPC) degrades the service to
+        read-only (§15) while any other disk error re-raises to the
+        flush driver."""
         served = 0
         wal_err: Optional[BaseException] = None
+        fatal_err: Optional[BaseException] = None
         while len(self._inflight_launches) > keep:
             fl = self._inflight_launches.popleft()
             try:
                 n, err = self._settle_launch(fl)
                 served += n
-                if err is not None and wal_err is None:
-                    wal_err = err
+                if err is not None:
+                    if wal_err is None:
+                        wal_err = err
+                    if isinstance(err, OSError):
+                        self.wal_storage_errors += 1
+                        # the fatal bad-disk signal may arrive on a
+                        # LATER launch than the first (non-fatal)
+                        # error of the drain — it must still win the
+                        # degrade decision below, not be masked
+                        if (fatal_err is None
+                                and getattr(err, "errno", None)
+                                in (errno.EIO, errno.ENOSPC)):
+                            fatal_err = err
             except BaseException:
                 while self._inflight_launches:
                     self._abandon_launch(self._inflight_launches.popleft())
                 raise
-        if wal_err is not None:
+        if fatal_err is not None:
+            # a dead/full disk under the WAL: degrade to read-only
+            # (journaled, observable) instead of crashing the
+            # serving loop — ARCHITECTURE §15
+            self._degrade_storage("wal", fatal_err)
+        elif wal_err is not None:
+            # other disk errors keep the historical
+            # raise-to-driver contract
             raise wal_err
         return served
+
+    def _degrade_storage(self, plane: str, exc: BaseException) -> None:
+        """Flip the service read-only after a fatal storage error on
+        the ack path (EIO/ENOSPC under the WAL): subsequent writes
+        fail fast at enqueue, reads keep serving, and the decision is
+        journaled — a trace event, the health()["storage"] section,
+        and the retpu_recovery_* gauges (ARCHITECTURE §15).  Recovery
+        is a restart: restore() replays the WAL onto a healthy disk.
+        Idempotent; the first error wins the record."""
+        if self._storage_degraded is not None:
+            return
+        code = getattr(exc, "errno", None)
+        self._storage_degraded = {
+            "plane": plane,
+            "mode": "read_only",
+            "errno": errno.errorcode.get(code, str(code)),
+            "error": repr(exc)[:200],
+            "at_flush": int(self.flushes),
+        }
+        # the read-only contract covers writes ALREADY QUEUED too:
+        # left in place they would flush later, and if the disk
+        # flickered back they would WAL-log and ack from a
+        # "read_only" service — onto a log whose fate the degrade
+        # already distrusts (review r15).  Fail them now through the
+        # normal release/recycle path; reads stay queued.
+        self._fail_queued_writes()
+        # subclass hook next: a replicated leader demotes itself
+        # through the group's step-down machinery (repgroup
+        # override, which rewrites mode to "step_down") — the
+        # journaled decision below must record what actually
+        # happened, not the base default
+        self._on_storage_degraded()
+        self._emit("svc_storage_degraded",
+                   dict(self._storage_degraded))
+
+    def _fail_queued_writes(self) -> None:
+        """Fail every queued write entry (scalar or batch), keeping
+        queued reads — the enqueue half of flipping read-only."""
+        for e in list(self._active):
+            q = self.queues[e]
+            drop = [op for op in q if op.kind != eng.OP_GET]
+            if not drop:
+                continue
+            keep = [op for op in q if op.kind == eng.OP_GET]
+            self.queues[e] = keep
+            self._queue_rounds[e] = sum(op.n for op in keep)
+            for op in drop:
+                self._fail_entry(e, op)
+
+    def _on_storage_degraded(self) -> None:
+        """Subclass seam: called once when the storage plane
+        degrades.  The base service has no leadership to shed beyond
+        the per-row device ballots (reads stay served; the row
+        leaders are device state, not a group role)."""
 
     def _abandon_launch(self, fl: _InFlightLaunch) -> None:
         """Fail a poisoned in-flight launch's clients (launch N < this
@@ -5130,7 +5303,8 @@ class BatchedEnsembleService:
         (ops served, wal error or None) — a WAL failure is reported,
         not raised, so the drain can keep settling later launches
         whose device commits are independent of this one's disk
-        error (see :meth:`_drain_launches`)."""
+        error; the drain then degrades or re-raises per the errno
+        (see :meth:`_drain_launches`)."""
         rec = fl.rec
         wait_key = ("inflight_wait" if self.pipeline_depth > 1
                     else "device_d2h")
@@ -5148,18 +5322,26 @@ class BatchedEnsembleService:
         # the WAL write itself fails, the commits stand on device (the
         # bookkeeping proceeds) but their clients get 'failed' — an
         # unacked commit is an allowed linearizable outcome; a lost
-        # acked one is not — and the disk error propagates to the
-        # flush driver.
+        # acked one is not — and the drain either degrades the
+        # service (fatal EIO/ENOSPC, §15) or re-raises to the flush
+        # driver.
         wal_err: Optional[BaseException] = None
+        # a degraded (read-only) service must not WAL-log or ack
+        # in-flight writes either — if the disk flickered back the
+        # append could succeed and ack from a service whose log tail
+        # the degrade already distrusts (review r15); their reads
+        # still serve (ack=False spares reads by design)
+        degraded = self._storage_degraded is not None
         t_wal = time.perf_counter()
-        if self._wal is not None:
+        if self._wal is not None and not degraded:
             try:
                 self._log_wal(taken, planes, rec=rec)
             except Exception as exc:
                 wal_err = exc
         t_res = time.perf_counter()
         served = self._resolve_flush(taken, planes,
-                                     ack=wal_err is None,
+                                     ack=wal_err is None
+                                     and not degraded,
                                      op_planes=(fl.kind_np,
                                                 fl.op_slot_np),
                                      rec=rec, fid=fl.flush_id,
@@ -5193,6 +5375,12 @@ class BatchedEnsembleService:
         settles proceed."""
         committed, get_ok, found, value, vsn = planes
         if fl.exec_wal is not None and self._wal is not None:
+            if self._storage_degraded is not None:
+                # read-only: the commit may be real on device, but
+                # no ack may ride a distrusted log (see
+                # _settle_launch's degraded gate)
+                self._safe_resolve(fl.exec_fut, "failed")
+                return 0, None
             kind, slot, val = fl.exec_wal
             try:
                 self._log_execute_wal(kind, slot, val, committed, vsn,

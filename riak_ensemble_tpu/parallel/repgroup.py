@@ -1099,6 +1099,9 @@ class ReplicaCore:
                 svc.save()
                 save_group_meta(svc, self.promised, self.applied_ge,
                                 self.applied_seq, self.cfg)
+        # §15 crash barrier: the run is durable, the ack is not yet
+        # on the wire — the classic replica-crash recovery point
+        faults.crashpoint("replica_apply_pre_ack")
         if svc._obs:
             # replica half of the cross-process flush trace: every
             # entry's spans record under the LEADER's flush id (the
@@ -1200,6 +1203,8 @@ class ReplicaCore:
                 # into a quorum while the new-epoch leader commits
                 # elsewhere (review r4: split-brain via compaction).
                 save_group_meta(svc, self.promised, ge, seq, self.cfg)
+        # §15 crash barrier: batch durable, ack not yet on the wire
+        faults.crashpoint("replica_apply_pre_ack")
         if svc._obs:
             # the full-plane fallback's replica trace: one re-executed
             # launch, so "apply" covers the whole device round + local
@@ -3487,6 +3492,26 @@ class ReplicatedService(BatchedEnsembleService):
         # ack — no leased read may outlive the observed fencing
         self._host_lease_until = 0.0
         self.core.promised = max(self.core.promised, promised)
+
+    def _on_storage_degraded(self) -> None:
+        """A leader whose WAL disk died cannot take the durability
+        barrier its acks promise — demote it through the existing
+        step-down machinery (ARCHITECTURE §15): leadership drops, the
+        host lease dies before any further ack, and a peer with a
+        working disk can promote itself.  The decision is journaled
+        (grp_step_down trace event, group_stats, and the base
+        svc_storage_degraded record/health section/gauges)."""
+        if self._is_leader:
+            if self._storage_degraded is not None:
+                self._storage_degraded["mode"] = "step_down"
+            self._is_leader = False
+            self._deposed = True
+            self._host_lease_until = 0.0
+            self.group_stats["storage_step_downs"] = \
+                self.group_stats.get("storage_step_downs", 0) + 1
+            self._emit("grp_step_down", {
+                "reason": "wal-storage",
+                "errno": (self._storage_degraded or {}).get("errno")})
 
     # -- replicated dynamic lifecycle ---------------------------------------
 

@@ -27,6 +27,7 @@ is no in-place truncate to get wrong.
 
 from __future__ import annotations
 
+import errno as _errno
 import os
 import pickle
 import struct
@@ -34,6 +35,7 @@ import zlib
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from riak_ensemble_tpu import faults
+from riak_ensemble_tpu.save import fsync_dir
 
 #: sync modes: "fsync" forces records to stable storage before the ack
 #: (power-loss safe — the basic_backend put contract); "buffer" writes
@@ -58,6 +60,23 @@ class PyLogStore:
     def __init__(self, path: str) -> None:
         self.path = path
         self._map: Dict[bytes, bytes] = {}
+        #: corruption evidence counters (stats(): a detected-but-
+        #: handled bad disk must be observable, never silent)
+        self.quarantines = 0
+        self.truncations = 0
+        self.truncated_bytes = 0
+        #: CRC-failed frames that were re-read (a retry that passes
+        #: is a healed transient read error, not a torn tail)
+        self.read_retries = 0
+        #: failed appends whose partial frame was truncated back to
+        #: the frame boundary (review r15: a surviving writer must
+        #: repair the tail, or later fsync-acked appends land after
+        #: the tear and are destroyed at the next replay)
+        self.append_repairs = 0
+        #: a failed append whose REPAIR also failed leaves the tail
+        #: unknown — every further append must fail fast rather than
+        #: write records replay may never reach
+        self._tail_unknown = False
         good = self._replay()
         if good is not None:
             # Truncate the torn/corrupt tail BEFORE appending: records
@@ -65,9 +84,31 @@ class PyLogStore:
             # future replay — acked writes silently lost on the second
             # crash (the replay correctly stops at the tear, so the
             # bytes past `good` were never acked data we could keep).
+            self.truncations += 1
+            self.truncated_bytes += max(
+                0, os.path.getsize(self.path) - good)
             with open(self.path, "r+b") as f:
                 f.truncate(good)
+        # checked AFTER replay: a quarantine moved the old log aside,
+        # so the append handle below creates a genuinely new file
+        existed = os.path.exists(path)
         self._f = open(path, "ab")
+        if not existed:
+            # a crash may keep the rename/creat un-durable without a
+            # directory fsync (ext4/xfs); a lost wal FILE would read
+            # as "no records" — silent loss of every fsync-acked write
+            fsync_dir(os.path.dirname(path) or ".")
+
+    def _quarantine(self) -> None:
+        """Move the unreplayable log aside for forensics WITHOUT
+        clobbering earlier evidence: monotonic ``.corrupt.<n>``
+        suffixes (a second corruption used to overwrite the first)."""
+        n = 0
+        while os.path.exists(f"{self.path}.corrupt.{n}"):
+            n += 1
+        os.replace(self.path, f"{self.path}.corrupt.{n}")
+        fsync_dir(os.path.dirname(self.path) or ".")
+        self.quarantines += 1
 
     def _replay(self) -> Optional[int]:
         """Rebuild the map from the log.  Returns the byte offset of
@@ -87,7 +128,7 @@ class PyLogStore:
                 # record too.  Preserve the bytes for forensics and
                 # start a fresh log.
                 f.close()
-                os.replace(self.path, self.path + ".corrupt")
+                self._quarantine()
                 return None
             off = 4
             while True:
@@ -95,9 +136,20 @@ class PyLogStore:
                 if len(head) < 8:
                     return off if head else None
                 crc, ln = struct.unpack(">II", head)
-                body = f.read(ln)
+                body = faults.read_filter("wal", f.read(ln))
+                if len(body) == ln and zlib.crc32(body) != crc:
+                    # CRC mismatch on a FULL frame: re-read the frame
+                    # FROM DISK once before believing it — a
+                    # transient bad read (bus/memory, or the injected
+                    # bit flip) heals on a real re-read, true on-disk
+                    # damage does not.  Without this, a transient
+                    # flip would be "repaired" by truncating HEALTHY
+                    # fsync-acked frames behind it (review r15).
+                    self.read_retries += 1
+                    f.seek(off + 8)
+                    body = faults.read_filter("wal", f.read(ln))
                 if len(body) < ln or zlib.crc32(body) != crc or ln < 5:
-                    return off  # torn tail
+                    return off  # torn/corrupt tail
                 op = body[0]
                 klen = struct.unpack(">I", body[1:5])[0]
                 if 5 + klen > ln:
@@ -112,11 +164,52 @@ class PyLogStore:
                 off += 8 + ln
 
     def _append(self, op: int, key: bytes, val: bytes) -> None:
+        if self._tail_unknown:
+            raise OSError(
+                _errno.EIO,
+                "WAL tail unknown after an unrepaired failed append; "
+                "refusing to write records replay may never reach")
+        faults.storage_raise("wal", "write")
         if self._f.tell() == 0:
             self._f.write(self._MAGIC)
         body = bytes([op]) + struct.pack(">I", len(key)) + key + val
-        self._f.write(struct.pack(">II", zlib.crc32(body), len(body))
-                      + body)
+        frame = struct.pack(">II", zlib.crc32(body), len(body)) + body
+        start = self._f.tell()
+        cut = faults.torn_limit("wal")
+        if cut is not None:
+            # torn write: the prefix reaches the disk and the writer
+            # SEES the failure — so it must repair the frame
+            # boundary before any later append, or those later
+            # (fsync-acked!) records land after the tear and the
+            # next replay's truncate-at-tear destroys them (review
+            # r15).  Crash-mid-write tears — where no repair can run
+            # — are the crash-point and replay-fuzz tests' domain.
+            self._f.write(frame[:min(cut, max(0, len(frame) - 1))])
+            self._f.flush()
+            self._repair_tail(start)
+            raise OSError(_errno.EIO,
+                          f"injected torn WAL write at byte {cut}")
+        try:
+            self._f.write(frame)
+        except OSError:
+            self._repair_tail(start)
+            raise
+
+    def _repair_tail(self, start: int) -> None:
+        """Truncate a partial frame back to its start; a repair that
+        itself fails poisons the store (fail-fast appends)."""
+        try:
+            self._f.truncate(start)
+            # truncate() does NOT move the buffered stream position,
+            # and O_APPEND writes ignore it — but tell() would keep
+            # reporting the pre-repair offset, so the NEXT failed
+            # append would repair at a stale `start`, zero-padding a
+            # hole that destroys later fsync-acked records at replay
+            # (review r15, reproduced).  Re-anchor at the real EOF.
+            self._f.seek(0, os.SEEK_END)
+            self.append_repairs += 1
+        except OSError:
+            self._tail_unknown = True
 
     def store(self, key: Any, value: Any) -> None:
         k, v = pickle.dumps(key, protocol=4), pickle.dumps(value,
@@ -148,6 +241,7 @@ class PyLogStore:
 
     def sync(self) -> None:
         self._f.flush()
+        faults.storage_raise("wal", "fsync")
         os.fsync(self._f.fileno())
 
     def flush(self) -> None:
@@ -163,11 +257,15 @@ class PyLogStore:
 
 
 def _open_store(path: str):
-    """Native treestore when buildable, Python log otherwise."""
+    """Native treestore when buildable, Python log otherwise.  Either
+    way the store consults the ``wal`` storage-fault class (the
+    native backend defaults to ``tree`` for synctree use)."""
     from riak_ensemble_tpu.synctree import native_store
 
     if native_store.available():
-        return native_store.NativeBackend(path)
+        st = native_store.NativeBackend(path)
+        st.fault_class = "wal"
+        return st
     return PyLogStore(path)
 
 
@@ -187,6 +285,10 @@ class ServiceWAL:
     def __init__(self, dir_path: str, sync_mode: str = "fsync") -> None:
         assert sync_mode in SYNC_MODES, sync_mode
         os.makedirs(dir_path, exist_ok=True)
+        # a freshly-created generation directory must itself survive a
+        # crash: fsync the parent so ``wal.<n>`` is reachable after
+        # power loss (rename/mkdir alone is not durable on ext4/xfs)
+        fsync_dir(os.path.dirname(dir_path) or ".")
         self.dir_path = dir_path
         self.sync_mode = sync_mode
         self._store = _open_store(os.path.join(dir_path, "wal"))
@@ -208,11 +310,14 @@ class ServiceWAL:
         """Append a batch and make it durable per the sync mode.  MUST
         complete before the writes it covers are acked."""
         with self._lock:
+            faults.crashpoint("wal_append")
             for key, value in records:
                 self._store.store(key, value)
             if self.sync_mode == "fsync":
+                faults.crashpoint("wal_fsync_pre")
                 self.sync_hook()
                 self._store.sync()
+                faults.crashpoint("wal_fsync_post")
             else:
                 # buffer mode promises PROCESS-crash safety: the
                 # records must at least reach the kernel before the
@@ -235,6 +340,7 @@ class ServiceWAL:
         are byte-identical to ``log()`` of the decoded records (the
         native/fallback equivalence contract)."""
         with self._lock:
+            faults.crashpoint("wal_append")
             st = self._store
             put_many = getattr(st, "put_many_raw", None)
             if put_many is not None:
@@ -246,8 +352,10 @@ class ServiceWAL:
             for key, value in extra_records:
                 st.store(key, value)
             if self.sync_mode == "fsync":
+                faults.crashpoint("wal_fsync_pre")
                 self.sync_hook()
                 self._store.sync()
+                faults.crashpoint("wal_fsync_post")
             else:
                 self._flush_store()
 
@@ -255,11 +363,14 @@ class ServiceWAL:
         """Remove records (e.g. a destroyed ensemble's kv entries)
         with the same durability barrier as :meth:`log`."""
         with self._lock:
+            faults.crashpoint("wal_append")
             for key in keys:
                 self._store.delete(key)
             if self.sync_mode == "fsync":
+                faults.crashpoint("wal_fsync_pre")
                 self.sync_hook()
                 self._store.sync()
+                faults.crashpoint("wal_fsync_post")
             else:
                 # Mirror log(): buffer mode still promises
                 # process-crash durability, and a destroy's kv
@@ -277,6 +388,33 @@ class ServiceWAL:
     def count(self) -> int:
         with self._lock:
             return self._store.count()
+
+    def evidence(self) -> Dict[str, Any]:
+        """LOCK-FREE read of the store's corruption-handling
+        counters (monotonic plain ints, set at open time) — for the
+        health/metrics scrape paths, which must never block behind a
+        flush holding the lock across a slow fsync."""
+        st = self._store
+        return {
+            "quarantines": int(getattr(st, "quarantines", 0)),
+            "truncations": int(getattr(st, "truncations", 0)),
+            "truncated_bytes": int(getattr(st, "truncated_bytes", 0)),
+            "read_retries": int(getattr(st, "read_retries", 0)),
+            "append_repairs": int(getattr(st, "append_repairs", 0)),
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        """Durability-evidence snapshot: record depth plus the
+        store's corruption-handling counters (quarantined logs, torn-
+        tail truncations) — what "the bad disk was detected, not
+        served" looks like from stats()/health()."""
+        with self._lock:
+            records = self._store.count()
+        return {
+            "records": records,
+            "sync_mode": self.sync_mode,
+            **self.evidence(),
+        }
 
     def close(self) -> None:
         self._store.close()

@@ -15,6 +15,9 @@ import pickle
 import zlib
 from typing import Any, Dict, Iterable, Tuple
 
+from riak_ensemble_tpu import faults
+from riak_ensemble_tpu.save import fsync_dir
+
 
 class DictBackend:
     """synctree_ets/synctree_orddict equivalent."""
@@ -46,12 +49,25 @@ class FileBackend(DictBackend):
     trees) is handled by the caller via key prefixing.
     """
 
+    #: storage fault-plane path class (docs/ARCHITECTURE.md §15)
+    fault_class = "tree"
+
     def __init__(self, path: str) -> None:
         super().__init__()
         self.path = path
+        #: CRC-detected replay stops (corrupt/torn frames dropped,
+        #: never served) — the detection evidence counter
+        self.truncations = 0
+        self.truncated_bytes = 0
+        #: CRC-failed frames healed by a one-shot re-read (transient
+        #: read corruption, not on-disk damage)
+        self.read_retries = 0
         os.makedirs(os.path.dirname(path), exist_ok=True)
+        existed = os.path.exists(path)
         self._replay()
         self._fh = open(self.path, "ab")
+        if not existed:
+            fsync_dir(os.path.dirname(path))
 
     def _replay(self) -> None:
         if not os.path.exists(self.path):
@@ -62,9 +78,27 @@ class FileBackend(DictBackend):
         while pos + 8 <= len(raw):
             size = int.from_bytes(raw[pos:pos + 4], "big")
             crc = int.from_bytes(raw[pos + 4:pos + 8], "big")
-            frame = raw[pos + 8:pos + 8 + size]
+            frame = faults.read_filter(self.fault_class,
+                                       raw[pos + 8:pos + 8 + size])
+            if len(frame) == size \
+                    and (zlib.crc32(frame) & 0xFFFFFFFF) != crc:
+                # one-shot re-read FROM DISK before trusting the
+                # mismatch: a transient bad read heals on a real
+                # retry; dropping the tail on it would discard
+                # healthy on-disk frames behind it (review r15)
+                self.read_retries += 1
+                with open(self.path, "rb") as rf:
+                    rf.seek(pos + 8)
+                    frame = faults.read_filter(self.fault_class,
+                                               rf.read(size))
             if len(frame) < size or (zlib.crc32(frame) & 0xFFFFFFFF) != crc:
-                break  # torn tail write: stop replay here
+                # torn tail write or corrupt frame: DETECTED by the
+                # CRC gate — stop replay here (count the evidence;
+                # what was dropped heals from a live replica via the
+                # exchange path, it is never served)
+                self.truncations += 1
+                self.truncated_bytes += len(raw) - pos
+                break
             op, key, value = pickle.loads(frame)
             if op == "put":
                 self.data[key] = value
@@ -79,6 +113,7 @@ class FileBackend(DictBackend):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
+        fsync_dir(os.path.dirname(self.path))
 
     @staticmethod
     def _frame(record: Tuple) -> bytes:
@@ -87,15 +122,19 @@ class FileBackend(DictBackend):
         return len(blob).to_bytes(4, "big") + crc.to_bytes(4, "big") + blob
 
     def store(self, key, value) -> None:
+        faults.storage_raise(self.fault_class, "write")
         super().store(key, value)
         self._fh.write(self._frame(("put", key, value)))
 
     def delete(self, key) -> None:
+        faults.storage_raise(self.fault_class, "write")
         super().delete(key)
         self._fh.write(self._frame(("del", key, None)))
 
     def sync(self) -> None:
         self._fh.flush()
+        faults.crashpoint("tree_save")
+        faults.storage_raise(self.fault_class, "fsync")
         os.fsync(self._fh.fileno())
 
     def close(self) -> None:

@@ -17,11 +17,33 @@ import ctypes
 import pickle
 from typing import Any, Iterable, List
 
+from riak_ensemble_tpu import faults
 from riak_ensemble_tpu.utils import native
 
 
 def available() -> bool:
     return native.load() is not None
+
+
+_corrupt_warned = False
+
+
+def _storage_faults(fault_class: str, op: str) -> None:
+    """The write-path seam of the §15 storage fault plane for the C
+    engine: injected EIO/ENOSPC raise exactly like the Python
+    stores'; a torn-write rule degrades to an ERROR-ONLY injection
+    (the engine owns its file handles, so Python cannot leave a
+    physically torn frame — the write still fails and the rule is
+    consumed, keeping an armed nemesis from injecting nothing)."""
+    faults.storage_raise(fault_class, op)
+    if op == "write":
+        cut = faults.torn_limit(fault_class)
+        if cut is not None:
+            import errno as _errno
+            raise OSError(
+                _errno.EIO,
+                f"injected torn write (native {fault_class} store: "
+                f"error-only, no partial frame)")
 
 
 def _enc(term: Any) -> bytes:
@@ -36,6 +58,11 @@ class NativeBackend:
     """Implements the synctree storage interface
     (fetch/exists/store/delete/keys) over the C++ engine."""
 
+    #: storage fault-plane path class (docs/ARCHITECTURE.md §15);
+    #: the WAL's ``_open_store`` rebinds it to ``"wal"`` — the engine
+    #: serves both roles and the injection must track the role
+    fault_class = "tree"
+
     def __init__(self, path: str) -> None:
         lib = native.load()
         if lib is None:
@@ -46,6 +73,22 @@ class NativeBackend:
         if not self._handle:
             raise RuntimeError(f"cannot open treestore at {path}")
         self.path = path
+        # the §15 read-corruption knob cannot reach the C engine's
+        # replay reads (its CRC gate runs in C; Python filtering the
+        # DECODED value would corrupt without detection — worse).
+        # An armed-but-inert rule must at least be loud: on-disk
+        # byte flips are the native corruption harness instead.
+        global _corrupt_warned
+        p = faults.active_plan()
+        if (not _corrupt_warned and p is not None
+                and p.describe().get("corrupt")):
+            _corrupt_warned = True
+            import sys
+            print("riak_ensemble_tpu.native_store: "
+                  "RETPU_FAULT_CORRUPT does not reach the C "
+                  "engine's replay reads (corrupt native store "
+                  "files on disk instead; the C CRC gate covers "
+                  "that path)", file=sys.stderr, flush=True)
 
     # -- backend interface ------------------------------------------------
 
@@ -66,12 +109,14 @@ class NativeBackend:
                                          None, 0) >= 0
 
     def store(self, key, value) -> None:
+        _storage_faults(self.fault_class, "write")
         k, v = _enc(key), _enc(value)
         self._lib.retpu_store_put(self._handle, k, len(k), v, len(v))
 
     def store_raw(self, k: bytes, v: bytes) -> None:
         """Pre-pickled record append (the resolve kernel's arena
         path): skips the Python-side encode, identical framing."""
+        _storage_faults(self.fault_class, "write")
         self._lib.retpu_store_put(self._handle, k, len(k), v, len(v))
 
     def put_many_raw(self, arena, index) -> None:
@@ -81,6 +126,7 @@ class NativeBackend:
         path.  Falls back to per-record puts on a stale .so without
         the batch symbol."""
         import numpy as np
+        _storage_faults(self.fault_class, "write")
         if not hasattr(self._lib, "retpu_store_put_many"):
             a = np.ascontiguousarray(arena, np.uint8)
             for koff, klen, voff, vlen in np.asarray(index).tolist():
@@ -95,6 +141,7 @@ class NativeBackend:
             idx.ctypes.data_as(ctypes.c_void_p), len(idx))
 
     def delete(self, key) -> None:
+        _storage_faults(self.fault_class, "write")
         k = _enc(key)
         self._lib.retpu_store_delete(self._handle, k, len(k))
 
@@ -116,6 +163,11 @@ class NativeBackend:
     # -- engine management --------------------------------------------------
 
     def sync(self) -> None:
+        if self.fault_class == "tree":
+            # the WAL role has its own barriers (wal_fsync_pre/post
+            # around the ServiceWAL sync call)
+            faults.crashpoint("tree_save")
+        faults.storage_raise(self.fault_class, "fsync")
         self._lib.retpu_store_sync(self._handle)
 
     def flush(self) -> None:

@@ -190,6 +190,62 @@ def test_bench_trend_check_rejects_malformed(tmp_path):
     assert rep["best_same_box_ops_per_sec"] == 100.0
 
 
+def test_recovery_rung_smoke():
+    """The --stage recovery runner (ARCHITECTURE §15): checkpoint +
+    WAL tail + restart really measure, the phases decompose the
+    headline, and the tail write replayed from the WAL is served —
+    the restart-to-serving number can never be a restore that lost
+    the tail."""
+    out = bench.run_recovery(0.2, smoke=True)
+    assert out["recovery_ms"] > 0
+    assert out["recovery_restore_ms"] > 0
+    assert out["recovery_first_op_ms"] > 0
+    assert out["recovery_ms"] >= out["recovery_restore_ms"]
+    assert out["recovery_wal_records"] > 0, \
+        "no WAL tail: the rung measured a checkpoint-only restart"
+    assert out["recovery_shape"]["n_ens"] == 16
+
+
+def test_bench_trend_polices_recovery_ms(tmp_path):
+    """The recov_ms column's ratchet (ISSUE 15): lower-is-better, so
+    a same-box restart-to-serving blowup past 1/tolerance x the best
+    earlier round trips --check; rounds predating the stage neither
+    ratchet nor fail."""
+    import json
+
+    import pytest as _pytest
+
+    from tools import bench_trend
+
+    box = {"cpu_count": 2, "jax": "j", "jaxlib": "jl",
+           "platform": "p"}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"parsed": {"value": 100.0, "box": box,
+                    "recovery_ms": 500.0}}))
+    # regression: 1200 ms vs best 500 ms at tolerance 0.5 (2x band)
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"parsed": {"value": 100.0, "box": box,
+                    "recovery_ms": 1200.0}}))
+    with _pytest.raises(bench_trend.TrendError):
+        bench_trend.check(str(tmp_path), tolerance=0.5)
+    # inside the band: ok, and the report names the comparison
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"parsed": {"value": 100.0, "box": box,
+                    "recovery_ms": 800.0}}))
+    rep = bench_trend.check(str(tmp_path), tolerance=0.5)
+    assert rep["best_same_box_recovery_ms"] == 500.0
+    assert rep["newest_recovery_ms"] == 800.0
+    # a newest round predating the stage (no recovery_ms) passes
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(
+        {"parsed": {"value": 100.0, "box": box}}))
+    bench_trend.check(str(tmp_path), tolerance=0.5)
+    # the column renders in the trajectory
+    rows = bench_trend.trajectory(bench_trend.load_rounds(
+        str(tmp_path)))
+    assert rows[0]["recovery_ms"] == 500.0
+    assert rows[2]["recovery_ms"] is None
+
+
 def test_bench_smoke_trend_tripwire():
     """The current smoke rung vs the best same-fingerprint recorded
     point (BENCH_SMOKE_TREND.json), within a tolerance band: a

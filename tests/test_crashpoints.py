@@ -1,0 +1,570 @@
+"""Crash-point recovery sweeps (docs/ARCHITECTURE.md §15, ISSUE 15).
+
+``RETPU_CRASHPOINT=<barrier>[:<nth>]`` kills a process with
+``os._exit`` at a named durability barrier; these tests aim that at
+every barrier the write path crosses and assert the recovery
+contract after restart:
+
+- **no fsync-acked write lost** — every key whose future resolved
+  'ok' before the kill reads back exactly;
+- **linearizability across the restart** — the one in-flight write
+  the kill interrupted is the KeyModel 'maybe' case: it may have
+  committed (crash after the fsync) or not (crash before), so its
+  key must read either its value or NOTFOUND, never garbage and
+  never a third value;
+- **the restarted service serves** — a post-restore write acks and
+  reads back.
+
+The deterministic single-barrier sweep and the torn-tail replay fuzz
+ride tier-1; the randomized kill sweep and the live 3-host
+corruption-repair / replica-crash scenarios carry ``slow``.
+"""
+
+import os
+import pickle
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+import conftest
+
+jax = pytest.importorskip("jax")
+pytest.importorskip("orbax.checkpoint")
+
+from riak_ensemble_tpu import faults  # noqa: E402
+from riak_ensemble_tpu.config import fast_test_config  # noqa: E402
+from riak_ensemble_tpu.parallel.batched_host import (  # noqa: E402
+    BatchedEnsembleService,
+)
+from riak_ensemble_tpu.parallel.wal import PyLogStore  # noqa: E402
+from riak_ensemble_tpu.runtime import Runtime  # noqa: E402
+from riak_ensemble_tpu.types import NOTFOUND  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: scalar-put child: prints TRY before each submit and ACK after each
+#: 'ok', so the parent can split "fsync-acked" (must survive) from
+#: "in flight at the kill" (may have committed)
+_PUT_CHILD = """
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from riak_ensemble_tpu.config import fast_test_config
+    from riak_ensemble_tpu.parallel.batched_host import (
+        BatchedEnsembleService)
+    from riak_ensemble_tpu.runtime import Runtime
+    rt = Runtime(seed=1)
+    svc = BatchedEnsembleService(rt, 2, 3, 4, tick=0.005,
+                                 config=fast_test_config(),
+                                 data_dir={data!r})
+    for i in range(6):
+        print("TRY", i, flush=True)
+        r = rt.await_future(svc.kput(i % 2, "k%d" % i, b"v%d" % i),
+                            10.0)
+        if r[0] == "ok":
+            print("ACK", i, flush=True)
+    print("SURVIVED", flush=True)
+    os._exit(0)
+"""
+
+#: checkpoint child: acked working set, then save() — the kill lands
+#: inside the checkpoint's tmp-write/rename/CURRENT-flip sequence
+_CKPT_CHILD = """
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from riak_ensemble_tpu.config import fast_test_config
+    from riak_ensemble_tpu.parallel.batched_host import (
+        BatchedEnsembleService)
+    from riak_ensemble_tpu.runtime import Runtime
+    rt = Runtime(seed=1)
+    svc = BatchedEnsembleService(rt, 2, 3, 4, tick=0.005,
+                                 config=fast_test_config(),
+                                 data_dir={data!r})
+    for i in range(3):
+        r = rt.await_future(svc.kput(i % 2, "k%d" % i, b"v%d" % i),
+                            10.0)
+        assert r[0] == "ok", r
+        print("ACK", i, flush=True)
+    print("SAVING", flush=True)
+    svc.save()
+    print("SURVIVED", flush=True)
+    os._exit(0)
+"""
+
+
+def _run_child(template: str, data: str, crashpoint: str):
+    env = dict(os.environ, RETPU_CRASHPOINT=crashpoint,
+               JAX_PLATFORMS="cpu")
+    child = textwrap.dedent(template.format(repo=REPO, data=data))
+    return subprocess.run([sys.executable, "-c", child], env=env,
+                          capture_output=True, text=True, timeout=240)
+
+
+def _acked_and_inflight(stdout: str):
+    acked, tried = [], []
+    for line in stdout.splitlines():
+        parts = line.split()
+        if parts and parts[0] == "ACK":
+            acked.append(int(parts[1]))
+        elif parts and parts[0] == "TRY":
+            tried.append(int(parts[1]))
+    inflight = [i for i in tried if i not in acked]
+    return acked, inflight
+
+
+def _restore(data: str, seed: int = 99):
+    rt = Runtime(seed=seed)
+    svc = BatchedEnsembleService.restore(
+        rt, data, tick=0.005, config=fast_test_config(),
+        data_dir=data)
+    return rt, svc
+
+
+# -- the deterministic kill-at-every-barrier sweep (tier-1) -------------------
+
+
+@pytest.mark.parametrize("barrier", [
+    "wal_append:2",      # kill before the batch's records are appended
+    "wal_fsync_pre:2",   # appended, not yet forced to disk
+    "wal_fsync_post:2",  # durable, ack never sent — the 'maybe' case
+])
+def test_kill_at_wal_barrier_recovers(tmp_path, barrier):
+    data = str(tmp_path / "data")
+    proc = _run_child(_PUT_CHILD, data, barrier)
+    assert proc.returncode == faults.CRASH_EXIT, \
+        (proc.returncode, proc.stderr[-2000:])
+    assert "SURVIVED" not in proc.stdout
+    acked, inflight = _acked_and_inflight(proc.stdout)
+    assert acked, "the barrier killed the child before any ack"
+
+    rt, svc = _restore(data)
+    # (a) no fsync-acked write lost
+    for i in acked:
+        got = rt.await_future(svc.kget(i % 2, "k%d" % i), 5.0)
+        assert got == ("ok", b"v%d" % i), \
+            f"acked write k{i} lost/stale after {barrier}: {got!r}"
+    # (b) the in-flight write is the KeyModel 'maybe': its value or
+    # NOTFOUND, never anything else
+    for i in inflight:
+        got = rt.await_future(svc.kget(i % 2, "k%d" % i), 5.0)
+        assert got[0] == "ok"
+        assert got[1] in (b"v%d" % i, NOTFOUND), \
+            f"in-flight k{i} read garbage after {barrier}: {got!r}"
+    # (c) the restarted service serves
+    assert rt.await_future(svc.kput(0, "post", b"p"), 5.0)[0] == "ok"
+    assert rt.await_future(svc.kget(0, "post"), 5.0) == ("ok", b"p")
+    svc.stop()
+
+
+@pytest.mark.parametrize("barrier", [
+    "ckpt_tmp_write:1",  # host blob tmp written, never renamed
+    "ckpt_rename:1",     # host blob live, CURRENT not flipped
+    "ckpt_rename:3",     # CURRENT flipped, backup/rotation never ran
+])
+def test_kill_inside_checkpoint_recovers(tmp_path, barrier):
+    """ISSUE 15 satellite: the ckpt_rename crash-point test — a kill
+    anywhere inside save()'s tmp-write → rename → CURRENT-flip
+    sequence leaves either the old (WAL-backed) or the new
+    checkpoint image fully restorable, with zero acked writes lost
+    either way (the 4-copy + CURRENT-pointer crash atomicity, now
+    exercised at its exact barriers, dir-fsync included)."""
+    data = str(tmp_path / "data")
+    proc = _run_child(_CKPT_CHILD, data, barrier)
+    assert proc.returncode == faults.CRASH_EXIT, \
+        (proc.returncode, proc.stderr[-2000:])
+    assert "SAVING" in proc.stdout and "SURVIVED" not in proc.stdout
+
+    rt, svc = _restore(data)
+    for i in range(3):
+        got = rt.await_future(svc.kget(i % 2, "k%d" % i), 5.0)
+        assert got == ("ok", b"v%d" % i), \
+            f"acked write k{i} lost across {barrier}: {got!r}"
+    assert rt.await_future(svc.kput(1, "post", b"p"), 5.0)[0] == "ok"
+    svc.stop()
+
+
+def test_kill_at_tree_save_barrier(tmp_path):
+    """The synctree store's durability barrier: a kill at tree_save
+    (post-append, pre-fsync) must leave every previously-synced
+    record replayable and the torn state detected, not served.  No
+    jax in the child — this one is cheap."""
+    path = str(tmp_path / "t" / "tree")
+    child = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+        from riak_ensemble_tpu.synctree.backends import FileBackend
+        be = FileBackend({path!r})
+        be.store("k0", "v0")
+        be.sync()
+        print("SYNCED k0", flush=True)
+        be.store("k1", "v1")
+        be.sync()
+        print("SURVIVED", flush=True)
+    """)
+    env = dict(os.environ, RETPU_CRASHPOINT="tree_save:2")
+    proc = subprocess.run([sys.executable, "-c", child], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == faults.CRASH_EXIT, \
+        (proc.returncode, proc.stderr[-2000:])
+    assert "SYNCED k0" in proc.stdout
+    assert "SURVIVED" not in proc.stdout
+
+    from riak_ensemble_tpu.synctree.backends import FileBackend
+    be = FileBackend(path)
+    assert be.fetch("k0") == "v0", "synced record lost at tree_save"
+    assert be.fetch("k1") in ("v1", None)  # flushed, never fsynced
+    be.close()
+
+
+# -- torn-tail replay fuzz (ISSUE 15 satellite) -------------------------------
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_torn_tail_replay_fuzz(tmp_path, seed):
+    """Random truncation/garbage offsets over a generated
+    multi-record log: replay must stop EXACTLY at the tear — every
+    record wholly before it intact (latest-per-key semantics), every
+    record at/after it dropped, and appends after the reopen
+    replayable (the truncate-at-tear contract of PyLogStore)."""
+    rng = random.Random(seed)
+    base = str(tmp_path / "base")
+    st = PyLogStore(base)
+    bounds = [4]  # frame end offsets (file starts with 4-byte magic)
+    records = []
+    for i in range(rng.randint(6, 14)):
+        key = f"k{rng.randint(0, 4)}"
+        val = "v%d" % i * rng.randint(1, 30)
+        if rng.random() < 0.2:
+            st.delete(key)
+            records.append((key, None))
+        else:
+            st.store(key, val)
+            records.append((key, val))
+        bounds.append(st._f.tell())
+    st.sync()
+    st.close()
+    size = os.path.getsize(base)
+    assert bounds[-1] == size
+
+    for case in range(8):
+        cut = rng.randint(4, size)
+        garbage = (rng.random() < 0.5)
+        p = str(tmp_path / f"fuzz{case}")
+        shutil.copyfile(base, p)
+        with open(p, "r+b") as f:
+            f.truncate(cut)
+            if garbage:
+                f.seek(0, 2)
+                f.write(bytes(rng.getrandbits(8)
+                              for _ in range(rng.randint(1, 40))))
+        # expected: exactly the records whose frames END at/below cut
+        n_complete = sum(1 for b in bounds[1:] if b <= cut)
+        expect = {}
+        for key, val in records[:n_complete]:
+            if val is None:
+                expect.pop(key, None)
+            else:
+                expect[key] = val
+        st2 = PyLogStore(p)
+        got = {k: st2.fetch(k) for k in st2.keys()}
+        assert got == expect, \
+            (f"seed {seed} case {case}: cut {cut}/{size} "
+             f"garbage={garbage}: replay did not stop at the tear")
+        # the reopened log keeps serving appends across another cycle
+        st2.store("post", f"p{case}")
+        st2.sync()
+        st2.close()
+        st3 = PyLogStore(p)
+        assert st3.fetch("post") == f"p{case}"
+        for k, v in expect.items():
+            assert st3.fetch(k) == v
+        st3.close()
+
+
+# -- randomized kill sweep (slow lane) ----------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", conftest.soak_seeds([7101, 7102,
+                                                      7103]))
+def test_randomized_crashpoint_kill_sweep(tmp_path, seed):
+    """The randomized half of the kill sweep: a random barrier and
+    hit count, a random keyed workload (scalar + batch puts,
+    deletes), the child recording TRY/ACK to an fsync'd side log.
+    After the kill the parent restores and checks the KeyModel rule
+    per key: the last acked value — or any value tried after that
+    ack (an in-flight op has no linearization upper bound), or
+    NOTFOUND if a tried delete could explain it.  Nothing else."""
+    rng = random.Random(seed)
+    barrier = rng.choice(["wal_append", "wal_fsync_pre",
+                          "wal_fsync_post"])
+    nth = rng.randint(1, 4)
+    data = str(tmp_path / "data")
+    acklog = str(tmp_path / "acks")
+    child = textwrap.dedent(f"""
+        import os, pickle, sys
+        sys.path.insert(0, {REPO!r})
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        from riak_ensemble_tpu.config import fast_test_config
+        from riak_ensemble_tpu.parallel.batched_host import (
+            BatchedEnsembleService)
+        from riak_ensemble_tpu.runtime import Runtime
+        rng = np.random.default_rng({seed})
+        rt = Runtime(seed={seed})
+        svc = BatchedEnsembleService(rt, 3, 3, 8, tick=0.005,
+                                     config=fast_test_config(),
+                                     data_dir={data!r})
+        ack_f = open({acklog!r}, "ab")
+        def record(*row):
+            ack_f.write(pickle.dumps(row))
+            ack_f.flush(); os.fsync(ack_f.fileno())
+        for n in range(30):
+            e = int(rng.integers(3))
+            r = rng.random()
+            if r < 0.55:
+                key = f"k{{int(rng.integers(5))}}"
+                val = b"v%d" % int(rng.integers(1000))
+                record("try", "put", e, key, val)
+                if rt.await_future(svc.kput(e, key, val),
+                                   10.0)[0] == "ok":
+                    record("ack", "put", e, key, val)
+            elif r < 0.75:
+                keys = [f"b{{i}}" for i in range(3)]
+                vals = [b"w%d" % int(rng.integers(1000))
+                        for _ in range(3)]
+                for kk, vv in zip(keys, vals):
+                    record("try", "put", e, kk, vv)
+                res = rt.await_future(
+                    svc.kput_many(e, keys, vals), 10.0)
+                for kk, vv, rr in zip(keys, vals, res):
+                    if rr[0] == "ok":
+                        record("ack", "put", e, kk, vv)
+            else:
+                key = f"k{{int(rng.integers(5))}}"
+                record("try", "del", e, key, None)
+                rr = rt.await_future(svc.kdelete(e, key), 10.0)
+                if isinstance(rr, tuple) and rr[0] == "ok":
+                    record("ack", "del", e, key, None)
+        print("DONE", flush=True)
+        os._exit(0)
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RETPU_CRASHPOINT=f"{barrier}:{nth}")
+    proc = subprocess.run([sys.executable, "-c", child], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode in (0, faults.CRASH_EXIT), \
+        proc.stderr[-2000:]
+
+    # per-(ens, key) model: last acked value, plus every value tried
+    # AFTER that ack (the 'maybe' set a crash may have committed)
+    acked = {}
+    maybe = {}
+    with open(acklog, "rb") as f:
+        while True:
+            try:
+                kind, op, e, key, val = pickle.load(f)
+            except EOFError:
+                break
+            k = (e, key)
+            want = NOTFOUND if op == "del" else val
+            if kind == "ack":
+                acked[k] = want
+                maybe[k] = set()
+            else:
+                maybe.setdefault(k, set()).add(want)
+
+    rt2 = Runtime(seed=seed + 1000)
+    svc2 = BatchedEnsembleService.restore(
+        rt2, data, tick=0.005, config=fast_test_config(),
+        data_dir=data)
+    for (e, key) in set(acked) | set(maybe):
+        got = rt2.await_future(svc2.kget(e, key), 5.0)
+        assert got[0] == "ok", (e, key, got)
+        allowed = set(maybe.get((e, key), set()))
+        if (e, key) in acked:
+            allowed.add(acked[(e, key)])
+        else:
+            allowed.add(NOTFOUND)  # never acked: may never have run
+        assert got[1] in allowed, \
+            (f"{barrier}:{nth} seed {seed}: {(e, key)} read "
+             f"{got[1]!r}, allowed {allowed!r}")
+    assert rt2.await_future(svc2.kput(0, "post", b"p"),
+                            5.0)[0] == "ok"
+    svc2.stop()
+
+
+# -- live 3-host scenarios (slow lane) ----------------------------------------
+
+
+def _flip_bytes(path: str, fracs=(0.45, 0.8)) -> bool:
+    size = os.path.getsize(path)
+    if size < 16:
+        return False
+    with open(path, "r+b") as f:
+        for frac in fracs:
+            off = max(4, int(size * frac) - 1)
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0x5A]))
+    return True
+
+
+@pytest.mark.slow
+def test_replica_wal_corruption_detected_and_repaired_from_live_replica(
+        tmp_path):
+    """THE corruption acceptance scenario on a live 3-host group:
+    kill a replica, flip bits in its on-disk WAL (silent disk
+    corruption while down), restart it.  The store's CRC gate must
+    detect the corruption at replay (truncate/quarantine — never
+    serve it), the leader re-syncs the survivor from live state, and
+    the proof is the handoff: with the OTHER replica killed, the
+    once-corrupt host carries the commit quorum alone and every
+    acked write reads back exactly."""
+    from test_repgroup import (_make_leader, _restart, _settle,
+                               _spawn_replica, _wait_synced)
+
+    procs, dirs = {}, {}
+    for name in ("r1", "r2"):
+        dirs[name] = str(tmp_path / name)
+        procs[name] = _spawn_replica(dirs[name])
+    svc = _make_leader(tmp_path, [procs["r1"][1], procs["r2"][1]])
+    acked = {}
+
+    def put_ok(phase, n=6):
+        futs = []
+        for i in range(n):
+            e, key = i % 4, f"{phase}-{i}"
+            val = b"%s/%d" % (phase.encode(), i)
+            futs.append((e, key, val, svc.kput(e, key, val)))
+        _settle(svc, [f for *_, f in futs], flushes=10)
+        for e, key, val, f in futs:
+            assert f.value[0] == "ok", (phase, key, f.value)
+            acked[(e, key)] = val
+
+    try:
+        put_ok("pre")
+        p1, _, _ = procs["r1"]
+        p1.send_signal(signal.SIGKILL)
+        p1.wait()
+        put_ok("during")  # commits continue on the leader + r2 quorum
+
+        # silent corruption while r1 is down: flip bits in every WAL
+        # store file under its data dir
+        flipped = 0
+        for root, _dirs, files in os.walk(dirs["r1"]):
+            for fn in files:
+                if os.path.basename(root).startswith("wal.") \
+                        and not fn.endswith(".tmp"):
+                    flipped += _flip_bytes(os.path.join(root, fn))
+        assert flipped, f"no WAL store files found under {dirs['r1']}"
+
+        _restart(procs, dirs, "r1")
+        _wait_synced(svc, 2)
+
+        # the once-corrupt host must now carry the quorum alone
+        p2, _, _ = procs["r2"]
+        p2.send_signal(signal.SIGKILL)
+        p2.wait()
+        put_ok("after")
+
+        futs = [(e, key, val, svc.kget(e, key))
+                for (e, key), val in acked.items()]
+        _settle(svc, [f for *_, f in futs], flushes=10)
+        for e, key, val, f in futs:
+            assert f.value == ("ok", val), \
+                (f"acked write lost or corrupt value served at "
+                 f"{(e, key)}: {f.value!r}")
+    finally:
+        try:
+            svc.stop()
+        except Exception:  # noqa: BLE001 — teardown best effort
+            pass
+        for p, _, _ in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+
+@pytest.mark.slow
+def test_replica_killed_at_apply_barrier_catches_up(tmp_path):
+    """replica_apply_pre_ack: a replica dies exactly between its
+    durable apply and the ack.  The leader keeps committing on the
+    remaining quorum; the restarted replica re-syncs (its WAL holds
+    the un-acked apply — the retransmit/seq discipline must absorb
+    it, not double-apply), and then carries the quorum alone with
+    zero acked writes lost."""
+    from test_repgroup import (_make_leader, _restart, _settle,
+                               _spawn_replica, _wait_synced)
+
+    procs, dirs = {}, {}
+    os.environ["RETPU_CRASHPOINT"] = "replica_apply_pre_ack:2"
+    try:
+        dirs["r1"] = str(tmp_path / "r1")
+        procs["r1"] = _spawn_replica(dirs["r1"])
+    finally:
+        os.environ.pop("RETPU_CRASHPOINT", None)
+    dirs["r2"] = str(tmp_path / "r2")
+    procs["r2"] = _spawn_replica(dirs["r2"])
+    svc = _make_leader(tmp_path, [procs["r1"][1], procs["r2"][1]],
+                       ack_timeout=5.0)
+    acked = {}
+
+    def put_ok(phase, n=6):
+        futs = []
+        for i in range(n):
+            e, key = i % 4, f"{phase}-{i}"
+            val = b"%s/%d" % (phase.encode(), i)
+            futs.append((e, key, val, svc.kput(e, key, val)))
+        _settle(svc, [f for *_, f in futs], flushes=12)
+        for e, key, val, f in futs:
+            assert f.value[0] == "ok", (phase, key, f.value)
+            acked[(e, key)] = val
+
+    try:
+        put_ok("pre")
+        # drive applies (heartbeats are empty applies) until the
+        # barrier fires — the crash needs a live stream to cross it
+        end = time.monotonic() + 90.0
+        while procs["r1"][0].poll() is None \
+                and time.monotonic() < end:
+            svc.heartbeat()
+            time.sleep(0.05)
+        assert procs["r1"][0].poll() == faults.CRASH_EXIT, \
+            "replica never died at replica_apply_pre_ack"
+        put_ok("during")
+
+        _restart(procs, dirs, "r1")
+        _wait_synced(svc, 2)
+        p2, _, _ = procs["r2"]
+        p2.send_signal(signal.SIGKILL)
+        p2.wait()
+        put_ok("after")
+
+        futs = [(e, key, val, svc.kget(e, key))
+                for (e, key), val in acked.items()]
+        _settle(svc, [f for *_, f in futs], flushes=10)
+        for e, key, val, f in futs:
+            assert f.value == ("ok", val), \
+                f"acked write lost at {(e, key)}: {f.value!r}"
+    finally:
+        try:
+            svc.stop()
+        except Exception:  # noqa: BLE001 — teardown best effort
+            pass
+        for p, _, _ in procs.values():
+            if p.poll() is None:
+                p.kill()

@@ -1,0 +1,582 @@
+"""Storage fault plane (docs/ARCHITECTURE.md §15, ISSUE 15).
+
+The reference's headline safety property is surviving a bad disk
+(synctree.erl:21-73).  These tests pin the injection plane itself —
+per-path-class EIO/ENOSPC on write/fsync, torn writes, bit-flip read
+corruption, the env knobs — and the service-level contract built on
+it: a detected corruption is evidence (counted, quarantined), never
+served; a WAL EIO/ENOSPC storm degrades the service to read-only (or
+steps a replicated leader down) instead of crashing the serving loop,
+observable in health()/stats() and the retpu_fault_*/retpu_recovery_*
+gauges.  Cheap, deterministic, tier-1; the randomized kill sweeps and
+the live 3-host corruption-repair scenario ride the slow lane in
+test_crashpoints.py.
+"""
+
+import errno
+import os
+import subprocess
+import sys
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from riak_ensemble_tpu import faults  # noqa: E402
+from riak_ensemble_tpu import save as savelib  # noqa: E402
+from riak_ensemble_tpu.config import fast_test_config  # noqa: E402
+from riak_ensemble_tpu.parallel.batched_host import (  # noqa: E402
+    BatchedEnsembleService,
+)
+from riak_ensemble_tpu.parallel.wal import (  # noqa: E402
+    PyLogStore, ServiceWAL,
+)
+from riak_ensemble_tpu.runtime import Runtime  # noqa: E402
+from riak_ensemble_tpu.synctree.backends import FileBackend  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_fault_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def settle(runtime, fut, timeout=5.0):
+    return runtime.await_future(fut, timeout)
+
+
+# -- plan rule surface / env knobs -------------------------------------------
+
+
+def test_storage_knobs_parse_from_env():
+    p = faults.from_env({
+        "RETPU_FAULT_STORAGE": "wal.fsync=ENOSPC,ckpt.write=EIO:2",
+        "RETPU_FAULT_TORN": "wal:37",
+        "RETPU_FAULT_CORRUPT": "tree:0.5",
+    })
+    assert p is not None and p.active()
+    d = p.describe()
+    assert d["storage"] == {"wal.fsync": ["ENOSPC", None],
+                            "ckpt.write": ["EIO", 2]}
+    assert d["torn"] == {"wal": 37}
+    assert d["corrupt"] == {"tree": 0.5}
+    # bounded rule: exactly two injections, then clean
+    assert p.storage_error("ckpt", "write").errno == errno.EIO
+    assert p.storage_error("ckpt", "write").errno == errno.EIO
+    assert p.storage_error("ckpt", "write") is None
+    # unbounded rule: keeps firing, counts the evidence
+    assert p.storage_error("wal", "fsync").errno == errno.ENOSPC
+    assert p.storage_error("wal", "write") is None
+    # torn rule is one-shot
+    assert p.torn_limit("wal") == 37
+    assert p.torn_limit("wal") is None
+    assert p.counters()["storage_errors_injected"] == 3
+    assert p.counters()["torn_writes_injected"] == 1
+
+
+def test_malformed_storage_knob_raises_loudly():
+    with pytest.raises(ValueError):
+        faults.from_env({"RETPU_FAULT_STORAGE": "wal.fsync=EPERM"})
+    with pytest.raises(ValueError):
+        faults.from_env({"RETPU_FAULT_STORAGE": "walfsync=EIO"})
+    with pytest.raises(ValueError):
+        faults.from_env({"RETPU_FAULT_TORN": "wal"})
+    with pytest.raises(ValueError):
+        faults.from_env({"RETPU_FAULT_CORRUPT": "tree:x"})
+    # review r15: a typo'd class/op or a zero count would arm an
+    # injecting-nothing nemesis — rejected at arm time instead
+    with pytest.raises(ValueError):
+        faults.from_env({"RETPU_FAULT_STORAGE": "wla.fsync=EIO"})
+    with pytest.raises(ValueError):
+        faults.from_env({"RETPU_FAULT_STORAGE": "wal.sync=EIO"})
+    with pytest.raises(ValueError):
+        faults.from_env({"RETPU_FAULT_STORAGE": "wal.fsync=EIO:0"})
+    with pytest.raises(ValueError):
+        faults.from_env({"RETPU_FAULT_TORN": "foo:5"})
+    with pytest.raises(ValueError):
+        faults.from_env({"RETPU_FAULT_CORRUPT": "blob:0.5"})
+
+
+def test_heal_clears_storage_rules_keeps_evidence():
+    p = faults.FaultPlan()
+    p.set_storage_error("wal", "write", "EIO")
+    p.set_torn_write("ckpt", 9)
+    p.set_read_corruption("tree", 1.0)
+    assert p.active()
+    assert p.storage_error("wal", "write") is not None
+    p.heal()
+    assert not p.active()
+    assert p.storage_error("wal", "write") is None
+    assert p.counters()["storage_errors_injected"] == 1
+
+
+# -- WAL store seams ----------------------------------------------------------
+
+
+def test_pylogstore_injected_write_and_fsync_errors(tmp_path):
+    st = PyLogStore(str(tmp_path / "log"))
+    st.store("k0", "v0")
+    faults.install(faults.FaultPlan()
+                   .set_storage_error("wal", "write", "EIO"))
+    with pytest.raises(OSError) as ei:
+        st.store("k1", "v1")
+    assert ei.value.errno == errno.EIO
+    faults.install(faults.FaultPlan()
+                   .set_storage_error("wal", "fsync", "ENOSPC"))
+    with pytest.raises(OSError) as ei:
+        st.sync()
+    assert ei.value.errno == errno.ENOSPC
+    faults.clear()
+    st.sync()
+    st.close()
+
+
+def test_injected_torn_write_repaired_and_later_acks_survive(
+        tmp_path):
+    """A torn write (truncated mid-record) fails the writer, which
+    REPAIRS the frame boundary before continuing (review r15) — so
+    every preceding record replays, the torn record is never served,
+    and crucially records appended+synced AFTER the failure survive
+    the next replay instead of being truncated behind the tear."""
+    p = str(tmp_path / "log")
+    st = PyLogStore(p)
+    st.store("k0", "v0")
+    st.sync()
+    faults.install(faults.FaultPlan().set_torn_write("wal", 6))
+    with pytest.raises(OSError):
+        st.store("k1", "v1")
+    faults.clear()
+    assert st.append_repairs == 1
+    # the writer survived: later appends must be fully replayable
+    st.store("k2", "v2")
+    st.sync()
+    st.close()
+    st2 = PyLogStore(p)
+    assert st2.fetch("k0") == "v0"
+    assert st2.fetch("k1") is None, "torn record served"
+    assert st2.fetch("k2") == "v2", \
+        "record appended after the repaired tear was lost at replay"
+    assert st2.truncations == 0, "repair left a tear for replay"
+    st2.close()
+
+
+def test_injected_read_corruption_detected_never_served(tmp_path):
+    """Bit-flip corruption on WAL replay reads: the CRC gate must
+    stop replay at the flipped record (detection), drop it (never
+    serve it), and the injection must be counted."""
+    p = str(tmp_path / "log")
+    st = PyLogStore(p)
+    st.store("k0", "v0" * 20)
+    st.sync()
+    st.close()
+    plan = faults.install(faults.FaultPlan(seed=7)
+                          .set_read_corruption("wal", 1.0))
+    st2 = PyLogStore(p)
+    faults.clear()
+    assert plan.corrupt_reads_injected >= 1
+    assert st2.fetch("k0") is None, \
+        "corrupted record served instead of dropped"
+    assert st2.truncations == 1
+    st2.close()
+
+
+# -- synctree/treestore seams -------------------------------------------------
+
+
+def test_filebackend_tree_faults_and_corruption_detection(tmp_path):
+    be = FileBackend(str(tmp_path / "t" / "tree"))
+    be.store("a", 1)
+    be.sync()
+    faults.install(faults.FaultPlan()
+                   .set_storage_error("tree", "write", "ENOSPC"))
+    with pytest.raises(OSError) as ei:
+        be.store("b", 2)
+    assert ei.value.errno == errno.ENOSPC
+    faults.install(faults.FaultPlan()
+                   .set_storage_error("tree", "fsync", "EIO"))
+    with pytest.raises(OSError):
+        be.sync()
+    faults.clear()
+    be.sync()
+    be.close()
+    # corrupt replay read: CRC detects, drops, counts — never serves
+    plan = faults.install(faults.FaultPlan(seed=3)
+                          .set_read_corruption("tree", 1.0))
+    be2 = FileBackend(str(tmp_path / "t" / "tree"))
+    faults.clear()
+    assert plan.corrupt_reads_injected >= 1
+    assert be2.fetch("a") is None
+    assert be2.truncations == 1
+    be2.close()
+
+
+# -- checkpoint seams ---------------------------------------------------------
+
+
+def test_checkpoint_write_fault_keeps_prior_checkpoint_restorable(
+        tmp_path):
+    """An ENOSPC mid-save must fail the save() loudly while the
+    previous checkpoint + WAL tail stay fully restorable (the CURRENT
+    pointer never flipped to a half-written image)."""
+    data = str(tmp_path / "data")
+    rt = Runtime(seed=31)
+    svc = BatchedEnsembleService(rt, 2, 3, 4, tick=0.005,
+                                 config=fast_test_config(),
+                                 data_dir=data)
+    assert settle(rt, svc.kput(0, "a", b"1"))[0] == "ok"
+    svc.save()
+    assert settle(rt, svc.kput(0, "b", b"2"))[0] == "ok"
+    faults.install(faults.FaultPlan()
+                   .set_storage_error("ckpt", "write", "ENOSPC"))
+    with pytest.raises(OSError):
+        svc.save()
+    faults.clear()
+    svc.stop()
+    svc._wal.close()
+
+    rt2 = Runtime(seed=32)
+    svc2 = BatchedEnsembleService.restore(
+        rt2, data, tick=0.005, config=fast_test_config(),
+        data_dir=data)
+    assert settle(rt2, svc2.kget(0, "a")) == ("ok", b"1")
+    assert settle(rt2, svc2.kget(0, "b")) == ("ok", b"2")
+    svc2.stop()
+
+
+def test_save_read_survives_injected_bitflip(tmp_path):
+    """The 4-copy save format vs injected read corruption: a flipped
+    bit in one copy must never surface — read() falls through to an
+    intact copy (the save.erl paranoia, now actually exercised)."""
+    path = str(tmp_path / "blob")
+    savelib.write(path, b"payload-bytes" * 17)
+    faults.install(faults.FaultPlan(seed=5)
+                   .set_read_corruption("ckpt", 1.0))
+    got = savelib.read(path)
+    faults.clear()
+    assert got == b"payload-bytes" * 17
+
+
+# -- graceful degradation (tentpole c) ----------------------------------------
+
+
+def test_wal_enospc_degrades_readonly_not_crash(tmp_path):
+    """EIO/ENOSPC under the WAL: the serving loop must NOT crash —
+    the affected writes fail (never acked), the service flips
+    read-only (journaled decision), reads keep serving, later writes
+    fail fast, and health()/stats()/gauges all carry the evidence."""
+    events = []
+    rt = Runtime(seed=21)
+    svc = BatchedEnsembleService(rt, 2, 3, 4, tick=0.005,
+                                 config=fast_test_config(),
+                                 data_dir=str(tmp_path / "data"))
+    rt.trace = lambda kind, payload: events.append((kind, payload))
+    assert settle(rt, svc.kput(0, "a", b"1"))[0] == "ok"
+
+    faults.install(faults.FaultPlan()
+                   .set_storage_error("wal", "fsync", "ENOSPC"))
+    assert settle(rt, svc.kput(0, "b", b"2")) == "failed"
+    h = svc.health()["storage"]
+    assert h["degraded"] is True and h["mode"] == "read_only"
+    assert h["reason"] == "ENOSPC" and h["wal_errors"] >= 1
+    # the decision is journaled as a trace event
+    assert any(k == "svc_storage_degraded" for k, _ in events), events
+    # reads keep serving; the storm never crashed the flush loop
+    assert settle(rt, svc.kget(0, "a")) == ("ok", b"1")
+    # disk healed or not: the service stays read-only until restart
+    faults.clear()
+    assert settle(rt, svc.kput(1, "c", b"3")) == "failed"
+    assert settle(rt, svc.kput_many(1, ["d", "e"],
+                                    [b"4", b"5"])) == ["failed"] * 2
+    # the acked pre-storm write is still served
+    assert settle(rt, svc.kget(0, "a")) == ("ok", b"1")
+    # gauges: the recovery plane is observable
+    snap = svc.obs_registry.snapshot()
+    assert snap["retpu_recovery_degraded"] == 1
+    assert snap["retpu_recovery_wal_errors_total"] >= 1
+    svc.stop()
+
+    # restart-to-recover: restore on a healthy disk serves writes again
+    rt2 = Runtime(seed=22)
+    svc2 = BatchedEnsembleService.restore(
+        rt2, str(tmp_path / "data"), tick=0.005,
+        config=fast_test_config(), data_dir=str(tmp_path / "data"))
+    assert svc2.health()["storage"]["degraded"] is False
+    assert settle(rt2, svc2.kget(0, "a")) == ("ok", b"1")
+    # the never-acked storm write must not have materialized
+    from riak_ensemble_tpu.types import NOTFOUND
+    assert settle(rt2, svc2.kget(0, "b")) in (("ok", NOTFOUND),
+                                              ("ok", b"2"))
+    assert settle(rt2, svc2.kput(0, "post", b"p"))[0] == "ok"
+    svc2.stop()
+
+
+def test_generic_wal_oserror_still_raises(tmp_path):
+    """Only the real bad-disk errnos degrade; a generic OSError keeps
+    the historical raise-to-driver contract (pinned by
+    test_pipeline.test_wal_error_does_not_abandon_later_launches —
+    this asserts the split directly)."""
+    rt = Runtime(seed=23)
+    svc = BatchedEnsembleService(rt, 1, 3, 4, tick=None,
+                                 config=fast_test_config(),
+                                 data_dir=str(tmp_path / "data"))
+    svc.flush()
+    real_log = svc._wal.log
+
+    def flaky(recs):
+        raise OSError("transient")
+    svc._wal.log = flaky
+    f = svc.kput(0, "k", b"v")
+    with pytest.raises(OSError):
+        for _ in range(6):
+            svc.flush()
+    assert f.done and f.value == "failed"
+    assert svc._storage_degraded is None, \
+        "generic OSError must not flip read-only"
+    svc._wal.log = real_log
+    f2 = svc.kput(0, "k", b"v2")
+    for _ in range(6):
+        if f2.done:
+            break
+        svc.flush()
+    assert f2.value[0] == "ok"
+    svc.stop()
+
+
+def test_late_fatal_errno_wins_over_earlier_transient(tmp_path):
+    """Review r15: a fatal EIO/ENOSPC observed on a LATER launch of
+    the same drain must still win the degrade decision — the first
+    (non-fatal) error of the drain must not mask it and crash the
+    serving loop."""
+    rt = Runtime(seed=27)
+    svc = BatchedEnsembleService(rt, 1, 3, 8, tick=None,
+                                 max_ops_per_tick=1,
+                                 pipeline_depth=2,
+                                 config=fast_test_config(),
+                                 data_dir=str(tmp_path / "data"))
+    svc.flush()
+    svc.flush()
+    errs = [OSError(errno.EBADF, "yanked fd"),
+            OSError(errno.EIO, "dead disk")]
+
+    def flaky(recs):
+        raise errs.pop(0)
+    svc._wal.log = flaky
+    f1 = svc.kput(0, "a", b"1")
+    f2 = svc.kput(0, "b", b"2")
+    for _ in range(6):  # must NOT raise: the late EIO degrades
+        svc.flush()
+    assert f1.done and f1.value == "failed"
+    assert f2.done and f2.value == "failed"
+    assert svc._storage_degraded is not None
+    assert svc.health()["storage"]["reason"] == "EIO"
+    svc.stop()
+
+
+def test_replicated_leader_steps_down_on_storage_degrade():
+    """The repgroup hook: a replicated leader whose WAL disk dies
+    demotes itself through the existing step-down machinery — no
+    leadership, no host lease, the decision in group_stats and the
+    storage record marked step_down."""
+    from riak_ensemble_tpu.parallel.batched_host import WallRuntime
+    from riak_ensemble_tpu.parallel.repgroup import (
+        DeposedError, ReplicatedService)
+
+    svc = ReplicatedService(WallRuntime(), 2, 1, 4, group_size=1)
+    svc._is_leader = True
+    svc._host_lease_until = 1e18
+    svc._degrade_storage("wal", OSError(errno.ENOSPC, "disk full"))
+    assert svc.is_leader is False and svc._deposed is True
+    assert svc._host_lease_until == 0.0
+    assert svc._storage_degraded["mode"] == "step_down"
+    assert svc.group_stats["storage_step_downs"] == 1
+    h = svc.health()
+    assert h["storage"]["degraded"] is True
+    assert h["storage"]["mode"] == "step_down"
+    with pytest.raises(DeposedError):
+        svc.update_members([("127.0.0.1", 1)])
+    svc.stop()
+
+
+def test_storage_health_section_constant_shape(tmp_path):
+    """Healthy service: the storage section is present with the same
+    keys a degraded one reports (dashboard-query stability — the §13
+    gauge discipline applied to §15)."""
+    rt = Runtime(seed=24)
+    svc = BatchedEnsembleService(rt, 1, 3, 4, tick=None,
+                                 config=fast_test_config(),
+                                 data_dir=str(tmp_path / "d"))
+    h = svc.health()["storage"]
+    assert h["degraded"] is False and h["mode"] is None
+    assert set(h) == {"degraded", "mode", "reason", "at_flush",
+                      "wal_errors", "wal_quarantines",
+                      "wal_truncations"}
+    s = svc.stats()
+    assert s["storage"] == h
+    assert s["wal"]["records"] == 0
+    snap = svc.obs_registry.snapshot()
+    assert snap["retpu_recovery_degraded"] == 0
+    assert snap["retpu_fault_storage_errors_total"] == 0
+    assert snap["retpu_fault_torn_writes_total"] == 0
+    assert snap["retpu_fault_corrupt_reads_total"] == 0
+    svc.stop()
+
+
+def test_degrade_fails_queued_writes_keeps_queued_reads(tmp_path):
+    """Review r15: the read-only contract covers writes already
+    QUEUED at degrade time — left queued they would flush later and
+    could ack if the disk flickered back.  They fail at the degrade;
+    queued reads survive and serve."""
+    rt = Runtime(seed=26)
+    svc = BatchedEnsembleService(rt, 2, 3, 8, tick=None,
+                                 config=fast_test_config(),
+                                 data_dir=str(tmp_path / "data"))
+    svc.flush()  # elections
+    f0 = svc.kput(0, "pre", b"p")
+    for _ in range(4):
+        if f0.done:
+            break
+        svc.flush()
+    assert f0.value[0] == "ok"
+    # queue a backlog WITHOUT flushing, then degrade; the read is
+    # forced onto a device round (lease zeroed) so it really queues
+    w1 = svc.kput(0, "q1", b"1")
+    w2 = svc.kput_many(1, ["q2", "q3"], [b"2", b"3"])
+    svc.lease_until[:] = 0.0
+    g = svc.kget(0, "pre")
+    svc._degrade_storage("wal", OSError(errno.ENOSPC, "disk full"))
+    assert w1.done and w1.value == "failed"
+    assert w2.done and w2.value == ["failed", "failed"]
+    assert not g.done  # the queued read survives the purge
+    for _ in range(4):
+        if g.done:
+            break
+        svc.flush()  # must not raise, must serve the read
+    assert g.value == ("ok", b"p")
+    # bulk execute writes refuse loudly on the read-only service
+    import numpy as np
+
+    from riak_ensemble_tpu.ops import engine as eng
+    with pytest.raises(OSError):
+        svc.execute(np.full((1, 2), eng.OP_PUT, np.int32),
+                    np.zeros((1, 2), np.int32),
+                    np.ones((1, 2), np.int32))
+    svc.stop()
+
+
+def test_degraded_service_never_compacts_onto_dead_disk(tmp_path):
+    """Review r15: a read-only (degraded) service must not run WAL
+    compaction — save() would write the same dead disk and the
+    OSError would crash the flush loop the degradation protects."""
+    rt = Runtime(seed=25)
+    svc = BatchedEnsembleService(rt, 1, 3, 4, tick=0.005,
+                                 config=fast_test_config(),
+                                 data_dir=str(tmp_path / "data"))
+    assert settle(rt, svc.kput(0, "a", b"1"))[0] == "ok"
+    faults.install(faults.FaultPlan()
+                   .set_storage_error("wal", "fsync", "ENOSPC"))
+    assert settle(rt, svc.kput(0, "b", b"2")) == "failed"
+    assert svc._storage_degraded is not None
+    # past the compaction bound with the disk still dead: idle
+    # flushes must neither compact nor raise
+    svc.wal_compact_records = 1
+    for _ in range(4):
+        svc.flush()
+    assert svc.wal_compactions == 0
+    assert settle(rt, svc.kget(0, "a")) == ("ok", b"1")
+    faults.clear()
+    svc.stop()
+
+
+def test_double_torn_append_repairs_at_true_eof(tmp_path):
+    """Review r15 (reproduced upstream): truncate() does not move
+    the buffered stream position, so without re-anchoring at EOF a
+    SECOND failed append would repair at a stale offset, punching a
+    hole that destroys later fsync-acked records at replay."""
+    p = str(tmp_path / "log")
+    st = PyLogStore(p)
+    st.store("k1", "v1")
+    st.sync()
+    for i in (2, 3):  # two consecutive torn appends, both repaired
+        faults.install(faults.FaultPlan().set_torn_write("wal", 6))
+        with pytest.raises(OSError):
+            st.store(f"k{i}", f"v{i}")
+        faults.clear()
+    assert st.append_repairs == 2
+    st.store("k4", "v4")  # fsync-acked after both repairs
+    st.sync()
+    st.close()
+    st2 = PyLogStore(p)
+    assert st2.fetch("k1") == "v1"
+    assert st2.fetch("k4") == "v4", \
+        "acked record after the second repair lost at replay"
+    assert st2.truncations == 0
+    st2.close()
+
+
+def test_transient_read_corruption_heals_on_retry(tmp_path,
+                                                  monkeypatch):
+    """Review r15: a CRC mismatch from a TRANSIENT bad read (heals
+    on re-read) must not be treated as a torn tail — truncating on
+    it would destroy healthy fsync-acked frames behind it."""
+    p = str(tmp_path / "log")
+    st = PyLogStore(p)
+    st.store("k0", "v0")
+    st.store("k1", "v1")
+    st.sync()
+    st.close()
+    calls = {"n": 0}
+
+    def one_shot_flip(path_class, data):
+        calls["n"] += 1
+        if calls["n"] == 1 and data:
+            out = bytearray(data)
+            out[0] ^= 0x40
+            return bytes(out)
+        return data
+    monkeypatch.setattr(faults, "read_filter", one_shot_flip)
+    st2 = PyLogStore(p)
+    assert st2.read_retries == 1
+    assert st2.truncations == 0, \
+        "transient read error truncated a healthy log"
+    assert st2.fetch("k0") == "v0" and st2.fetch("k1") == "v1"
+    st2.close()
+
+
+# -- crash-point scheduler basics ---------------------------------------------
+
+
+def test_crashpoint_unarmed_is_noop():
+    faults.crashpoint("wal_fsync_pre")  # must not exit this process
+
+
+def test_crashpoint_malformed_nth_disarms_loudly(monkeypatch,
+                                                 capsys):
+    """Review r15: a malformed :nth must not raise inside the
+    durability barrier (WAL lock held, serving loop) — it shouts to
+    stderr once and disarms, the plan()-knob discipline."""
+    monkeypatch.setenv("RETPU_CRASHPOINT", "wal_append:2x")
+    faults.crashpoint("wal_append")  # neither exits nor raises
+    assert "RETPU_CRASHPOINT" not in os.environ
+    assert "malformed" in capsys.readouterr().err
+    faults.crashpoint("wal_append")  # disarmed: clean no-op
+
+
+def test_crashpoint_kills_at_nth_hit():
+    """RETPU_CRASHPOINT=<name>:<nth> terminates the process with
+    CRASH_EXIT at exactly the nth barrier crossing (cheap: the child
+    imports faults alone, no jax)."""
+    child = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from riak_ensemble_tpu import faults\n"
+        "faults.crashpoint('other')\n"
+        "faults.crashpoint('b'); print('one', flush=True)\n"
+        "faults.crashpoint('b'); print('two', flush=True)\n"
+    ) % REPO
+    env = dict(os.environ, RETPU_CRASHPOINT="b:2")
+    p = subprocess.run([sys.executable, "-c", child], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == faults.CRASH_EXIT, (p.returncode, p.stderr)
+    assert "one" in p.stdout and "two" not in p.stdout

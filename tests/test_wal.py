@@ -336,13 +336,37 @@ def test_pylogstore_foreign_prefix_starts_fresh(tmp_path):
     with open(p, "wb") as f:
         f.write(b"NOTAWALFILE")
     st = PyLogStore(p)
+    assert st.quarantines == 1
     st.store("k", "v")
     st.sync()
     st.close()
     st2 = PyLogStore(p)
     assert st2.fetch("k") == "v"
     st2.close()
-    assert os.path.exists(p + ".corrupt")
+    assert os.path.exists(p + ".corrupt.0")
+
+
+def test_pylogstore_second_quarantine_keeps_first_evidence(
+        tmp_path, monkeypatch):
+    """ISSUE 15 satellite: a second corruption must not clobber the
+    first quarantined log — monotonic ``.corrupt.<n>`` suffixes, and
+    the count rides stats() via ServiceWAL."""
+    from riak_ensemble_tpu.synctree import native_store
+
+    monkeypatch.setattr(native_store, "available", lambda: False)
+    p = str(tmp_path / "w" / "wal")
+    for i in (0, 1):
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "wb") as f:
+            f.write(b"GARBAGE-%d" % i)
+        w = ServiceWAL(str(tmp_path / "w"))
+        assert w.stats()["quarantines"] == 1
+        w.close()
+    names = sorted(n for n in os.listdir(tmp_path / "w")
+                   if ".corrupt." in n)
+    assert names == ["wal.corrupt.0", "wal.corrupt.1"]
+    with open(str(tmp_path / "w" / "wal.corrupt.0"), "rb") as f:
+        assert f.read() == b"GARBAGE-0", "first evidence clobbered"
 
 
 def test_buffer_mode_reaches_kernel_before_ack(tmp_path, monkeypatch):

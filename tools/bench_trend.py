@@ -81,6 +81,11 @@ COLUMNS: Tuple[Tuple[str, str], ...] = (
     # >=0.95 = the controller converged within the 5% acceptance
     # band at every link it was measured on)
     ("autotune_vs_best_static", "autotune_x"),
+    # restart-to-serving ms at the 512-ens rung (bench --stage
+    # recovery: checkpoint restore + WAL replay + first-op warmup —
+    # the RTO half of the §15 crash contract.  LOWER is better; the
+    # --check band polices it same-fingerprint like the headline)
+    ("recovery_ms", "recov_ms"),
 )
 
 
@@ -247,6 +252,25 @@ def check(root: str, tolerance: float = 0.5) -> Dict[str, Any]:
                     f"{newest['parsed']['value']:.1f} ops/s is below "
                     f"{tolerance:.0%} of round {best['round']}'s "
                     f"{best_v:.1f} on the same box fingerprint")
+            # recovery_ms ratchet (ISSUE 15): restart-to-serving is
+            # LOWER-is-better, so the band inverts — the newest
+            # same-box point must stay under best/tolerance (2x the
+            # best at the default 0.5).  Rounds predating the stage
+            # (no recovery_ms) neither ratchet nor fail.
+            rec_v = newest["parsed"].get("recovery_ms")
+            rec_same = [r["parsed"]["recovery_ms"] for r in same
+                        if isinstance(r["parsed"].get("recovery_ms"),
+                                      (int, float))]
+            if isinstance(rec_v, (int, float)) and rec_same:
+                best_rec = min(rec_same)
+                report["best_same_box_recovery_ms"] = best_rec
+                report["newest_recovery_ms"] = rec_v
+                if rec_v * tolerance > best_rec:
+                    raise TrendError(
+                        f"out-of-band recovery regression: round "
+                        f"{newest['round']} restart-to-serving "
+                        f"{rec_v:.1f} ms exceeds 1/{tolerance:g} x "
+                        f"the best same-box {best_rec:.1f} ms")
     return report
 
 
