@@ -2596,6 +2596,562 @@ def run_reconfig(seconds: float, smoke: bool) -> dict:
     }
 
 
+# -- §16 compartmentalized serving plane: the ingress rung -------------------
+
+#: loadgen child source (run via ``python -c`` with one JSON argv):
+#: an asyncio herd of simulated client connections importing ONLY the
+#: wire codec — no jax — so thousands of connections cost a subprocess
+#: fork, not an XLA init.  Each connection keeps one slab batch in
+#: flight (closed-loop per connection, open-loop across the herd) and
+#: the child prints ONE JSON tally line.
+_INGRESS_LOADGEN = r'''
+import asyncio, json, struct, sys, time
+
+cfg = json.loads(sys.argv[1])
+sys.path.insert(0, cfg["repo"])
+try:  # the 10k-connection shape needs headroom past the soft FD cap
+    import resource
+    _h = resource.getrlimit(resource.RLIMIT_NOFILE)[1]
+    if _h != resource.RLIM_INFINITY:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (_h, _h))
+except Exception:
+    pass
+from riak_ensemble_tpu import wire
+
+HDR = struct.Struct(">I")
+addrs = [tuple(a) for a in cfg["addrs"]]
+n_ens, k = cfg["n_ens"], cfg["k"]
+mode = cfg["mode"]
+write_every = cfg.get("write_every", 8)
+stagger = cfg.get("stagger", 0.002)
+ramp = cfg.get("ramp", 0.0)
+
+
+def slab(keys):
+    lens = struct.pack("<%di" % len(keys), *[len(s) for s in keys])
+    return lens, "".join(keys).encode("ascii")
+
+
+rlens, rarena = slab(["r%d" % j for j in range(k)])
+wlens, warena = slab(["w%d" % j for j in range(k)])
+vals = [b"v%03d" % j for j in range(k)]
+vlens = struct.pack("<%di" % k, *[len(v) for v in vals])
+varena = b"".join(vals)
+
+tally = {"batches": 0, "read_ops": 0, "write_ops": 0, "rerouted": 0,
+         "soft_errors": 0, "errors": 0}
+lats = []
+t0 = time.monotonic()
+t_start = t0 + ramp
+t_end = t_start + cfg["seconds"]
+
+
+async def one(i):
+    await asyncio.sleep(min(ramp, i * stagger))
+    reader = writer = None
+    for _ in range(200):  # the proxy tier may still be booting
+        try:
+            reader, writer = await asyncio.open_connection(
+                *addrs[i % len(addrs)])
+            break
+        except OSError:
+            await asyncio.sleep(0.05)
+    if writer is None:
+        tally["errors"] += 1
+        return
+    rid = 0
+    try:
+        while time.monotonic() < t_end:
+            rid += 1
+            wr = mode == "mixed" and rid % write_every == 0
+            ens = (i + rid) % n_ens
+            frame = ((rid, "kput_slab", ens, wlens, warena, vlens,
+                      varena) if wr
+                     else (rid, "kget_slab", ens, rlens, rarena))
+            payload = wire.encode(frame)
+            ts = time.monotonic()
+            writer.write(HDR.pack(len(payload)) + payload)
+            await writer.drain()
+            head = await asyncio.wait_for(reader.readexactly(4), 60.0)
+            (n,) = HDR.unpack(head)
+            resp = wire.decode(await asyncio.wait_for(
+                reader.readexactly(n), 60.0))
+            te = time.monotonic()
+            res = resp[1]
+            if not isinstance(res, list):
+                if res == ("error", "not-leader"):
+                    tally["rerouted"] += 1  # replica lease lapsed
+                    await asyncio.sleep(0.005)
+                else:  # whole-batch soft failure (leader re-sync)
+                    tally["soft_errors"] += 1
+                    await asyncio.sleep(0.01)
+                continue
+            ok = sum(1 for r in res
+                     if isinstance(r, tuple) and r and r[0] == "ok")
+            tally["soft_errors"] += len(res) - ok
+            if te < t_start:
+                continue  # ramp: connections still piling on
+            tally["batches"] += 1
+            tally["write_ops" if wr else "read_ops"] += ok
+            if len(lats) < 200000:
+                lats.append(te - ts)
+    except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+            ConnectionError, OSError):
+        tally["errors"] += 1
+    finally:
+        if writer is not None:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+
+async def herd():
+    await asyncio.gather(*(one(i) for i in range(cfg["conns"])))
+
+
+asyncio.run(herd())
+tally["window"] = max(time.monotonic() - t_start, 1e-9)
+lats.sort()
+
+
+def pct(q):
+    if not lats:
+        return None
+    return round(lats[min(len(lats) - 1, int(q * len(lats)))] * 1e3, 3)
+
+
+tally["p50_ms"] = pct(0.50)
+tally["p99_ms"] = pct(0.99)
+print(json.dumps(tally), flush=True)
+'''
+
+
+def _ingress_ask(addr, *frame, timeout=60.0):
+    """One svcnode-protocol round-trip on a fresh socket — the
+    bench's sync control lane (prewrite, serving gates, the fleet
+    scrape)."""
+    import socket as _socket
+    import struct as _struct
+
+    from riak_ensemble_tpu import wire
+
+    hdr = _struct.Struct(">I")
+    with _socket.create_connection(addr, timeout=timeout) as s:
+        s.settimeout(timeout)
+        payload = wire.encode(frame)
+        s.sendall(hdr.pack(len(payload)) + payload)
+        buf = b""
+        while len(buf) < 4:
+            b = s.recv(4 - len(buf))
+            if not b:
+                raise ConnectionError("closed")
+            buf += b
+        (n,) = hdr.unpack(buf)
+        buf = b""
+        while len(buf) < n:
+            b = s.recv(min(1 << 16, n - len(buf)))
+            if not b:
+                raise ConnectionError("closed")
+            buf += b
+        return wire.decode(buf)[1]
+
+
+def _ingress_control(port, frame, timeout=180.0):
+    """Raw repl-port control round-trip (``("promote", peers)``)."""
+    import socket as _socket
+
+    from riak_ensemble_tpu.parallel import repgroup
+
+    with _socket.create_connection(("127.0.0.1", port),
+                                   timeout=timeout) as s:
+        s.settimeout(timeout)
+        repgroup.send_frame(s, frame)
+        return repgroup.recv_frame(s)
+
+
+def _ingress_prewrite(leader, n_ens, k, budget=240.0):
+    """Seed every ensemble's read keys through the fresh leader —
+    doubling as the serving gate (the first writes retry through the
+    post-promote host-quorum heal)."""
+    import struct as _struct
+
+    keys = ["r%d" % j for j in range(k)]
+    lens = _struct.pack("<%di" % k, *[len(s) for s in keys])
+    arena = "".join(keys).encode("ascii")
+    vals = [b"v%03d" % j for j in range(k)]
+    vlens = _struct.pack("<%di" % k, *[len(v) for v in vals])
+    varena = b"".join(vals)
+    deadline = time.monotonic() + budget
+    for e in range(n_ens):
+        while True:
+            try:
+                rs = _ingress_ask(leader, 1, "kput_slab", e, lens,
+                                  arena, vlens, varena)
+            except (ConnectionError, OSError):
+                rs = None
+            if isinstance(rs, list) and all(
+                    isinstance(r, tuple) and r and r[0] == "ok"
+                    for r in rs):
+                break
+            assert time.monotonic() < deadline, \
+                f"ingress prewrite never converged: {rs!r}"
+            time.sleep(0.25)
+
+
+def _ingress_spawn_host(n_ens, n_slots, tmp, i, procs):
+    """One group host OS process for the full-shape arm (its own
+    GIL — ingress scaling is invisible when every tier shares one
+    interpreter), follower reads on, the rung's lease/heartbeat
+    config.  The ready line carries both ports; the child lands in
+    ``procs`` before the parse so it can never leak past the
+    caller's kill sweep."""
+    import textwrap
+    import threading
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child = textwrap.dedent(f"""
+        import os, sys, time
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        sys.path.insert(0, {repo!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_compilation_cache_dir",
+                          {repo!r} + "/.jax_cache")
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 1.0)
+        from riak_ensemble_tpu.config import Config
+        from riak_ensemble_tpu.parallel import repgroup
+        srv = repgroup.ReplicaServer(
+            {n_ens}, 3, {n_slots}, data_dir={tmp!r} + "/r{i}",
+            config=Config(ensemble_tick=0.05, lease_duration=1.5,
+                          probe_delay=0.1, storage_delay=0.005,
+                          storage_tick=0.5, gossip_tick=0.2),
+            follower_reads=True)
+        print("ready repl=%d client=%d"
+              % (srv.repl_port, srv.client_port), flush=True)
+        while True:
+            time.sleep(60)
+    """)
+    p = subprocess.Popen([sys.executable, "-c", child],
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.DEVNULL, text=True, env=env)
+    procs.append(p)
+    line = p.stdout.readline()
+    assert line.startswith("ready"), f"ingress host died: {line!r}"
+    parts = dict(kv.split("=") for kv in line.split()[1:])
+    threading.Thread(target=lambda f=p.stdout: [None for _ in f],
+                     daemon=True).start()
+    return int(parts["repl"]), int(parts["client"])
+
+
+def _ingress_spawn_proxies(count, hosts, procs):
+    """``count`` proxy OS processes fronting the same group; returns
+    (children, client-facing addrs).  Spawned concurrently — each
+    pays a jax import — ready lines parsed after."""
+    import threading
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    up = ",".join(f"{h}:{p}" for h, p in hosts)
+    px = []
+    for _ in range(count):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "riak_ensemble_tpu.proxy",
+             "--port", "0", "--upstream", up,
+             "--discover-timeout", "120"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env)
+        procs.append(p)
+        px.append(p)
+    addrs = []
+    for p in px:
+        line = p.stdout.readline()
+        assert line.startswith("proxy serving on "), \
+            f"ingress proxy died: {line!r}"
+        host, _, port = line.split()[3].rpartition(":")
+        addrs.append((host, int(port)))
+        threading.Thread(target=lambda f=p.stdout: [None for _ in f],
+                         daemon=True).start()
+    return px, addrs
+
+
+def _ingress_loadgens(cfgs, procs, budget):
+    """Run the loadgen herd children to completion; one parsed tally
+    per child."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ,
+               PYTHONPATH=repo + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    kids = []
+    for c in cfgs:
+        p = subprocess.Popen(
+            [sys.executable, "-c", _INGRESS_LOADGEN, json.dumps(c)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env)
+        procs.append(p)
+        kids.append(p)
+    out = []
+    for p in kids:
+        stdout, stderr = p.communicate(timeout=budget)
+        assert p.returncode == 0, \
+            f"ingress loadgen died: {stderr[-400:]}"
+        out.append(json.loads(stdout.strip().splitlines()[-1]))
+    return out
+
+
+def _ingress_tally(results):
+    """Fold per-child tallies into one arm record: counts sum, the
+    window is the slowest child's (rates stay conservative), the
+    latency columns are the worst any child observed."""
+    window = max(r["window"] for r in results)
+    agg = {key: sum(r[key] for r in results)
+           for key in ("batches", "read_ops", "write_ops",
+                       "rerouted", "soft_errors", "errors")}
+    p50 = [r["p50_ms"] for r in results if r["p50_ms"] is not None]
+    p99 = [r["p99_ms"] for r in results if r["p99_ms"] is not None]
+    return {
+        "batches_per_sec": round(agg["batches"] / window, 1),
+        "read_ops_per_sec": round(agg["read_ops"] / window, 1),
+        "write_ops_per_sec": round(agg["write_ops"] / window, 1),
+        "client_p50_ms": max(p50) if p50 else None,
+        "client_p99_ms": max(p99) if p99 else None,
+        "rerouted": agg["rerouted"],
+        "soft_errors": agg["soft_errors"],
+        "errors": agg["errors"],
+    }
+
+
+def _ingress_engine_p99(fm):
+    """Worst engine-tier ``retpu_op_latency_ms`` p99 across the fleet
+    snapshot (the PR 8 op rings; base series plus labeled tenants)."""
+    best = None
+    hosts = fm.get("hosts") if isinstance(fm, dict) else None
+    for snap in (hosts or {}).values():
+        h = snap.get("retpu_op_latency_ms") \
+            if isinstance(snap, dict) else None
+        if not isinstance(h, dict):
+            continue
+        for hh in [h] + list((h.get("by_label") or {}).values()):
+            v = hh.get("p99") if isinstance(hh, dict) else None
+            if isinstance(v, (int, float)) and v == v \
+                    and (best is None or v > best):
+                best = float(v)
+    return best
+
+
+def _ingress_follower_served(fm):
+    """Every host's ``retpu_group_follower_reads_served`` summed out
+    of the fleet snapshot — the replicas' own proof the spread arm
+    was served from mirrors, riding the same single pull."""
+    total = 0
+    hosts = fm.get("hosts") if isinstance(fm, dict) else None
+    for snap in (hosts or {}).values():
+        v = snap.get("retpu_group_follower_reads_served") \
+            if isinstance(snap, dict) else None
+        if isinstance(v, dict):
+            v = sum(x for x in v.values()
+                    if isinstance(x, (int, float)))
+        if isinstance(v, (int, float)):
+            total += int(v)
+    return total
+
+
+def run_ingress(seconds: float, smoke: bool) -> dict:
+    """§16 serving-plane rung: proxy-count ingress scaling and the
+    follower-read A/B against ONE promoted 3-host replication group.
+
+    Two interleaved A/Bs ride the round JSON:
+
+    - **ingress scaling** — an open-loop herd of simulated client
+      connections drives mixed slab batches through 1 vs N stateless
+      proxies (each its own OS process, svcnode wire protocol, one
+      scatter-gather hop per batch); acceptance wants the
+      client-batch ingestion rate to scale >= 1.5x from 1 -> 4
+      proxies at the round shape while write throughput (quorum-
+      bound at the leader — proxies can't help it) holds within 10%.
+    - **follower reads** — the same read workload aimed at the
+      leader alone vs spread over all three hosts with replica-
+      served leased reads answering from delta-maintained mirrors;
+      acceptance wants >= 1.8x read throughput on the 3-host group.
+
+    Per-tier evidence: client-observed p50/p99 from the herd (the
+    ingress tier) and the engine-tier ``retpu_op_latency_ms`` p99
+    from the PR 8 op rings — every host's registry scraped in ONE
+    ``("fleet", "metrics")`` pull off the leader (§11), which also
+    carries the replicas' follower-read counters.
+
+    The smoke shape keeps the GROUP in process (threaded hosts,
+    shared jit cache — the tier-1 budget) with proxies and loadgens
+    as real subprocesses; its ratios are structural sanity, not a
+    measure (every smoke host shares one GIL).  The full shape runs
+    3 host processes, (1, 4) proxy processes and an 8-child herd
+    sized 10k+ connections (capped to the box's FD budget)."""
+    import shutil
+    import statistics
+    import tempfile
+
+    if smoke:
+        n_ens, n_slots, k = 8, 16, 4
+        proxy_counts, reps, gens, gens_flw = (1, 2), 1, 2, 1
+        conns, flw_conns = 16, 9
+        measure = max(0.5, min(seconds, 1.0))
+    else:
+        n_ens, n_slots, k = 32, 32, 8
+        proxy_counts, reps, gens, gens_flw = (1, 4), 2, 8, 2
+        try:
+            import resource
+            hard = resource.getrlimit(resource.RLIMIT_NOFILE)[1]
+            cap = 10_000 if hard == resource.RLIM_INFINITY \
+                else max(512, (hard - 512) // 2)
+        except Exception:
+            cap = 10_000
+        conns, flw_conns = min(10_000, cap), 48
+        measure = max(5.0, seconds)
+
+    tmp = tempfile.mkdtemp(prefix="bench_ingress_")
+    procs: list = []
+    srvs: list = []
+    try:
+        # -- one 3-host group, host 0 promoted -------------------------
+        if smoke:
+            from riak_ensemble_tpu.config import Config
+            from riak_ensemble_tpu.parallel import repgroup
+            cfg = Config(ensemble_tick=0.05, lease_duration=1.5,
+                         probe_delay=0.1, storage_delay=0.005,
+                         storage_tick=0.5, gossip_tick=0.2)
+            srvs = [repgroup.ReplicaServer(
+                n_ens, 3, n_slots, data_dir=f"{tmp}/r{i}",
+                config=cfg, follower_reads=True) for i in range(3)]
+            ports = [(s.repl_port, s.client_port) for s in srvs]
+        else:
+            ports = [_ingress_spawn_host(n_ens, n_slots, tmp, i,
+                                         procs) for i in range(3)]
+        repl_ports = [r for r, _c in ports]
+        hosts = [("127.0.0.1", c) for _r, c in ports]
+        leader = hosts[0]
+        resp = _ingress_control(
+            repl_ports[0],
+            ("promote", [("127.0.0.1", p) for p in repl_ports[1:]]))
+        assert resp[0] == "ok", f"ingress promote failed: {resp!r}"
+        _ingress_prewrite(leader, n_ens, k)
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+
+        def herd_cfg(addrs, n, mode, ramp):
+            return dict(repo=repo, addrs=[list(a) for a in addrs],
+                        conns=n, seconds=measure, mode=mode,
+                        write_every=8, n_ens=n_ens, k=k, ramp=ramp,
+                        stagger=0.002)
+
+        # -- A/B 1: ingress scaling, arm order mirrored per rep --------
+        order = []
+        for r in range(reps):
+            order += list(proxy_counts if r % 2 == 0
+                          else tuple(reversed(proxy_counts)))
+        arm_recs = {p: [] for p in proxy_counts}
+        for count in order:
+            px, paddrs = _ingress_spawn_proxies(count, hosts, procs)
+            per = max(1, conns // gens)
+            ramp = min(2.0, per * 0.002)
+            res = _ingress_loadgens(
+                [herd_cfg(paddrs, per, "mixed", ramp)
+                 for _ in range(gens)],
+                procs, budget=measure + ramp + 180.0)
+            arm = _ingress_tally(res)
+            arm["conns"] = per * gens
+            arm_recs[count].append(arm)
+            for p in px:
+                p.kill()
+
+        arms = {}
+        for count in proxy_counts:
+            a = dict(arm_recs[count][-1])
+            for key in ("batches_per_sec", "read_ops_per_sec",
+                        "write_ops_per_sec"):
+                a[key] = statistics.median(
+                    rec[key] for rec in arm_recs[count])
+            arms[str(count)] = a
+        lo, hi = str(min(proxy_counts)), str(max(proxy_counts))
+        ingress_x = round(arms[hi]["batches_per_sec"]
+                          / max(arms[lo]["batches_per_sec"], 1e-9), 3)
+        w_lo = arms[lo]["write_ops_per_sec"]
+        write_hold = round(arms[hi]["write_ops_per_sec"] / w_lo, 3) \
+            if w_lo > 0 else None
+
+        # -- A/B 2: follower-served reads, arm order mirrored ----------
+        # gate: both replicas must hold a live lease before the
+        # spread arm measures (grants rode the prewrite settles; the
+        # idle leader's heartbeats renew them)
+        deadline = time.monotonic() + 60.0
+        for addr in hosts[1:]:
+            while _ingress_ask(addr, 0, "kget", 0, "r0") == \
+                    ("error", "not-leader"):
+                assert time.monotonic() < deadline, \
+                    "follower lease never arrived"
+                time.sleep(0.25)
+        flw_recs = {"leader_only": [], "followers": []}
+        flw_order = []
+        for r in range(reps):
+            pair = ["leader_only", "followers"]
+            flw_order += pair if r % 2 == 0 else pair[::-1]
+        for name in flw_order:
+            addrs = [leader] if name == "leader_only" else hosts
+            per = max(1, flw_conns // gens_flw)
+            res = _ingress_loadgens(
+                [herd_cfg(addrs, per, "read", 0.1)
+                 for _ in range(gens_flw)],
+                procs, budget=measure + 180.0)
+            flw_recs[name].append(_ingress_tally(res))
+        flw = {}
+        for name, recs in flw_recs.items():
+            rec = dict(recs[-1])
+            rec["read_ops_per_sec"] = statistics.median(
+                r["read_ops_per_sec"] for r in recs)
+            rec["conns"] = max(1, flw_conns // gens_flw) * gens_flw
+            flw[name] = rec
+        follower_x = round(
+            flw["followers"]["read_ops_per_sec"]
+            / max(flw["leader_only"]["read_ops_per_sec"], 1e-9), 3)
+
+        # -- per-tier evidence: ONE fleet pull off the leader ----------
+        fm = _ingress_ask(leader, 1, "fleet", "metrics", timeout=120.0)
+        return {
+            "ingress_x": ingress_x,
+            "ingress_write_hold": write_hold,
+            "ingress_arms": arms,
+            "ingress_conns": conns,
+            "ingress_engine_p99_ms": _ingress_engine_p99(fm),
+            "follower_read_x": follower_x,
+            "follower_read_arms": flw,
+            "follower_reads_served_total": _ingress_follower_served(fm),
+            "ingress_shape": {
+                "n_ens": n_ens, "n_slots": n_slots, "k": k,
+                "proxies": list(proxy_counts), "reps": reps,
+                "measure_s": measure, "smoke": smoke},
+        }
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+            except Exception:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        for s in srvs:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 #: fallback ladder: (label, shapes, per-stage subprocess timeout).
 #: Full TPU shapes first; smaller shapes if the backend is too slow to
 #: compile/run the big ones; forced-CPU small shapes as the last
@@ -2701,6 +3257,8 @@ def _stage_entry(args) -> None:
         out = run_fleet_obs_overhead(args.seconds)
     elif args.stage == "recovery":
         out = run_recovery(args.seconds, smoke=False)
+    elif args.stage == "ingress":
+        out = run_ingress(args.seconds, smoke=False)
     elif args.stage == "merkle":
         m = run_merkle(args.seconds, smoke=False)
         out = {"ladder_metric": m["metric"], "ladder_value": m["value"]}
@@ -2732,7 +3290,8 @@ def main() -> None:
                     choices=("kernel", "service", "merkle", "reconfig",
                              "probe", "stepprobe", "repgroup",
                              "widecmp", "escale", "faultsweep",
-                             "autotune", "fleetobs", "recovery"),
+                             "autotune", "fleetobs", "recovery",
+                             "ingress"),
                     help="internal: run one stage in-process")
     ap.add_argument("--n-ens", type=int, default=10_000)
     ap.add_argument("--n-peers", type=int, default=5)
@@ -2772,6 +3331,7 @@ def main() -> None:
         svc.update(run_autotune(secs, smoke=True))
         svc.update(run_fleet_obs_overhead(secs))
         svc.update(run_recovery(secs, smoke=True))
+        svc.update(run_ingress(secs, smoke=True))
         svc["platform"] = "smoke"
         svc["bench_trend"] = trend
         label = "64_ens_5_peers_smoke"
@@ -2882,6 +3442,17 @@ def main() -> None:
             if r is not None:
                 svc.update({k: v for k, v in r.items()
                             if k.startswith("recovery_")})
+            # §16 serving-plane rung: proxy-count ingress scaling +
+            # the follower-read A/B over a real 3-process group with
+            # subprocess proxies and a 10k-connection client herd —
+            # sockets + GIL-bound parsing, so it rides whatever
+            # platform the headline took
+            r = _run_stage("ingress", label, {}, args.seconds,
+                           600.0, force_cpu)
+            if r is not None:
+                svc.update({k: v for k, v in r.items()
+                            if k.startswith(("ingress_",
+                                             "follower_"))})
             # E-scaling datapoints (ROADMAP carried debt item 2): the
             # 1k-ens CPU rung always rides the round JSON; the 2k-
             # and 4k-ens points land when the box completes them

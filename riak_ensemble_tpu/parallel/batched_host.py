@@ -82,6 +82,7 @@ import os
 import random
 import time
 from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -773,6 +774,14 @@ class BatchedEnsembleService:
         #: read fast-path observability
         self.read_fastpath_hits = 0
         self.read_fastpath_misses = 0
+        #: network front-end backpressure events, incremented by
+        #: whatever server fronts this service (svcnode.ServiceServer,
+        #: the proxy tier): a client stalled at the per-connection
+        #: inflight cap, or dropped because its reply buffer passed
+        #: the write cap — the evidence row behind
+        #: retpu_svc_backpressure_total
+        self.svc_backpressure: Dict[str, int] = {
+            "inflight_stalls": 0, "write_buf_drops": 0}
         self.read_fastpath_miss_reasons: Dict[str, int] = {}
         self.flushes = 0
         self.ops_served = 0
@@ -975,6 +984,22 @@ class BatchedEnsembleService:
         #: measurable (stats()["completion_slab"])
         self.completion_wakes = 0
         self.completion_rows = 0
+        #: sharded resolve/enqueue workers (RETPU_RESOLVE_SHARDS,
+        #: default 1 = the single-threaded path, the bit-identical
+        #: oracle arm; docs/ARCHITECTURE.md §16).  >1 partitions the
+        #: per-flush host bookkeeping — pending-slab build,
+        #: completion-slab gather, mirror scatter — by contiguous
+        #: run-descriptor/column range across a small thread pool.
+        #: Every chunk writes/reads disjoint plane cells or mirror
+        #: rows, so the sharded result is state-identical to the
+        #: serial walk; the native kernels release the GIL, which is
+        #: where the parallelism comes from.  The pool is lazy
+        #: (created on the first sharded flush) and torn down in
+        #: stop().
+        self._resolve_shards = max(1, int(
+            os.environ.get("RETPU_RESOLVE_SHARDS", "1") or "1"))
+        self._resolve_pool: Optional[ThreadPoolExecutor] = None
+        self.sharded_flushes = 0
         self.obs_registry = obs.MetricsRegistry()
         self.flight = obs.FlightRecorder(name="svc")
         self._h_flush = self.obs_registry.histogram(
@@ -2489,6 +2514,40 @@ class BatchedEnsembleService:
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
+        if self._resolve_pool is not None:
+            self._resolve_pool.shutdown(wait=True)
+            self._resolve_pool = None
+
+    # -- sharded resolve/enqueue workers (ARCHITECTURE §16) ---------------
+
+    def _shard_bounds(self, n: int):
+        """Contiguous [lo, hi) chunk bounds partitioning ``n`` run
+        descriptors (or taken columns) across the worker pool, or
+        ``None`` when sharding is off or pointless — the caller then
+        takes the untouched single-threaded path.  Chunks are
+        descriptor-granular: a descriptor's lane run never splits, so
+        each chunk touches a disjoint set of plane cells."""
+        s = self._resolve_shards
+        if s <= 1 or n <= 1:
+            return None
+        s = min(s, n)
+        step = -(-n // s)
+        return [(lo, min(lo + step, n)) for lo in range(0, n, step)]
+
+    def _shard_map(self, fn, bounds) -> list:
+        """Run ``fn(lo, hi)`` over every chunk, tail chunks on the
+        pool and the first on the calling thread, and return results
+        in chunk order (the order invariant every sharded site's
+        concatenation relies on)."""
+        if self._resolve_pool is None:
+            self._resolve_pool = ThreadPoolExecutor(
+                max_workers=self._resolve_shards,
+                thread_name_prefix="retpu-resolve")
+        futs = [self._resolve_pool.submit(fn, lo, hi)
+                for lo, hi in bounds[1:]]
+        out = [fn(*bounds[0])]
+        out.extend(f.result() for f in futs)
+        return out
 
     # -- checkpoint / resume -----------------------------------------------
 
@@ -3721,6 +3780,7 @@ class BatchedEnsembleService:
         return {
             "flushes": self.flushes,
             "ops_served": self.ops_served,
+            "svc_backpressure": dict(self.svc_backpressure),
             "corruptions_detected": self.corruptions,
             "replicas_repaired": self.repairs,
             "live_payloads": len(self.values),
@@ -3795,6 +3855,12 @@ class BatchedEnsembleService:
             "completion_slab": {
                 "wakes": self.completion_wakes,
                 "rows": self.completion_rows,
+            },
+            # sharded resolve/enqueue workers (ARCHITECTURE §16):
+            # pool width and how many flushes actually chunked
+            "resolve_shards": {
+                "shards": self._resolve_shards,
+                "sharded_flushes": self.sharded_flushes,
             },
         }
 
@@ -4074,6 +4140,10 @@ class BatchedEnsembleService:
             "retpu_read_fastpath_misses_total": fam(
                 "counter", "fast-path fallbacks to the device round",
                 self.read_fastpath_misses),
+            "retpu_svc_backpressure_total": obs.registry.family(
+                "counter", "front-end backpressure events (inflight-"
+                "cap stalls, slow-reader write-buffer drops)",
+                dict(self.svc_backpressure), label="kind"),
             "retpu_rmw_conflicts_total": fam(
                 "counter", "host-path kmodify CAS retries",
                 self.rmw_conflicts),
@@ -5028,11 +5098,34 @@ class BatchedEnsembleService:
             l_val = np.asarray(val_l, np.int32)
             l_expe = np.asarray(expe_l, np.int32)
             l_exps = np.asarray(exps_l, np.int32)
-            native_pack = (self._native_enqueue is not None
-                           and self._native_enqueue.pack(
-                               k, self.n_ens, ec, er, el, ek,
-                               l_slot, l_val, l_expe, l_exps, kind,
-                               slot, val, exp_e, exp_s))
+            bounds = self._shard_bounds(len(ec))
+            if self._native_enqueue is None:
+                native_pack = False
+            elif bounds is None:
+                native_pack = self._native_enqueue.pack(
+                    k, self.n_ens, ec, er, el, ek,
+                    l_slot, l_val, l_expe, l_exps, kind,
+                    slot, val, exp_e, exp_s)
+            else:
+                # sharded pending-slab build (ARCHITECTURE §16): each
+                # chunk's descriptors write disjoint [K, E] cells
+                # (rows [er, er+el) of their columns), so concurrent
+                # packs into the shared planes never overlap; lanes
+                # slice at the chunk's global offset because pack
+                # consumes them in descriptor order
+                def _pack_chunk(lo, hi):
+                    s = offs[lo]
+                    t = offs[hi] if hi < len(offs) else lane_n
+                    return self._native_enqueue.pack(
+                        k, self.n_ens, ec[lo:hi], er[lo:hi],
+                        el[lo:hi], ek[lo:hi], l_slot[s:t],
+                        l_val[s:t], l_expe[s:t], l_exps[s:t],
+                        kind, slot, val, exp_e, exp_s)
+                # a chunk falling back is harmless: the numpy rewrite
+                # below re-fills EVERY cell with identical values
+                native_pack = all(self._shard_map(_pack_chunk,
+                                                  bounds))
+                self.sharded_flushes += 1
             if not native_pack:
                 rows, cols = _lane_indices(ec, er, el)
                 kind[rows, cols] = np.repeat(ek, el)
@@ -5796,8 +5889,39 @@ class BatchedEnsembleService:
         per-op oracle loops."""
         committed, get_ok, found, value, vsn = planes
         ent_col, ent_row0, ent_len, n_rows, offs = lanes[:5]
-        got = None
-        if self._native_enqueue is not None:
+        got = lists = None
+        bounds = (self._shard_bounds(len(ent_col))
+                  if self._native_enqueue is not None else None)
+        if bounds is not None:
+            # sharded completion-slab gather (ARCHITECTURE §16):
+            # chunk [lo, hi) covers global slab rows [offs[lo],
+            # offs[hi]) — the native gather AND its bulk tolist run
+            # per chunk on the pool (both drop the GIL in C); the
+            # main thread concatenates in chunk order, so the lanes
+            # and lists are element-identical to the serial gather
+            cm_u8, gk_u8, fn_u8 = (_u8view(committed),
+                                   _u8view(get_ok), _u8view(found))
+            val_c = np.ascontiguousarray(value, np.int32)
+            vsn_c = np.ascontiguousarray(vsn, np.int32)
+            n_desc = len(ent_col)
+
+            def _gather_chunk(lo, hi):
+                s = offs[lo]
+                t = offs[hi] if hi < n_desc else n_rows
+                g = self._native_enqueue.gather(
+                    len(committed), committed.shape[1],
+                    ent_col[lo:hi], ent_row0[lo:hi], ent_len[lo:hi],
+                    cm_u8, gk_u8, fn_u8, val_c, vsn_c, t - s)
+                return (g, [a.tolist() for a in g]) \
+                    if g is not None else None
+
+            chunks = self._shard_map(_gather_chunk, bounds)
+            if all(c is not None for c in chunks):
+                got = tuple(np.concatenate([c[0][i] for c in chunks])
+                            for i in range(5))
+                lists = [sum((c[1][i] for c in chunks), [])
+                         for i in range(5)]
+        if got is None and self._native_enqueue is not None:
             got = self._native_enqueue.gather(
                 len(committed), committed.shape[1], ent_col,
                 ent_row0, ent_len, _u8view(committed),
@@ -5814,11 +5938,11 @@ class BatchedEnsembleService:
         # (bulk C tolist); every entry below slices plain lists —
         # the per-entry numpy slice + tolist pairs of the oracle
         # loops are gone entirely
-        ok_l = ok_lane.tolist()
-        gok_l = gok_lane.tolist()
-        fnd_l = fnd_lane.tolist()
-        val_l = val_lane.tolist()
-        vs_l = vsn_lane.tolist()
+        if lists is None:
+            lists = [ok_lane.tolist(), gok_lane.tolist(),
+                     fnd_lane.tolist(), val_lane.tolist(),
+                     vsn_lane.tolist()]
+        ok_l, gok_l, fnd_l, val_l, vs_l = lists
         self.completion_wakes += 1
         self.completion_rows += n_rows
         served = 0
@@ -6154,14 +6278,36 @@ class BatchedEnsembleService:
             kcounts = np.fromiter(
                 (sum(op.n for op in ops) for _e, ops in taken),
                 np.int32, n_cols)
-            native_mirrors = self._native_resolve.scatter_mirrors(
-                self.n_ens, self.n_slots, op_planes[0], op_planes[1],
-                committed, get_ok, found, value, vsn, cols, kcounts,
-                ack_reads,
-                (eng.OP_PUT, eng.OP_CAS, eng.OP_GET, eng.OP_RMW),
-                self._slot_vsn_np, self._slot_vsn_ok,
-                self._inline_value_np, self._inline_value_ok,
-                self._inline_np)
+            bounds = self._shard_bounds(n_cols)
+            if bounds is None:
+                native_mirrors = self._native_resolve.scatter_mirrors(
+                    self.n_ens, self.n_slots, op_planes[0],
+                    op_planes[1], committed, get_ok, found, value,
+                    vsn, cols, kcounts, ack_reads,
+                    (eng.OP_PUT, eng.OP_CAS, eng.OP_GET, eng.OP_RMW),
+                    self._slot_vsn_np, self._slot_vsn_ok,
+                    self._inline_value_np, self._inline_value_ok,
+                    self._inline_np)
+            else:
+                # sharded mirror scatter (ARCHITECTURE §16): chunks
+                # partition the taken COLUMNS, and every ensemble
+                # column appears in `taken` at most once, so chunk
+                # writes land on disjoint mirror rows; a chunk that
+                # falls back just leaves its rows for the Python
+                # mirror walk (state-identical either way)
+                def _scatter_chunk(lo, hi):
+                    return self._native_resolve.scatter_mirrors(
+                        self.n_ens, self.n_slots, op_planes[0],
+                        op_planes[1], committed, get_ok, found,
+                        value, vsn, cols[lo:hi], kcounts[lo:hi],
+                        ack_reads,
+                        (eng.OP_PUT, eng.OP_CAS, eng.OP_GET,
+                         eng.OP_RMW),
+                        self._slot_vsn_np, self._slot_vsn_ok,
+                        self._inline_value_np, self._inline_value_ok,
+                        self._inline_np)
+                native_mirrors = all(self._shard_map(_scatter_chunk,
+                                                     bounds))
             if native_mirrors and rec is not None:
                 dt = time.perf_counter() - t0
                 rec["resolve_native"] = rec.get("resolve_native",

@@ -150,6 +150,7 @@ from riak_ensemble_tpu.ops import engine as eng
 from riak_ensemble_tpu.parallel.batched_host import (
     BatchedEnsembleService, WallRuntime, _PendingBatch,
     warmup_kernels)
+from riak_ensemble_tpu.types import NOTFOUND
 
 _HDR = struct.Struct(">I")
 #: install frames carry full engine-state snapshots
@@ -837,6 +838,98 @@ class ReplicaCore:
         #: hook: the owning server mirrors config changes into its
         #: failover peer list (set by ReplicaServer)
         self.on_cfg = None
+        #: follower-served leased reads (docs/ARCHITECTURE.md §16):
+        #: this lane may answer keyed reads from its delta-maintained
+        #: mirrors until ``serve_until`` (monotonic, this host's
+        #: clock).  The window derives ONLY from lease grants the
+        #: leader ships inside abatch frames — each grant names the
+        #: highest ack seq the leader counted inside a quorum-
+        #: confirmed settle, and the window anchors at THIS lane's
+        #: own send time of that ack (causally before the leader's
+        #: receive, so the window always expires inside the leader's
+        #: write fence for this address).
+        self.serve_until = 0.0
+        self.confirmed_seq = 0
+        #: (seq, anchor_t, touched-cols-or-None) acks awaiting their
+        #: grant; None cols = a full-plane entry (blocks every
+        #: ensemble until confirmed)
+        self._flw_anchors: "deque[Tuple[int, float, Any]]" = deque()
+        self._flw_anchor_top = 0
+        self._flw_unconf: set = set()
+        self._flw_unconf_all = False
+        #: per-frame collector the apply paths feed touched ensemble
+        #: columns into (None while the frame carries no grants —
+        #: the follower-reads-off arm records nothing)
+        self._flw_collect: Optional[set] = None
+        self._flw_collect_all = False
+
+    # -- follower-served leased reads (docs/ARCHITECTURE.md §16) --------
+
+    def _flw_drop(self) -> None:
+        """Revoke the serve window and every pending anchor — called
+        BEFORE this lane grants a higher promise, acks a config
+        record, installs a snapshot, or steps into leadership, so no
+        follower-served read can outlive the fencing event."""
+        self.serve_until = 0.0
+        self._flw_anchors.clear()
+        self._flw_anchor_top = self.applied_seq
+        self._flw_unconf = set()
+        self._flw_unconf_all = False
+
+    def _flw_note_ack(self, cols: Any, grants: Any) -> None:
+        """Record the ack about to go on the wire as a lease anchor
+        (the anchor time is taken BEFORE the send, so it lower-bounds
+        the leader's receive time), then consume any grant addressed
+        to this lane.  A grant for seq G proves the leader counted
+        our FIRST ack for G inside a quorum-confirmed settle within
+        that batch's ack deadline, so [anchor(G), anchor(G)+lease) is
+        strictly inside the leader's write fence for this address;
+        re-acks of an already-anchored seq are ignored (only the
+        first instance is provably the one the settle counted)."""
+        now = time.monotonic()
+        if self.applied_seq > self._flw_anchor_top:
+            self._flw_anchors.append((self.applied_seq, now, cols))
+            self._flw_anchor_top = self.applied_seq
+        me = getattr(self.svc, "self_addr", None)
+        g = -1
+        if grants and me is not None:
+            for h, p, s in grants:
+                if (str(h), int(p)) == me:
+                    g = int(s)
+                    break
+        if g >= 0:
+            best = None
+            while self._flw_anchors and self._flw_anchors[0][0] <= g:
+                best = self._flw_anchors.popleft()
+            if best is not None:
+                self.serve_until = max(
+                    self.serve_until,
+                    best[1] + self.svc.config.lease())
+            self.confirmed_seq = max(self.confirmed_seq, g)
+        # visibility gate: ensembles touched by applied-but-not-yet-
+        # confirmed entries must not serve — a follower read could
+        # otherwise observe a write BEFORE the leader's own settle
+        # acks it, and a later leader read might miss it (the
+        # time-travel anomaly)
+        self._flw_unconf_all = any(a[2] is None
+                                   for a in self._flw_anchors)
+        cols_u: set = set()
+        if not self._flw_unconf_all:
+            for a in self._flw_anchors:
+                cols_u.update(a[2])
+        self._flw_unconf = cols_u
+
+    def _flw_serve_ok(self, ens: int) -> bool:
+        """May this follower answer a keyed read of ensemble ``ens``
+        from its mirrors right now?  Window valid (with the same
+        safety margin the leader-side fast path applies) AND no
+        applied-but-unconfirmed entry touches the ensemble."""
+        now = time.monotonic()
+        if now + self.svc._read_margin >= self.serve_until:
+            return False
+        if self._flw_unconf_all or ens in self._flw_unconf:
+            return False
+        return True
 
     def _obs_role(self) -> str:
         """This lane's span-store role: "replica" plus the lane tag
@@ -858,6 +951,11 @@ class ReplicaCore:
         with (meta_lock if meta_lock is not None
               else contextlib.nullcontext()):
             if ge > self.promised:
+                # read-lease fence (§16): every follower-served read
+                # window this lane holds dies BEFORE the grant goes
+                # out — the new leader's first write can't race a
+                # stale-lease read
+                self._flw_drop()
                 self.promised = ge
                 save_group_meta(self.svc, self.promised,
                                 self.applied_ge, self.applied_seq,
@@ -900,11 +998,20 @@ class ReplicaCore:
         run; full-plane entries re-execute through the plain launch
         halves.  One cumulative ack (the chained per-entry CRCs)
         covers the whole frame."""
-        _, ge, entries = frame
+        _, ge, entries = frame[:3]
+        # optional 4th field (follower-read leader): sorted
+        # (host, port, seq) grant triples — absent on the wire when
+        # the leader runs with follower reads off, which keeps that
+        # arm's frames byte-identical to HEAD
+        grants = frame[3] if len(frame) > 3 else None
+        self._flw_collect = set() if grants is not None else None
+        self._flw_collect_all = False
         if ge != self.promised or ge < self.applied_ge:
             return ("nack", "epoch", self.promised, self.applied_ge,
                     self.applied_seq)
         if not entries:
+            if grants is not None:
+                self._flw_note_ack(set(), grants)
             return ("applied", ge, self.applied_seq, self.last_crc)
         if ge == self.applied_ge \
                 and int(entries[-1][1]) <= self.applied_seq:
@@ -912,6 +1019,11 @@ class ReplicaCore:
             # anything partially behind is a protocol break — nack
             # and let the leader re-sync
             if int(entries[-1][1]) == self.applied_seq:
+                if grants is not None:
+                    # a re-ack never re-anchors (dedup by seq inside
+                    # _flw_note_ack) but grants riding the frame
+                    # still confirm earlier anchors
+                    self._flw_note_ack(set(), grants)
                 return ("applied", ge, self.applied_seq, self.last_crc)
             return ("nack", "seq", self.promised, self.applied_ge,
                     self.applied_seq)
@@ -920,6 +1032,8 @@ class ReplicaCore:
         while i < n:
             ent = entries[i]
             if int(ent[1]) != self.applied_seq + 1:
+                if grants is not None:
+                    self._flw_drop()
                 return ("nack", "seq", self.promised, self.applied_ge,
                         self.applied_seq)
             if ent[0] == "f":
@@ -937,14 +1051,25 @@ class ReplicaCore:
                     nxt += 1
                 crcs = self._apply_delta_run(ge, entries[i:j])
                 if crcs is None:
+                    if grants is not None:
+                        self._flw_drop()
                     return ("nack", "crc", self.promised,
                             self.applied_ge, self.applied_seq)
                 for c in crcs:
                     combined = _crc_chain(combined, c)
                 i = j
             else:
+                if grants is not None:
+                    self._flw_drop()
                 return ("nack", "bad-entry", self.promised,
                         self.applied_ge, self.applied_seq)
+        if grants is not None:
+            # anchor this ack before it hits the wire; a full-plane
+            # entry anywhere in the frame gates EVERY ensemble until
+            # the leader confirms it (cols=None)
+            self._flw_note_ack(
+                None if self._flw_collect_all else self._flw_collect,
+                grants)
         return ("applied", ge, self.applied_seq, combined)
 
     def _apply_delta_run(self, ge: int,
@@ -1077,6 +1202,10 @@ class ReplicaCore:
             self.applied_ge, self.applied_seq = int(ge), int(seq)
             self.last_crc = int(crc_ship)
             crcs.append(int(crc_ship))
+        if self._flw_collect is not None:
+            # follower-read visibility gate: these ensembles now hold
+            # applied-but-unconfirmed writes
+            self._flw_collect.update(np.nonzero(touched)[0].tolist())
         t_applied = time.perf_counter()
         marks: Dict[str, float] = {}
         if final:
@@ -1132,6 +1261,9 @@ class ReplicaCore:
     def _apply_full_entry(self, ge: int, ent: Tuple) -> int:
         (_, seq, k, want_vsn, elect_b, lease_b, kind_b, slot_b,
          val_b, exp_e_b, exp_s_b, meta, fid) = ent
+        # full-plane entries can touch any ensemble — gate every
+        # follower read until the leader confirms this frame
+        self._flw_collect_all = True
         t_start = time.perf_counter()
         svc = self.svc
         e_n = svc.n_ens
@@ -1290,6 +1422,9 @@ class ReplicaCore:
         if seq != self.applied_seq + 1:
             return ("nack", "seq", self.promised, self.applied_ge,
                     self.applied_seq)
+        # lifecycle records never carry grants and their mutations are
+        # not anchor-gated — the read window dies with them (rare)
+        self._flw_drop()
         if kind == "create":
             view = (None if view_b is None
                     else _unpack_bool(view_b, svc.n_peers))
@@ -1314,6 +1449,9 @@ class ReplicaCore:
         if ge < self.promised:
             return ("nack", "epoch", self.promised, self.applied_ge,
                     self.applied_seq)
+        # a snapshot install replaces the mirrors wholesale — any
+        # outstanding read window is fenced out with it
+        self._flw_drop()
         install_state(self.svc, dump)
         if len(frame) > 4:
             # the snapshot's config is part of the state at (ge, seq)
@@ -1350,6 +1488,9 @@ class ReplicaCore:
         if seq != self.applied_seq + 1:
             return ("nack", "seq", self.promised, self.applied_ge,
                     self.applied_seq)
+        # read-lease fence (§16): no config record acks while this
+        # lane could still serve reads under the OLD membership
+        self._flw_drop()
         self.set_cfg((int(cver), _norm_addrs(hosts),
                       _norm_addrs(joint)))
         self.applied_ge, self.applied_seq = ge, seq
@@ -1383,6 +1524,9 @@ class ReplicaCore:
                     self.applied_seq)
         applied = [tuple(a) for a in applied]
         crc = record_digest((a[1], a[2], a[3], a[4]) for a in applied)
+        # version-preserving installs bypass the anchor gate — no
+        # follower read may span one (rare: tenant handoff)
+        self._flw_drop()
         self.applied_ge, self.applied_seq = int(ge), int(seq)
         self.last_crc = crc
         BatchedEnsembleService._apply_installed(
@@ -2136,6 +2280,7 @@ class ReplicatedService(BatchedEnsembleService):
                  self_addr: Optional[Tuple[str, int]] = None,
                  trust_host_lease: bool = False,
                  fault_label: Optional[str] = None,
+                 follower_reads: Optional[bool] = None,
                  **kw) -> None:
         # the (runtime, n_ens, n_peers, n_slots) positional prefix
         # matches the base class so restore() reconstructs us from a
@@ -2187,6 +2332,23 @@ class ReplicatedService(BatchedEnsembleService):
         #: waits out the lease (docs/ARCHITECTURE.md §9).
         self.trust_host_lease = bool(trust_host_lease)
         self._host_lease_until = 0.0
+        #: follower-served leased reads (docs/ARCHITECTURE.md §16).
+        #: OFF by default — the off arm ships 3-field abatch frames
+        #: byte-identical to HEAD.  On: every abatch frame carries the
+        #: per-address grant table, settles renew per-replica read
+        #: leases, and settle quorums exclude nothing — but a write
+        #: cannot ACK while a non-acking replica still holds an
+        #: unexpired lease (the write barrier that makes replica
+        #: reads linearizable).
+        self._follower_reads = (
+            bool(follower_reads) if follower_reads is not None
+            else os.environ.get("RETPU_FOLLOWER_READS", "0") == "1")
+        #: highest ack seq granted per replica address (ships in every
+        #: frame) and the leader-side write fence per address:
+        #: fence[a] = settle time + lease, an upper bound on the
+        #: replica's own window (its anchor predates our settle)
+        self._flw_grants: Dict[Tuple[str, int], int] = {}
+        self._flw_fence: Dict[Tuple[str, int], float] = {}
         #: fault-plane endpoint name for THIS leader's side of its
         #: links (docs/ARCHITECTURE.md §13).  Default "local"; tests
         #: hosting several leaders in one process pass distinct
@@ -2241,7 +2403,10 @@ class ReplicatedService(BatchedEnsembleService):
                             "repl_encode_s": 0.0,
                             "repl_build_s": 0.0,
                             "repl_ack_s": 0.0,
-                            "repl_acked_batches": 0}
+                            "repl_acked_batches": 0,
+                            "follower_lease_write_blocks": 0,
+                            "follower_reads_served": 0,
+                            "follower_reads_blocked": 0}
         # group-level metrics join the service's registry (the
         # svcnode `metrics` verb and the docs ratchet see one plane)
         self.obs_registry.collect(self._obs_group_collect)
@@ -2570,6 +2735,12 @@ class ReplicatedService(BatchedEnsembleService):
                 # a fresh reign starts lease-less: the first quorum-
                 # confirmed settle grants the host read lease
                 self._host_lease_until = 0.0
+                # ...and grant-less: follower read leases issued by
+                # the PREVIOUS reign are not ours to renew, and this
+                # lane's own replica-role window dies with the reign
+                self._flw_grants.clear()
+                self._flw_fence.clear()
+                self.core._flw_drop()
             # a persisted explicit config defines the quorum size now
             if self.core.cfg[1] is not None:
                 self.group_size = len(self.core.cfg[1])
@@ -2583,6 +2754,25 @@ class ReplicatedService(BatchedEnsembleService):
                 if (age, aseq) == (self.core.applied_ge,
                                    self._grp_seq):
                     link.needs_sync = False
+            if self._follower_reads:
+                # §16 takeover fence: a member that did NOT grant our
+                # promise may still hold a read lease from the old
+                # reign (granting members dropped theirs inside
+                # handle_promise, before the grant persisted).  Any
+                # such lease anchors at an ack the OLD leader settled
+                # within its batch deadline, so it expires within
+                # ack_timeout + lease() of that settle — and no new
+                # grant can issue once our majority promised (their
+                # epoch nacks break the old leader's settle quorum).
+                # Wait it out before this reign's first write acks.
+                members = self._member_addrs() or [
+                    (l.host, l.port) for l in self._links]
+                ungranted = [a for a in members
+                             if a != self.self_addr
+                             and a not in granted_addrs]
+                if ungranted:
+                    time.sleep(self.ack_timeout + self.config.lease()
+                               + self._read_margin)
             self._emit("grp_takeover", {"epoch": ge,
                                         "seq": self._grp_seq})
             return True
@@ -2676,6 +2866,21 @@ class ReplicatedService(BatchedEnsembleService):
             return
         self._drain_launches()
         self._drain_pending(block_all=True)
+        if self._follower_reads:
+            # §16 config fence: no member may serve a follower read
+            # under the OLD membership once config records start
+            # acking.  Stop issuing grants (handle_cfg drops windows
+            # on every acking member; _settle_batch won't grant while
+            # _cfg_txn is set below) and wait out every outstanding
+            # fence — after this, any lease we ever granted has
+            # expired on the holder's clock too.
+            self._flw_grants.clear()
+            now_m = time.monotonic()
+            wait = max([t - now_m for t in self._flw_fence.values()],
+                       default=0.0)
+            if wait > 0:
+                time.sleep(wait + self._read_margin)
+            self._flw_fence.clear()
         cver = self.core.cfg[0]
         if self.core.cfg[1] is None:
             # first explicit config: pin the CURRENT set at cver+1 so
@@ -3005,8 +3210,19 @@ class ReplicatedService(BatchedEnsembleService):
         self._ship_buf = []
         first_seq = entries[0].seq
         t0 = time.perf_counter()
-        enc = _EncodedParts(
-            ("abatch", self._ge, [e.entry for e in entries]))
+        if self._follower_reads:
+            # §16: piggyback the grant table — each replica reads its
+            # own row (the highest of ITS acks counted inside a
+            # quorum-confirmed settle).  One shared encoding still
+            # serves every link; sorted for deterministic bytes.
+            grants = tuple(sorted(
+                (h, p, s) for (h, p), s in self._flw_grants.items()))
+            enc = _EncodedParts(
+                ("abatch", self._ge, [e.entry for e in entries],
+                 grants))
+        else:
+            enc = _EncodedParts(
+                ("abatch", self._ge, [e.entry for e in entries]))
         self.group_stats["repl_encode_s"] += time.perf_counter() - t0
         self.group_stats["repl_frames"] += 1
         self.group_stats["repl_bytes_shipped"] += enc.nbytes
@@ -3365,6 +3581,68 @@ class ReplicatedService(BatchedEnsembleService):
                 continue
             self._account_ack(link, apply_t.result, batch.crc, acked)
         q = self._quorum_from(acked) and not self._deposed
+        if self._follower_reads:
+            if q:
+                # §16 WRITE BARRIER: a write must not ack while a
+                # replica that did NOT ack it may still serve reads
+                # under an unexpired lease — its mirrors would miss
+                # the write and a follower read could return the
+                # overwritten value AFTER the client saw the ack.
+                # Settles fire at quorum, so a fence holder is often
+                # just a straggler whose ack is milliseconds out:
+                # WAIT for it (don't fail the batch), bounded by its
+                # fence — a holder that cannot confirm (nack, dead
+                # socket) stalls this ack at most lease(), the
+                # classic price of leased reads.
+                addr_t = {(l.host, l.port): t for l, t in batch.sends}
+                blocked = False
+                while True:
+                    now_m = time.monotonic()
+                    for a in [a for a, t in self._flw_fence.items()
+                              if t <= now_m]:
+                        del self._flw_fence[a]
+                    missing = [a for a in self._flw_fence
+                               if a not in acked]
+                    if not missing:
+                        break
+                    if not blocked:
+                        blocked = True
+                        self.group_stats[
+                            "follower_lease_write_blocks"] += 1
+                    a = missing[0]
+                    t = addr_t.get(a)
+                    budget = max(0.0, self._flw_fence[a] - now_m)
+                    if t is not None and not t.event.is_set():
+                        if t.event.wait(budget):
+                            for l2, t2 in batch.sends:
+                                if t2 is t:
+                                    self._account_ack(
+                                        l2, t2.result, batch.crc,
+                                        acked)
+                                    break
+                        continue
+                    # the holder answered without a countable ack (or
+                    # never got this batch): its mirrors provably miss
+                    # the write — only fence expiry releases the ack
+                    time.sleep(budget)
+            now_m = time.monotonic()
+            if q and now_m <= batch.deadline \
+                    and self._cfg_txn is None:
+                # grant/renew: each acking replica's lease window
+                # anchors at ITS ack-send time, provably before this
+                # settle — fence[a] (our clock) always outlasts the
+                # replica's own window.  The deadline gate bounds
+                # grant issuance to ack_timeout past the ship, which
+                # is what lets a takeover wait out
+                # ack_timeout + lease() + read_margin.  No grants
+                # during a membership transition (handle_cfg drops
+                # windows; new ones must wait for the new config).
+                g_seq = batch.entries[-1].seq
+                lease_s = self.config.lease()
+                for a in acked:
+                    self._flw_grants[a] = max(
+                        self._flw_grants.get(a, 0), g_seq)
+                    self._flw_fence[a] = now_m + lease_s
         self._last_quorum_ok = q
         # the HOST lease for leader-local fast reads: only a settle
         # whose host quorum confirmed this epoch renews it, and a
@@ -3489,8 +3767,12 @@ class ReplicatedService(BatchedEnsembleService):
             self._emit("grp_deposed", {"superseded_by": promised})
         self._deposed = True
         # a deposed leader invalidates its read lease BEFORE its next
-        # ack — no leased read may outlive the observed fencing
+        # ack — no leased read may outlive the observed fencing; the
+        # follower-read grant table dies with the reign too (a deposed
+        # leader can't settle a quorum, so it could never renew)
         self._host_lease_until = 0.0
+        self._flw_grants.clear()
+        self._flw_fence.clear()
         self.core.promised = max(self.core.promised, promised)
 
     def _on_storage_degraded(self) -> None:
@@ -3705,7 +3987,8 @@ class ReplicaServer:
                  auto_failover: Optional[float] = None,
                  dynamic: bool = False,
                  advertise: Optional[Tuple[str, int]] = None,
-                 trust_host_lease: bool = False) -> None:
+                 trust_host_lease: bool = False,
+                 follower_reads: Optional[bool] = None) -> None:
         runtime = WallRuntime()
         if data_dir is not None and (
                 os.path.exists(os.path.join(data_dir, "META"))
@@ -3715,13 +3998,15 @@ class ReplicaServer:
                 runtime, data_dir, group_size=group_size,
                 data_dir=data_dir, config=config,
                 ack_timeout=ack_timeout,
-                trust_host_lease=trust_host_lease, **dyn_kw)
+                trust_host_lease=trust_host_lease,
+                follower_reads=follower_reads, **dyn_kw)
         else:
             self.svc = ReplicatedService(
                 runtime, n_ens, 1, n_slots, group_size=group_size,
                 data_dir=data_dir, config=config,
                 ack_timeout=ack_timeout, dynamic=dynamic,
-                trust_host_lease=trust_host_lease)
+                trust_host_lease=trust_host_lease,
+                follower_reads=follower_reads)
         self.core = self.svc.core
         warmup_kernels(self.svc)
         warm_delta_apply(self.svc)
@@ -3980,6 +4265,9 @@ class ReplicaServer:
         if self.svc._is_leader:
             self.svc._is_leader = False
             self.svc._deposed = True
+            # any replica-role read window predating our reign is
+            # meaningless now (and a fresh one needs fresh grants)
+            self.core._flw_drop()
             self.svc._emit("grp_step_down", {})
 
     def _promote(self, peers: List[Tuple[str, int]]) -> Tuple:
@@ -4158,6 +4446,22 @@ class ReplicaServer:
                     send(req_id, self.svc.stats())
                 continue
             if not self.svc.is_leader:
+                if op in ("kget", "kget_vsn", "kget_many",
+                          "kget_slab"):
+                    # §16 follower-served leased reads: answer from
+                    # this replica's delta-maintained mirrors when an
+                    # unexpired leader-granted lease covers the
+                    # ensemble; any miss falls back to not-leader
+                    # (the client re-routes to the leader, exactly
+                    # the pre-lease behavior)
+                    try:
+                        with self._lock:
+                            r = self._follower_read(op, args)
+                    except Exception:
+                        r = None
+                    if r is not None:
+                        send(req_id, r)
+                        continue
                 send(req_id, ("error", "not-leader"))
                 continue
             if op == "update_group_members":
@@ -4249,12 +4553,68 @@ class ReplicaServer:
             fut.add_waiter(
                 lambda result, rid=req_id: send(rid, result))
 
+    def _follower_read(self, op: str, args: tuple):
+        """Serve one read verb off this REPLICA's host mirrors under
+        the leader-granted lease (docs/ARCHITECTURE.md §16), or None
+        when anything disqualifies it: lease lapsed/margin-expired,
+        the ensemble carries applied-but-unconfirmed writes, or any
+        requested key's mirror state is incomplete.  All-or-nothing
+        per request — a partially-mirror-served batch would interleave
+        two consistency regimes inside one reply."""
+        svc = self.svc
+        if not args:
+            return None
+        ens = args[0]
+        if type(ens) is not int or not 0 <= ens < svc.n_ens:
+            return None
+        if not self.core._flw_serve_ok(ens):
+            svc.group_stats["follower_reads_blocked"] += 1
+            return None
+        want_vsn = op == "kget_vsn"
+        if op in ("kget", "kget_vsn"):
+            keys = [args[1]]
+        elif op == "kget_many":
+            keys = list(args[1])
+            want_vsn = bool(args[2]) if len(args) > 2 else False
+        else:  # kget_slab
+            from riak_ensemble_tpu.svcnode import _slab_keys
+            keys = _slab_keys(args[1], args[2])
+            want_vsn = bool(args[3]) if len(args) > 3 else False
+        nf = (("ok", NOTFOUND, (0, 0)) if want_vsn
+              else ("ok", NOTFOUND))
+        ks = svc.key_slot[ens]
+        out = []
+        for key in keys:
+            slot = ks.get(key)
+            if slot is None:
+                out.append(nf)
+                continue
+            reason, r = svc._fast_read_result(ens, slot, want_vsn)
+            if reason is not None:
+                svc.group_stats["follower_reads_blocked"] += 1
+                return None
+            out.append(r)
+        svc.group_stats["follower_reads_served"] += len(out)
+        return out if op in ("kget_many", "kget_slab") else out[0]
+
     def _dispatch(self, op: str, args: tuple):
         svc = self.svc
         if args:
             ens = args[0]
             if type(ens) is not int or not 0 <= ens < svc.n_ens:
                 raise ValueError(f"bad ensemble index {ens!r}")
+        if op in ("kput_slab", "kget_slab"):
+            # the proxy tier forwards whole op slabs here once this
+            # host is promoted: same arena decode as svcnode's front
+            # door (lazy import dodges the module cycle)
+            from riak_ensemble_tpu.svcnode import (_slab_keys,
+                                                   _slab_vals)
+            if op == "kput_slab":
+                return svc.kput_many(ens, _slab_keys(args[1], args[2]),
+                                     _slab_vals(args[3], args[4]))
+            return svc.kget_many(
+                ens, _slab_keys(args[1], args[2]),
+                want_vsn=bool(args[3]) if len(args) > 3 else False)
         fns = {"kput": svc.kput, "kget": svc.kget,
                "kget_vsn": svc.kget_vsn, "kupdate": svc.kupdate,
                "kput_once": svc.kput_once, "kmodify": svc.kmodify,
@@ -4505,6 +4865,12 @@ def main(argv=None) -> int:
                          "host leads (opt-in: trusts the host-quorum "
                          "lease between settles — see "
                          "docs/ARCHITECTURE.md §9)")
+    ap.add_argument("--follower-reads", action="store_true",
+                    help="serve kget* from this REPLICA's mirrors "
+                         "under leader-granted epoch-fenced read "
+                         "leases, and (as leader) grant them "
+                         "(docs/ARCHITECTURE.md §16; also "
+                         "RETPU_FOLLOWER_READS=1)")
     args = ap.parse_args(argv)
 
     from riak_ensemble_tpu.config import fast_test_config
@@ -4524,7 +4890,8 @@ def main(argv=None) -> int:
         config=fast_test_config() if args.fast else None,
         peers=peers, auto_failover=args.auto_failover,
         dynamic=args.dynamic, advertise=adv,
-        trust_host_lease=args.trust_host_lease)
+        trust_host_lease=args.trust_host_lease,
+        follower_reads=args.follower_reads or None)
     print(f"repgroup replica repl={srv.repl_port} "
           f"client={srv.client_port}", flush=True)
     fp = faults.active_plan()

@@ -298,6 +298,7 @@ class ServiceServer:
         # can't grow the queues/pending maps without bound (the
         # VERDICT/advisor backpressure finding).
         inflight = asyncio.Semaphore(_MAX_INFLIGHT)
+        bp = self.svc.svc_backpressure
 
         def send(req_id: Any, result: Any) -> None:
             # Responses are written from flush-context future waiters
@@ -315,6 +316,11 @@ class ServiceServer:
             if (transport is not None
                     and transport.get_write_buffer_size()
                     > _MAX_WRITE_BUF):
+                # slow-reader drop: counted, so ``stats()`` and the
+                # retpu_svc_backpressure_total family carry the
+                # evidence an operator needs to tell a misbehaving
+                # client from a server fault
+                bp["write_buf_drops"] += 1
                 transport.abort()
 
         try:
@@ -412,6 +418,11 @@ class ServiceServer:
                           "resolve_ensemble"):
                     send(req_id, self._lifecycle(op, args))
                     continue
+                if inflight.locked():
+                    # the read loop is about to block on the
+                    # per-connection op budget: a pipelining client
+                    # has _MAX_INFLIGHT unresolved ops
+                    bp["inflight_stalls"] += 1
                 await inflight.acquire()
                 try:
                     fut = self._dispatch(op, args)
@@ -441,7 +452,28 @@ class ServiceServer:
 
 
 class ServiceClient:
-    """Pipelined client: awaitable ops correlated by request id."""
+    """Pipelined client: awaitable ops correlated by request id.
+
+    A dropped socket no longer strands the client: the next op
+    transparently reconnects (bounded backoff) before sending — safe
+    for every verb, nothing was dispatched yet.  In-flight ops at the
+    moment of the drop still resolve ``DISCONNECTED`` (ambiguous by
+    contract), but the **idempotent** verbs (``kget*``, ``stats``,
+    ``health``, ``metrics``) additionally retry ONCE on a fresh
+    connection — a read that dies mid-flight cannot double-apply, so
+    the caller never sees the blip.  Writes keep surfacing the
+    ambiguity: auto-retrying a ``kput`` whose first attempt may have
+    committed would double-apply."""
+
+    #: side-effect-free verbs a mid-flight connection loss may safely
+    #: re-issue (exactly once) after reconnecting
+    IDEMPOTENT_OPS = frozenset({
+        "kget", "kget_vsn", "kget_many", "kget_slab",
+        "stats", "health", "metrics"})
+
+    #: reconnect backoff schedule (seconds slept before attempts
+    #: 2..N; the first attempt is immediate)
+    RECONNECT_BACKOFF = (0.05, 0.1, 0.2)
 
     def __init__(self, host: str, port: int) -> None:
         self.host, self.port = host, port
@@ -450,12 +482,46 @@ class ServiceClient:
         self._pending: Dict[int, asyncio.Future] = {}
         self._ids = itertools.count(1)
         self._pump: Optional[asyncio.Task] = None
+        self._ever_connected = False
+        self._closed = False
+        self.reconnects = 0
+        #: serializes reconnection so concurrent ops on a dropped
+        #: socket dial once, not once each
+        self._rlock = asyncio.Lock()
 
     async def connect(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(
             self.host, self.port)
+        self._ever_connected = True
         self._pump = asyncio.get_running_loop().create_task(
             self._read_loop())
+
+    async def _reconnect(self) -> bool:
+        """Bounded-backoff redial of the configured address; True on
+        success.  Only meaningful after a successful :meth:`connect`
+        (a never-connected or explicitly closed client stays in the
+        documented DISCONNECTED regime)."""
+        async with self._rlock:
+            if self._closed or not self._ever_connected:
+                return False
+            if self._writer is not None \
+                    and not self._writer.is_closing():
+                return True  # a sibling op already re-dialed
+            if self._pump is not None:
+                self._pump.cancel()
+            if self._writer is not None:
+                self._writer.close()
+            self._fail_pending()
+            for i in range(1 + len(self.RECONNECT_BACKOFF)):
+                if i:
+                    await asyncio.sleep(self.RECONNECT_BACKOFF[i - 1])
+                try:
+                    await self.connect()
+                except (OSError, ConnectionError):
+                    continue
+                self.reconnects += 1
+                return True
+            return False
 
     #: result for ops whose outcome is UNKNOWN (connection lost before
     #: the response arrived): distinct from the protocol's "failed",
@@ -464,6 +530,7 @@ class ServiceClient:
     DISCONNECTED = ("error", "disconnected")
 
     async def close(self) -> None:
+        self._closed = True
         if self._pump is not None:
             self._pump.cancel()
         if self._writer is not None:
@@ -500,8 +567,12 @@ class ServiceClient:
         (a WireError is a caller bug, never a leaked future)."""
         # Never-connected or already-closed clients get the documented
         # DISCONNECTED result, not an AttributeError (advisor finding).
+        # A previously-connected client whose socket DROPPED instead
+        # redials (bounded backoff) before sending — nothing was
+        # dispatched yet, so this is safe for every verb.
         if self._writer is None or self._writer.is_closing():
-            return self.DISCONNECTED
+            if not await self._reconnect():
+                return self.DISCONNECTED
         req_id = next(self._ids)
         parts = encode(req_id)
         length = sum(memoryview(p).nbytes for p in parts)
@@ -523,9 +594,20 @@ class ServiceClient:
             self._pending.pop(req_id, None)  # a long-lived pipelined
             raise                            # client must not leak ids
 
+    async def _call_once_retry(self, op: str, encode, timeout: float):
+        """One roundtrip, plus the idempotent-verb retry: a read that
+        resolved DISCONNECTED mid-flight re-issues exactly once on a
+        fresh connection (side-effect-free, so no double-apply risk);
+        every other verb surfaces the ambiguity unchanged."""
+        r = await self._roundtrip(encode, timeout)
+        if r == self.DISCONNECTED and op in self.IDEMPOTENT_OPS \
+                and await self._reconnect():
+            r = await self._roundtrip(encode, timeout)
+        return r
+
     async def call(self, op: str, *args: Any, timeout: float = 30.0):
-        return await self._roundtrip(
-            lambda rid: [wire.encode((rid, op) + args)], timeout)
+        return await self._call_once_retry(
+            op, lambda rid: [wire.encode((rid, op) + args)], timeout)
 
     async def call_parts(self, op: str, *args: Any,
                          timeout: float = 30.0):
@@ -534,8 +616,9 @@ class ServiceClient:
         :func:`wire.encode_parts`, so each wrapped buffer goes from
         its owning array straight to the transport — no per-key term
         encode, no arena concatenation into an intermediate frame."""
-        return await self._roundtrip(
-            lambda rid: wire.encode_parts((rid, op) + args), timeout)
+        return await self._call_once_retry(
+            op, lambda rid: wire.encode_parts((rid, op) + args),
+            timeout)
 
     # convenience wrappers
     async def kput(self, ens, key, value, **kw):

@@ -246,6 +246,71 @@ def test_bench_trend_polices_recovery_ms(tmp_path):
     assert rows[2]["recovery_ms"] is None
 
 
+def test_ingress_rung_smoke():
+    """The --stage ingress runner (§16): a promoted 3-host group
+    behind real subprocess proxies and a subprocess client herd.
+    Both A/Bs produce nonzero rates at the tiny shape (the RATIOS
+    are round-time claims — every smoke host shares one GIL), the
+    spread arm really was served from replica mirrors, and the
+    per-tier evidence rode ONE fleet pull off the leader."""
+    out = bench.run_ingress(0.5, smoke=True)
+    arms = out["ingress_arms"]
+    assert set(arms) == {"1", "2"}, arms
+    for arm in arms.values():
+        assert arm["batches_per_sec"] > 0
+        assert arm["read_ops_per_sec"] > 0
+        assert arm["write_ops_per_sec"] > 0
+        assert arm["errors"] == 0, arm
+    assert out["ingress_x"] > 0
+    assert out["ingress_write_hold"] is not None
+    flw = out["follower_read_arms"]
+    assert flw["leader_only"]["read_ops_per_sec"] > 0
+    assert flw["followers"]["read_ops_per_sec"] > 0
+    assert flw["followers"]["write_ops_per_sec"] == 0
+    # the replicas' own counters prove mirror-served reads (scraped
+    # through the single ("fleet", "metrics") pull)
+    assert out["follower_reads_served_total"] > 0
+    assert out["ingress_engine_p99_ms"] is not None
+    assert out["ingress_shape"]["smoke"] is True
+
+
+def test_bench_trend_polices_ingress_x(tmp_path):
+    """The ingress_x column's ratchet (ISSUE 16): higher-is-better,
+    so a same-box proxy-scaling collapse below tolerance x the best
+    earlier round trips --check; rounds predating the stage neither
+    ratchet nor fail."""
+    import json
+
+    import pytest as _pytest
+
+    from tools import bench_trend
+
+    box = {"cpu_count": 2, "jax": "j", "jaxlib": "jl",
+           "platform": "p"}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"parsed": {"value": 100.0, "box": box, "ingress_x": 2.0}}))
+    # regression: 0.6x vs best 2.0x at tolerance 0.5 (half-of-best)
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"parsed": {"value": 100.0, "box": box, "ingress_x": 0.6}}))
+    with _pytest.raises(bench_trend.TrendError):
+        bench_trend.check(str(tmp_path), tolerance=0.5)
+    # inside the band: ok, and the report names the comparison
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"parsed": {"value": 100.0, "box": box, "ingress_x": 1.5}}))
+    rep = bench_trend.check(str(tmp_path), tolerance=0.5)
+    assert rep["best_same_box_ingress_x"] == 2.0
+    assert rep["newest_ingress_x"] == 1.5
+    # a newest round predating the stage (no ingress_x) passes
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(
+        {"parsed": {"value": 100.0, "box": box}}))
+    bench_trend.check(str(tmp_path), tolerance=0.5)
+    # the column renders in the trajectory
+    rows = bench_trend.trajectory(bench_trend.load_rounds(
+        str(tmp_path)))
+    assert rows[0]["ingress_x"] == 2.0
+    assert rows[2]["ingress_x"] is None
+
+
 def test_bench_smoke_trend_tripwire():
     """The current smoke rung vs the best same-fingerprint recorded
     point (BENCH_SMOKE_TREND.json), within a tolerance band: a

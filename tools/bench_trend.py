@@ -86,6 +86,10 @@ COLUMNS: Tuple[Tuple[str, str], ...] = (
     # the RTO half of the §15 crash contract.  LOWER is better; the
     # --check band polices it same-fingerprint like the headline)
     ("recovery_ms", "recov_ms"),
+    # client-batch ingestion scaling from 1 proxy to the high proxy
+    # count (bench --stage ingress; §16 serving plane.  HIGHER is
+    # better; --check polices it same-fingerprint like the headline)
+    ("ingress_x", "ingress_x"),
 )
 
 
@@ -271,6 +275,25 @@ def check(root: str, tolerance: float = 0.5) -> Dict[str, Any]:
                         f"{newest['round']} restart-to-serving "
                         f"{rec_v:.1f} ms exceeds 1/{tolerance:g} x "
                         f"the best same-box {best_rec:.1f} ms")
+            # ingress_x ratchet (ISSUE 16): proxy-count ingestion
+            # scaling is higher-is-better like the headline — the
+            # newest same-box point must stay above tolerance x the
+            # best earlier round's.  Rounds predating the stage (no
+            # ingress_x) neither ratchet nor fail.
+            ing_v = newest["parsed"].get("ingress_x")
+            ing_same = [r["parsed"]["ingress_x"] for r in same
+                        if isinstance(r["parsed"].get("ingress_x"),
+                                      (int, float))]
+            if isinstance(ing_v, (int, float)) and ing_same:
+                best_ing = max(ing_same)
+                report["best_same_box_ingress_x"] = best_ing
+                report["newest_ingress_x"] = ing_v
+                if ing_v < tolerance * best_ing:
+                    raise TrendError(
+                        f"out-of-band ingress regression: round "
+                        f"{newest['round']} proxy-scaling "
+                        f"{ing_v:.2f}x is below {tolerance:.0%} of "
+                        f"the best same-box {best_ing:.2f}x")
     return report
 
 
