@@ -32,14 +32,24 @@ def save(path: str, state: EngineState) -> None:
 
 def load(path: str, template: Optional[EngineState] = None) -> EngineState:
     """Restore a checkpoint.  ``template`` (an ``init_state`` of the
-    same shapes) restores with matching shardings/dtypes; without it,
-    arrays come back with saved metadata."""
+    same shapes) restores each array DIRECTLY onto the template
+    leaf's sharding — so a checkpoint taken under one device
+    placement restores onto another (mesh-sharded save → single-shard
+    serve and back) without inheriting the save-time placement from
+    the file.  Without a template, arrays come back with saved
+    metadata."""
+    import jax
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     ckptr = ocp.PyTreeCheckpointer()
     if template is not None:
-        restored = ckptr.restore(path, item=template._asdict())
+        tpl = template._asdict()
+        restore_args = jax.tree.map(
+            lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding)
+            if isinstance(x, jax.Array) else ocp.RestoreArgs(), tpl)
+        restored = ckptr.restore(path, item=tpl,
+                                 restore_args=restore_args)
     else:
         restored = ckptr.restore(path)
     return EngineState(**restored)

@@ -165,6 +165,81 @@ class KvResult(NamedTuple):
 
 
 # ---------------------------------------------------------------------------
+# The canonical sharded-pytree layout (ONE state layout, two placements)
+#
+# Every path — the single-shard service, the mesh service, checkpoints,
+# warmup — shares the axis layout declared right here next to the
+# NamedTuples it describes.  ``state_specs()`` gives the mesh placement
+# (E over 'ens', M over 'peer'); ``state_specs(ens=None, peer=None)``
+# gives the single-shard placement (everything replicated) — the SAME
+# pytree of PartitionSpecs, so the two worlds can never drift apart.
+
+
+def state_specs(ens: Optional[str] = "ens",
+                peer: Optional[str] = "peer") -> "EngineState":
+    """:class:`EngineState`-shaped pytree of ``PartitionSpec``\\ s.
+
+    ``ens``/``peer`` name the mesh axes the E and M dims shard over
+    (None = replicated along that axis).  Field ↔ spec table lives in
+    docs/ARCHITECTURE.md §17.
+    """
+    from jax.sharding import PartitionSpec as P
+    return EngineState(
+        epoch=P(ens, peer),
+        fact_seq=P(ens, peer),
+        leader=P(ens),
+        view_mask=P(ens, None, peer),
+        view_vsn=P(ens),
+        pend_vsn=P(ens),
+        commit_vsn=P(ens),
+        obj_seq_ctr=P(ens),
+        obj_epoch=P(ens, peer, None),
+        obj_seq=P(ens, peer, None),
+        obj_val=P(ens, peer, None),
+        tree_leaf=P(ens, peer, None, None),
+        tree_node=P(ens, peer, None, None),
+    )
+
+
+def scan_result_specs(ens: Optional[str] = "ens",
+                      peer: Optional[str] = "peer") -> "KvResult":
+    """:class:`KvResult` specs for :func:`kv_step_scan`'s stacked
+    ``[K, E]`` planes (``obj_vsn`` ``[K, E, 2]``, ``tree_corrupt``
+    ``[K, E, M]``)."""
+    from jax.sharding import PartitionSpec as P
+    return KvResult(
+        committed=P(None, ens), get_ok=P(None, ens),
+        found=P(None, ens), value=P(None, ens),
+        obj_vsn=P(None, ens, None), quorum_ok=P(None, ens),
+        tree_corrupt=P(None, ens, peer),
+    )
+
+
+def wide_result_specs(ens: Optional[str] = "ens",
+                      peer: Optional[str] = "peer") -> "KvResult":
+    """:class:`KvResult` specs for :func:`kv_step_scan_wide`'s
+    ``[G, E, W]`` planes (``obj_vsn`` ``[G, E, W, 2]``,
+    ``tree_corrupt`` ``[G, E, M]``)."""
+    from jax.sharding import PartitionSpec as P
+    return KvResult(
+        committed=P(None, ens, None), get_ok=P(None, ens, None),
+        found=P(None, ens, None), value=P(None, ens, None),
+        obj_vsn=P(None, ens, None, None), quorum_ok=P(None, ens, None),
+        tree_corrupt=P(None, ens, peer),
+    )
+
+
+def state_sharding(mesh) -> "EngineState":
+    """:func:`state_specs` bound to a concrete mesh: an
+    :class:`EngineState` of ``NamedSharding`` ready for
+    ``jax.device_put`` / checkpoint-restore templates."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), state_specs(),
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
+# ---------------------------------------------------------------------------
 # Merkle trie layout + path kernels (the synctree on the data path)
 
 

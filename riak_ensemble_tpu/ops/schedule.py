@@ -167,3 +167,33 @@ def flat_order(plan: WidePlan) -> Tuple[np.ndarray, np.ndarray]:
         out_k[:, e] = kk[order]
     return out_k, np.broadcast_to(
         np.arange(n_ens, dtype=np.int32)[None, :], (k_depth, n_ens))
+
+
+def shard_active_columns(active: np.ndarray, n_ens: int,
+                         n_shards: int, a_min: int
+                         ) -> Tuple[list, int]:
+    """Split a GLOBAL active-column index set into per-ens-shard LOCAL
+    index lists with one common pow2 bucket width.
+
+    The mesh keeps E in ``n_shards`` contiguous blocks of
+    ``E/n_shards`` rows (NamedSharding over the 'ens' axis), so a
+    global column index ``c`` lives on shard ``c // e_loc`` at local
+    index ``c % e_loc``.  Compaction-aware sharding computes the |A|
+    bucket PER SHARD — every shard packs the same ``a_width`` columns
+    (pow2 ≥ the busiest shard's count, floored at ``a_min``, capped at
+    ``e_loc``) so the shard_map'd packer sees one static shape while
+    each shard's d2h payload stays local.
+
+    Returns ``(per_shard, a_width)``: ``per_shard[s]`` is an int32
+    array of ≤ ``a_width`` LOCAL indices (the caller pads to
+    ``a_width``); ``a_width == e_loc`` means no compaction wins on
+    this flush (every shard at full width).
+    """
+    e_loc = n_ens // n_shards
+    active = np.asarray(active, np.int32)
+    shard_of = active // e_loc
+    per_shard = [active[shard_of == s] - s * e_loc
+                 for s in range(n_shards)]
+    busiest = max((p.size for p in per_shard), default=0)
+    a_width = _pow2_at_least(max(busiest, a_min))
+    return per_shard, min(a_width, e_loc)

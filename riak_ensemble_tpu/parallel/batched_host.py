@@ -203,12 +203,100 @@ def _backend_mem_bytes() -> float:
     return float(stats.get("bytes_in_use", float("nan")))
 
 
+def _backend_mem_bytes_per_device() -> Dict[str, float]:
+    """Per-device form of :func:`_backend_mem_bytes` for mesh-sharded
+    engines: bytes in use on EVERY local device, keyed by device id.
+    An 'ens'-shard imbalance (one shard's slabs growing past its
+    siblings) is invisible in the default-device gauge."""
+    import jax
+    out: Dict[str, float] = {}
+    for d in jax.local_devices():
+        stats = d.memory_stats()
+        out[str(d.id)] = (float(stats.get("bytes_in_use",
+                                          float("nan")))
+                          if stats else float("nan"))
+    return out
+
+
+def mesh_ens_shards(engine) -> int:
+    """Number of 'ens'-axis shards the SHARD-WISE pack path applies
+    to: >1 only for a mesh engine whose 'peer' axis is unsharded
+    (each device then holds complete [e_loc, M, ...] rows, so the
+    per-shard pack needs no cross-device traffic at all).  A sharded
+    peer axis keeps the gathered pack (`_pack_results_gathered`) —
+    the corrupt plane spans peer shards there.  0 = not shard-wise
+    (single-device engines included)."""
+    mesh = getattr(engine, "mesh", None)
+    if mesh is None or int(mesh.shape.get("peer", 1)) != 1:
+        return 0
+    n = int(mesh.shape["ens"])
+    return n if n > 1 else 0
+
+
+def _make_shardwise_packer(mesh):
+    """Compaction-aware SHARD-WISE pack for an 'ens'-sharded mesh
+    (peer axis unsharded): ``_pack_results_body`` runs PER ENS-SHARD
+    under shard_map — each device bit-packs its own [K, e_loc] result
+    block with its own LOCAL active-column gather, and the packed d2h
+    payload leaves each device without any all-gather (the gathered
+    pack's replication step is exactly the cross-device tax this
+    removes).  The active-column index operand is ``[n_sh, A_loc]``
+    LOCAL indices (one row per shard, pad 0 — ignored by the host
+    unpack), sharded P('ens', None) so row s lands on shard s.  The
+    output is the per-shard flat vectors concatenated in shard order
+    — :func:`unpack_results_sharded` inverts it.
+
+    Returns a wrapper with the ``(won, res, want_vsn, active_idx)``
+    packer signature, ``_cache_size`` summed over the member programs
+    (CompileWatch), and ``_shardwise`` = the shard count (the service
+    keys its launch records off it).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from riak_ensemble_tpu.parallel.mesh import _shard_map
+
+    res_specs = eng.scan_result_specs()
+    programs: Dict[Tuple[bool, bool], Any] = {}
+
+    def program(want_vsn: bool, has_active: bool):
+        prog = programs.get((want_vsn, has_active))
+        if prog is None:
+            if has_active:
+                def body(won, res, aidx):
+                    return _pack_results_body(won, res, want_vsn,
+                                              aidx.ravel())
+                in_specs = (P("ens"), res_specs, P("ens", None))
+            else:
+                def body(won, res):
+                    return _pack_results_body(won, res, want_vsn)
+                in_specs = (P("ens"), res_specs)
+            prog = jax.jit(_shard_map(
+                body, mesh=mesh, in_specs=in_specs,
+                out_specs=P("ens"), check_vma=False))
+            programs[(want_vsn, has_active)] = prog
+        return prog
+
+    def pack(won, res, want_vsn, active_idx=None):
+        if active_idx is None:
+            return program(bool(want_vsn), False)(won, res)
+        return program(bool(want_vsn), True)(won, res, active_idx)
+
+    pack._cache_size = lambda: sum(p._cache_size()
+                                   for p in programs.values())
+    pack._shardwise = int(mesh.shape["ens"])
+    return pack
+
+
 def _select_packer(engine):
     """The pack program matching the engine's placement: plain jit for
-    single-device engines, the gathered form for mesh-sharded ones."""
+    single-device engines, the shard-wise form for 'ens'-sharded
+    meshes with an unsharded peer axis, the gathered form for the
+    rest."""
     mesh = getattr(engine, "mesh", None)
     if mesh is None:
         return _pack_results
+    if mesh_ens_shards(engine):
+        return _make_shardwise_packer(mesh)
     from jax.sharding import NamedSharding, PartitionSpec
     rep = NamedSharding(mesh, PartitionSpec())
     return functools.partial(_pack_results_gathered, sharding=rep)
@@ -338,6 +426,40 @@ def unpack_results(flat: np.ndarray, e: int, m: int, k: int,
     else:
         committed = get_ok = found = value = vsn = None
     return won, quorum_ok, corrupt, committed, get_ok, found, value, vsn
+
+
+def unpack_results_sharded(flat: np.ndarray, e: int, m: int, k: int,
+                           want_vsn: bool, n_shards: int,
+                           shard_active: Optional[List[np.ndarray]]
+                           = None, a_width: int = 0):
+    """Invert the shard-wise packer (:func:`_make_shardwise_packer`):
+    the payload is ``n_shards`` :func:`_pack_results` blocks in shard
+    order, each covering a contiguous ``e_loc = E/n_shards`` column
+    slice, compacted per shard through its LOCAL active index list
+    (``shard_active[s]``, ≤ ``a_width`` entries; None = every shard
+    at full width).  Each block unpacks through the single-shard
+    oracle and the full-width planes concatenate back along E — so
+    every downstream consumer (mirror scatter, WAL, wide routing,
+    replica CRC) stays layout-blind, exactly as with the gathered
+    pack."""
+    e_loc = e // n_shards
+    nb = packed_nbytes(e_loc, m, k, want_vsn,
+                       a_width if shard_active is not None else None)
+    parts = []
+    for s in range(n_shards):
+        act = None if shard_active is None else shard_active[s]
+        parts.append(unpack_results(
+            flat[s * nb:(s + 1) * nb], e_loc, m, k, want_vsn,
+            active=act,
+            a_width=0 if shard_active is None else a_width))
+
+    def cat(i, axis):
+        if parts[0][i] is None:
+            return None
+        return np.concatenate([p[i] for p in parts], axis=axis)
+
+    return (cat(0, 0), cat(1, 0), cat(2, 0), cat(3, 1), cat(4, 1),
+            cat(5, 1), cat(6, 1), cat(7, 1))
 
 
 def _lane_indices(ent_col: np.ndarray, ent_row0: np.ndarray,
@@ -556,6 +678,14 @@ class _InFlightLaunch:
     active: Any = None
     a_width: int = 0
     sliced: bool = False
+    #: shard-wise mesh pack (mesh_ens_shards > 0): the packed payload
+    #: is n_shards per-shard blocks; ``shard_active`` holds each
+    #: shard's LOCAL active index list when the flush compacted
+    #: (None = per-shard full width).  Set on EVERY launch of a
+    #: shard-wise service — election-only and device-resident
+    #: (execute) launches included.
+    n_shards: int = 0
+    shard_active: Any = None
     #: host slot plane in op order (the native mirror scatter's
     #: companion to ``kind_np``); None for device-resident planes
     op_slot_np: Any = None
@@ -627,8 +757,13 @@ class BatchedEnsembleService:
         self.max_k = max_ops_per_tick
         self.engine = engine if engine is not None else _LocalEngine()
         #: result packer matched to the engine's placement (mesh
-        #: engines gather explicitly — see _pack_results_gathered)
+        #: engines pack per ens-shard or gather explicitly — see
+        #: _make_shardwise_packer / _pack_results_gathered)
         self._pack = _select_packer(self.engine)
+        #: >0 = the shard-wise mesh pack path: packed payloads are
+        #: per-ens-shard blocks and active-column compaction computes
+        #: its |A| bucket PER SHARD (compaction-aware sharding)
+        self._mesh_shards = mesh_ens_shards(self.engine)
         self.state = self.engine.init_state(n_ens, n_peers, n_slots)
         #: host failure detector input (set_peer_up)
         self.up = np.ones((n_ens, n_peers), dtype=bool)
@@ -3263,14 +3398,36 @@ class BatchedEnsembleService:
         # zero-transfer contract).  The wide path compacts too: the
         # scheduler only rearranges ops WITHIN their ensemble column,
         # so the [K, E] planes' active set is the plan's as well.
-        active = aidx_j = None
+        active = aidx_j = shard_active = None
         a_width = 0
         sliced = False
         if self._compact and k and not isinstance(kind, jax.Array):
             cols = np.flatnonzero(
                 (np.asarray(kind) != eng.OP_NOOP).any(axis=0)
                 | np.asarray(elect, bool))
-            if cols.size:
+            if cols.size and self._mesh_shards:
+                # Compaction-aware SHARDING: the |A| bucket is
+                # computed PER ENS-SHARD — every shard packs the same
+                # pow2 width (the busiest shard's bucket) of its own
+                # LOCAL columns, so the bucketing, the column gather
+                # and the packed d2h payload all stay shard-local
+                # (no replicated index constraint, no all-gather).
+                # The step itself keeps the full grid (a sharded E
+                # axis cannot slice across shards) — this is the
+                # pack-gather strength only.
+                from riak_ensemble_tpu.ops import schedule as sch
+                per_shard, a_loc = sch.shard_active_columns(
+                    cols, self.n_ens, self._mesh_shards, A_BUCKET_MIN)
+                if a_loc < self.n_ens // self._mesh_shards:
+                    active = cols.astype(np.int32)
+                    a_width = a_loc
+                    shard_active = per_shard
+                    pad = np.zeros((self._mesh_shards, a_loc),
+                                   np.int32)
+                    for si, p in enumerate(per_shard):
+                        pad[si, :p.size] = p
+                    aidx_j = self._shard_aidx(pad)
+            elif cols.size:
                 a_b = A_BUCKET_MIN
                 while a_b < cols.size:
                     a_b <<= 1
@@ -3433,9 +3590,21 @@ class BatchedEnsembleService:
             leader_snapshot=leader_snapshot,
             lease_snapshot=lease_snapshot, donated=donated,
             active=active, a_width=a_width, sliced=sliced,
+            n_shards=self._mesh_shards, shard_active=shard_active,
             op_slot_np=np.asarray(slot) if host_planes else None,
             flush_id=obs.next_flush_id() if self._obs else 0,
             t_join=t0)
+
+    def _shard_aidx(self, pad: np.ndarray):
+        """Place a ``[n_shards, A_loc]`` per-shard local active-index
+        matrix so row s lands on ens-shard s (the shard-wise packer's
+        P('ens', None) operand) — an uncommitted upload would leave
+        the placement to GSPMD and could round-trip through a
+        replicate step."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        return jax.device_put(
+            pad, NamedSharding(self.engine.mesh,
+                               PartitionSpec("ens", None)))
 
     def _fetch_packed(self, fl: _InFlightLaunch) -> np.ndarray:
         """Block until the launch's packed result is on the host (the
@@ -3494,12 +3663,22 @@ class BatchedEnsembleService:
             # into full-width planes; election-only launches (k == 0)
             # and layout surprises fall back to the Python oracle.
             planes8 = None
-            if self._native_resolve is not None and fl.k_eff:
-                planes8 = self._native_resolve.unpack(
-                    flat, e, m, fl.k_eff, fl.want_vsn, fl.active,
-                    fl.a_width, fl.sliced)
-            native_arm = planes8 is not None
-            if not native_arm:
+            if fl.n_shards:
+                # shard-wise mesh payload: per-shard blocks, Python
+                # unpack per block (the native kernel walks the
+                # single-block layout; this path trades it for zero
+                # cross-device gathers on the pack side)
+                planes8 = unpack_results_sharded(
+                    flat, e, m, fl.k_eff, fl.want_vsn, fl.n_shards,
+                    shard_active=fl.shard_active, a_width=fl.a_width)
+                native_arm = False
+            else:
+                if self._native_resolve is not None and fl.k_eff:
+                    planes8 = self._native_resolve.unpack(
+                        flat, e, m, fl.k_eff, fl.want_vsn, fl.active,
+                        fl.a_width, fl.sliced)
+                native_arm = planes8 is not None
+            if planes8 is None:
                 planes8 = unpack_results(flat, e, m, fl.k_eff,
                                          fl.want_vsn, active=fl.active,
                                          a_width=fl.a_width,
@@ -3525,8 +3704,10 @@ class BatchedEnsembleService:
             self.payload_bytes += int(flat.nbytes)
             self.payload_bytes_full_width += packed_nbytes(
                 e, m, fl.k_eff, fl.want_vsn)
-            self._occ_sum += (fl.a_width / e if fl.active is not None
-                              else 1.0)
+            # shard-wise launches pack a_width columns PER SHARD, so
+            # the effective packed width is a_width * n_shards
+            self._occ_sum += (fl.a_width * max(fl.n_shards, 1) / e
+                              if fl.active is not None else 1.0)
             self._occ_launches += 1
             if self._obs:
                 fl.payload_nbytes = int(flat.nbytes)
@@ -4019,6 +4200,20 @@ class BatchedEnsembleService:
             "retpu_backend_mem_bytes",
             "bytes in use on the default jax device (NaN when the "
             "backend reports no memory stats)", fn=_backend_mem_bytes)
+        self.obs_registry.collect(self._obs_device_mem_collect)
+
+    def _obs_device_mem_collect(self) -> Dict[str, Any]:
+        """Per-device memory family (mesh plane telemetry): one
+        sample per local device, so an 'ens'-shard imbalance shows up
+        as a device-labeled outlier instead of averaging away in the
+        default-device gauge."""
+        return {
+            "retpu_backend_mem_bytes_per_device": obs.registry.family(
+                "gauge",
+                "bytes in use per local jax device (NaN when the "
+                "backend reports no memory stats)",
+                _backend_mem_bytes_per_device(), label="device"),
+        }
 
     def _obs_cost_collect(self) -> Dict[str, Any]:
         """Per-bucket XLA cost-analysis gauges captured at warmup
@@ -4535,11 +4730,16 @@ class BatchedEnsembleService:
     def _a_ladder(self) -> List[Optional[int]]:
         """Active-column bucket widths the launch path can pack at:
         full width (None) plus, with compaction on, the pow2 ladder
-        from A_BUCKET_MIN strictly below E."""
+        from A_BUCKET_MIN strictly below E.  Shard-wise mesh engines
+        bucket PER SHARD, so their ladder runs strictly below the
+        LOCAL width E/n_shards instead."""
         ladder: List[Optional[int]] = [None]
         if self._compact:
+            top = self.n_ens
+            if self._mesh_shards:
+                top = self.n_ens // self._mesh_shards
             b = A_BUCKET_MIN
-            while b < self.n_ens:
+            while b < top:
                 ladder.append(b)
                 b <<= 1
         return ladder
@@ -4674,9 +4874,14 @@ class BatchedEnsembleService:
                     np.asarray(pack(won, res, True))
                     np.asarray(pack(won, res, False))
                 elif not warm_bucket(k_eff, aw, wide_gw):
-                    np.asarray(pack(
-                        won, res, True,
-                        active_idx=jnp.zeros((aw,), jnp.int32)))
+                    if self._mesh_shards:
+                        # shard-wise: [n_shards, A_loc] local pad-0
+                        # index matrix (the live flush's operand form)
+                        aidx = self._shard_aidx(np.zeros(
+                            (self._mesh_shards, aw), np.int32))
+                    else:
+                        aidx = jnp.zeros((aw,), jnp.int32)
+                    np.asarray(pack(won, res, True, active_idx=aidx))
 
         k = 0
         while True:

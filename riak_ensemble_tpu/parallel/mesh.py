@@ -69,55 +69,55 @@ def make_mesh(n_ens: int, n_peer: int = 1,
     return Mesh(grid, ("ens", "peer"))
 
 
-# PartitionSpecs for each EngineState field ([E,M] / [E] / [E,V,M] /
-# [E,M,S] / [E,M,S,LANES] / [E,M,U,LANES]).
-_STATE_SPECS = eng.EngineState(
-    epoch=P("ens", "peer"),
-    fact_seq=P("ens", "peer"),
-    leader=P("ens"),
-    view_mask=P("ens", None, "peer"),
-    view_vsn=P("ens"),
-    pend_vsn=P("ens"),
-    commit_vsn=P("ens"),
-    obj_seq_ctr=P("ens"),
-    obj_epoch=P("ens", "peer", None),
-    obj_seq=P("ens", "peer", None),
-    obj_val=P("ens", "peer", None),
-    tree_leaf=P("ens", "peer", None, None),
-    tree_node=P("ens", "peer", None, None),
-)
+# The canonical sharded-pytree layout lives next to the NamedTuples it
+# describes (ops/engine.state_specs and friends) so the single-shard
+# and mesh paths can never drift apart — these module aliases keep the
+# historical names for existing callers.
+_STATE_SPECS = eng.state_specs()
+_SCAN_RESULT_SPECS = eng.scan_result_specs()
+_WIDE_RESULT_SPECS = eng.wide_result_specs()
 
-# kv_step_scan stacks results along a leading K axis.
-_SCAN_RESULT_SPECS = eng.KvResult(
-    committed=P(None, "ens"), get_ok=P(None, "ens"), found=P(None, "ens"),
-    value=P(None, "ens"), obj_vsn=P(None, "ens", None),
-    quorum_ok=P(None, "ens"), tree_corrupt=P(None, "ens", "peer"),
-)
 
-# kv_step_scan_wide stacks [G, E, W] (tree_corrupt: [G, E, Ml]).
-_WIDE_RESULT_SPECS = eng.KvResult(
-    committed=P(None, "ens", None), get_ok=P(None, "ens", None),
-    found=P(None, "ens", None), value=P(None, "ens", None),
-    obj_vsn=P(None, "ens", None, None), quorum_ok=P(None, "ens", None),
-    tree_corrupt=P(None, "ens", "peer"),
-)
+def _forward_cache_size(wrapper, jitted) -> None:
+    """Expose the jitted program's compile-cache probe on a plain
+    wrapper function: ``obs.CompileWatch`` detects compiles via
+    ``fn._cache_size()`` and silently passes through callables that
+    lack it — a mesh step without this forward would serve compiles
+    invisibly (the satellite-1 contract is CompileWatch-assertable
+    zero serve-phase compiles on the mesh path)."""
+    cs = getattr(jitted, "_cache_size", None)
+    if cs is not None:
+        wrapper._cache_size = cs
 
 
 class ShardedEngine:
-    """Engine kernels shard_map'd over a ('ens', 'peer') mesh.
+    """Engine kernels shard_map'd over a ('ens', 'peer') mesh — the
+    first-class mesh serving engine.
 
     E must divide by mesh 'ens' size; M by mesh 'peer' size (pad views
     with absent peers if needed — all-zero view columns are inert).
+
+    The fused serving steps (``full_step``/``full_step_wide`` and
+    their ``_donate`` variants) are INSTANCE attributes: plain
+    wrappers over the shard_map'd programs that default absent CAS
+    planes and forward ``_cache_size`` so ``CompileWatch`` sees mesh
+    compiles and ``BatchedEnsembleService._step_fns`` trusts the
+    donate pairing (instance-level pair).  There are NO sliced
+    variants — a mesh-sharded E axis cannot gather active columns
+    across shards without resharding; the mesh service keeps the full
+    grid and compacts the packed RESULT per ens-shard instead (see
+    ``batched_host``'s shard-wise packer).
     """
 
     def __init__(self, mesh: Mesh) -> None:
         self.mesh = mesh
         ax = "peer" if mesh.shape["peer"] > 1 else None
 
-        def smap(fn, in_specs, out_specs):
+        def smap(fn, in_specs, out_specs, donate=False):
             return jax.jit(_shard_map(
                 fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                check_vma=False))
+                check_vma=False),
+                donate_argnums=(0,) if donate else ())
 
         self._elect = smap(
             lambda st, el, ca, up: eng.elect_step(st, el, ca, up,
@@ -132,24 +132,39 @@ class ShardedEngine:
              P(None, "ens"), P("ens", "peer"), P(None, "ens"),
              P(None, "ens")),
             (_STATE_SPECS, _SCAN_RESULT_SPECS))
-        self._full = smap(
-            lambda st, el, ca, k, sl, v, lz, up, xe, xs: eng.full_step(
-                st, el, ca, k, sl, v, lz, up, axis_name=ax,
-                exp_epoch=xe, exp_seq=xs),
-            (_STATE_SPECS, P("ens"), P("ens"), P(None, "ens"),
-             P(None, "ens"), P(None, "ens"), P(None, "ens"),
-             P("ens", "peer"), P(None, "ens"), P(None, "ens")),
-            (_STATE_SPECS, P("ens"), _SCAN_RESULT_SPECS))
-        self._full_wide = smap(
-            lambda st, el, ca, k, sl, v, lz, up, xe, xs:
-                eng.full_step_wide(
-                    st, el, ca, k, sl, v, lz, up, axis_name=ax,
-                    exp_epoch=xe, exp_seq=xs),
-            (_STATE_SPECS, P("ens"), P("ens"), P(None, "ens", None),
-             P(None, "ens", None), P(None, "ens", None),
-             P(None, "ens", None), P("ens", "peer"),
-             P(None, "ens", None), P(None, "ens", None)),
-            (_STATE_SPECS, P("ens"), _WIDE_RESULT_SPECS))
+        _full_in = (_STATE_SPECS, P("ens"), P("ens"), P(None, "ens"),
+                    P(None, "ens"), P(None, "ens"), P(None, "ens"),
+                    P("ens", "peer"), P(None, "ens"), P(None, "ens"))
+        _full_out = (_STATE_SPECS, P("ens"), _SCAN_RESULT_SPECS)
+
+        def _full_body(st, el, ca, k, sl, v, lz, up, xe, xs):
+            return eng.full_step(st, el, ca, k, sl, v, lz, up,
+                                 axis_name=ax, exp_epoch=xe, exp_seq=xs)
+
+        self._full = smap(_full_body, _full_in, _full_out)
+        self._full_donate = smap(_full_body, _full_in, _full_out,
+                                 donate=True)
+        _wide_in = (_STATE_SPECS, P("ens"), P("ens"),
+                    P(None, "ens", None), P(None, "ens", None),
+                    P(None, "ens", None), P(None, "ens", None),
+                    P("ens", "peer"), P(None, "ens", None),
+                    P(None, "ens", None))
+        _wide_out = (_STATE_SPECS, P("ens"), _WIDE_RESULT_SPECS)
+
+        def _wide_body(st, el, ca, k, sl, v, lz, up, xe, xs):
+            return eng.full_step_wide(st, el, ca, k, sl, v, lz, up,
+                                      axis_name=ax, exp_epoch=xe,
+                                      exp_seq=xs)
+
+        self._full_wide = smap(_wide_body, _wide_in, _wide_out)
+        self._full_wide_donate = smap(_wide_body, _wide_in, _wide_out,
+                                      donate=True)
+        # instance-attribute serving steps (see class docstring)
+        self.full_step = self._make_step(self._full)
+        self.full_step_donate = self._make_step(self._full_donate)
+        self.full_step_wide = self._make_step(self._full_wide)
+        self.full_step_wide_donate = self._make_step(
+            self._full_wide_donate)
         self._reconfig = smap(
             lambda st, pr, nv, up: eng.reconfig_step(st, pr, nv, up,
                                                      axis_name=ax),
@@ -183,14 +198,45 @@ class ShardedEngine:
             eng.reset_rows,
             (_STATE_SPECS, P("ens"), P("ens", "peer")),
             _STATE_SPECS)
+        # Placement canonicalizer: shard_state routes host-built
+        # states through this identity program so they land on the
+        # EXACT sharding the step programs emit.  A device_put with
+        # the spelled-out specs places equivalently but spells the
+        # spec differently (GSPMD canonicalizes size-1 axes and
+        # trailing Nones away), and the differing cache key would
+        # force a first-flush recompile after warmup.
+        self._canon = smap(lambda st: st, (_STATE_SPECS,),
+                           _STATE_SPECS)
+
+    def _make_step(self, jitted):
+        """Wrap a shard_map'd fused-step program in the serving-step
+        call convention (keyword CAS planes, defaulted to zeros) with
+        ``_cache_size`` forwarded for CompileWatch."""
+        def step(state, elect, cand, kind, slot, val, lease_ok, up,
+                 exp_epoch=None, exp_seq=None):
+            exp_epoch, exp_seq = _default_exp(kind, exp_epoch, exp_seq)
+            return jitted(state, elect, cand, kind, slot, val,
+                          lease_ok, up, exp_epoch, exp_seq)
+        _forward_cache_size(step, jitted)
+        return step
 
     # -- placement ---------------------------------------------------------
 
+    @property
+    def n_ens_shards(self) -> int:
+        """Number of shards along the 'ens' mesh axis."""
+        return int(self.mesh.shape["ens"])
+
+    @property
+    def n_peer_shards(self) -> int:
+        """Number of shards along the 'peer' mesh axis."""
+        return int(self.mesh.shape["peer"])
+
     def shard_state(self, state: eng.EngineState) -> eng.EngineState:
-        """Place a host-built state onto the mesh with engine specs."""
-        return jax.tree.map(
-            lambda x, spec: jax.device_put(x, NamedSharding(self.mesh, spec)),
-            state, _STATE_SPECS)
+        """Place a host-built state onto the mesh with engine specs —
+        via the identity program, so the placement's cache key matches
+        the step outputs' bit for bit (see ``_canon`` above)."""
+        return self._canon(state)
 
     def init_state(self, n_ensembles: int, n_peers: int, n_slots: int,
                    **kw) -> eng.EngineState:
@@ -214,20 +260,8 @@ class ShardedEngine:
         return self._kv(state, kind, slot, val, lease_ok, up,
                         exp_epoch, exp_seq)
 
-    def full_step(self, state, elect, cand, kind, slot, val, lease_ok,
-                  up, exp_epoch=None, exp_seq=None):
-        exp_epoch, exp_seq = _default_exp(kind, exp_epoch, exp_seq)
-        return self._full(state, elect, cand, kind, slot, val, lease_ok,
-                          up, exp_epoch, exp_seq)
-
-    def full_step_wide(self, state, elect, cand, kind, slot, val,
-                       lease_ok, up, exp_epoch=None, exp_seq=None):
-        """Wide-scheduled flagship step over the mesh: [G, E, W]
-        conflict-free planes (:func:`riak_ensemble_tpu.ops.engine.
-        kv_step_scan_wide`)."""
-        exp_epoch, exp_seq = _default_exp(kind, exp_epoch, exp_seq)
-        return self._full_wide(state, elect, cand, kind, slot, val,
-                               lease_ok, up, exp_epoch, exp_seq)
+    # full_step / full_step_wide (+ _donate) are instance attributes
+    # built in __init__ — see the class docstring.
 
     def reconfig_step(self, state, propose, new_view, up):
         """Joint-consensus membership change over the mesh
@@ -263,3 +297,31 @@ class ShardedEngine:
         """Ensemble-row recycle over the mesh
         (:func:`riak_ensemble_tpu.ops.engine.reset_rows`)."""
         return self._reset(state, mask, new_view)
+
+
+def mesh_engine(n_devices: Optional[int] = None, n_peer: int = 1,
+                devices: Optional[Sequence] = None) -> ShardedEngine:
+    """Build a serving :class:`ShardedEngine` over the first
+    ``n_devices`` local devices (default: all of them), ``n_peer`` of
+    the mesh innermost on the 'peer' axis.
+
+    The svcnode/bench entry point (``--mesh-devices``).  On a CPU box
+    the devices come from ``XLA_FLAGS=--xla_force_host_platform_
+    device_count=N`` — which must be set BEFORE jax initializes its
+    backend, so a too-small device count fails here with the knob
+    named rather than deep inside a shard_map.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    want = len(devs) if n_devices is None else int(n_devices)
+    if want > len(devs):
+        raise ValueError(
+            f"mesh_engine: asked for {want} devices but jax sees only "
+            f"{len(devs)} ({devs[0].platform}); on CPU set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={want} in the "
+            "environment BEFORE the process imports jax")
+    if want % n_peer:
+        raise ValueError(
+            f"mesh_engine: {want} devices do not divide into "
+            f"n_peer={n_peer} columns")
+    return ShardedEngine(make_mesh(want // n_peer, n_peer,
+                                   devices=devs[:want]))

@@ -863,6 +863,13 @@ def main(argv=None) -> int:
                     help="disable the lease-protected read fast path "
                          "(every read takes a device round; same as "
                          "RETPU_FAST_READS=0)")
+    ap.add_argument("--mesh-devices", type=int, default=0,
+                    help="serve from a mesh engine sharded over this "
+                         "many devices along the 'ens' axis (0 = "
+                         "single-shard).  On CPU export XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=8 "
+                         "BEFORE starting the node so jax sees the "
+                         "virtual devices")
     ap.add_argument("--autotune", action="store_true", default=None,
                     help="arm the obs-actuated runtime controller "
                          "(same as RETPU_AUTOTUNE=1): auto-tunes the "
@@ -873,11 +880,17 @@ def main(argv=None) -> int:
                          "('controller',) verb)")
     args = ap.parse_args(argv)
 
+    engine = None
+    if args.mesh_devices:
+        from riak_ensemble_tpu.parallel.mesh import mesh_engine
+        engine = mesh_engine(args.mesh_devices)
+
     async def run() -> None:
         server = await serve(
             args.n_ens, args.n_peers, args.n_slots, args.host,
             args.port, args.tick,
             config=fast_test_config() if args.fast else None,
+            engine=engine,
             dynamic=args.dynamic, data_dir=args.data_dir,
             warm=args.warm,
             fast_reads=False if args.no_fast_reads else None,

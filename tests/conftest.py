@@ -35,6 +35,15 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running nemesis sweeps/soaks excluded "
         "from the tier-1 window (run explicitly or via -m slow)")
+    # The mesh equivalence suite needs the forced 8-device CPU mesh
+    # (set above for every test session).  The marker lets CI run it
+    # as its OWN pytest session (`pytest -m mesh`) so a future change
+    # to the forced device count can't silently contaminate the other
+    # suites — and lets a single-device environment deselect it.
+    config.addinivalue_line(
+        "markers", "mesh: single-shard↔mesh equivalence suite; needs "
+        "xla_force_host_platform_device_count=8 (runs standalone via "
+        "-m mesh)")
 
 
 def soak_seeds(base):

@@ -90,6 +90,12 @@ COLUMNS: Tuple[Tuple[str, str], ...] = (
     # count (bench --stage ingress; §16 serving plane.  HIGHER is
     # better; --check polices it same-fingerprint like the headline)
     ("ingress_x", "ingress_x"),
+    # mesh scaling efficiency at the >=10k-ens escale rung: mesh
+    # ops/s over (devices x the single-shard reference at equal
+    # per-shard load).  HIGHER is better; --check polices it
+    # same-fingerprint — and the fingerprint includes device_count,
+    # so points from different mesh widths never compare (§17)
+    ("escale_eff", "esc_eff"),
 )
 
 
@@ -101,11 +107,18 @@ class TrendError(Exception):
 def fingerprint_key(box: Optional[Dict[str, Any]]
                     ) -> Optional[Tuple]:
     """Comparable box identity from an ``obs.box_fingerprint`` dict
-    (None when the round predates fingerprints)."""
+    (None when the round predates fingerprints).
+
+    ``device_count`` joined the fingerprint with the mesh escale
+    ladder: an 8-device mesh point must never ratchet against a
+    single-device round (same box, completely different serving
+    shape).  Rounds recorded before the field exists carry None
+    there and only compare among themselves."""
     if not isinstance(box, dict):
         return None
     return (box.get("cpu_count"), box.get("jax"), box.get("jaxlib"),
-            box.get("platform") or box.get("jax_platforms"))
+            box.get("platform") or box.get("jax_platforms"),
+            box.get("device_count"))
 
 
 def load_rounds(root: str) -> List[Dict[str, Any]]:
@@ -294,6 +307,26 @@ def check(root: str, tolerance: float = 0.5) -> Dict[str, Any]:
                         f"{newest['round']} proxy-scaling "
                         f"{ing_v:.2f}x is below {tolerance:.0%} of "
                         f"the best same-box {best_ing:.2f}x")
+            # escale_eff ratchet (ISSUE 17): mesh scaling efficiency
+            # at the >=10k-ens rung is higher-is-better like the
+            # headline.  device_count rides the fingerprint, so
+            # efficiency points from different mesh widths are never
+            # compared at all.  Rounds predating the mesh ladder
+            # neither ratchet nor fail.
+            eff_v = newest["parsed"].get("escale_eff")
+            eff_same = [r["parsed"]["escale_eff"] for r in same
+                        if isinstance(r["parsed"].get("escale_eff"),
+                                      (int, float))]
+            if isinstance(eff_v, (int, float)) and eff_same:
+                best_eff = max(eff_same)
+                report["best_same_box_escale_eff"] = best_eff
+                report["newest_escale_eff"] = eff_v
+                if eff_v < tolerance * best_eff:
+                    raise TrendError(
+                        f"out-of-band mesh-scaling regression: round "
+                        f"{newest['round']} escale efficiency "
+                        f"{eff_v:.2f} is below {tolerance:.0%} of "
+                        f"the best same-box {best_eff:.2f}")
     return report
 
 
