@@ -3420,6 +3420,180 @@ def run_ingress(seconds: float, smoke: bool) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _commrepl_arm(seconds: float, smoke: bool, n_ens: int,
+                  n_slots: int, n_keys: int, dup: int,
+                  comm: bool) -> dict:
+    """One arm of the commrepl A/B: a 3-host group driven by a
+    contended-counter kmodify_many storm (every hot key duplicated
+    ``dup`` times per batch).  ``comm`` flips the leader's
+    ``RETPU_COMM_REPL`` lane — replicas apply whichever entry kind
+    arrives, so only the leader's flag differs between arms."""
+    import shutil
+    import signal
+    import tempfile
+
+    from riak_ensemble_tpu import funref
+    from riak_ensemble_tpu.config import fast_test_config
+    from riak_ensemble_tpu.parallel import repgroup
+    from riak_ensemble_tpu.parallel.batched_host import WallRuntime
+
+    tmp = tempfile.mkdtemp(prefix="bench_commrepl_")
+    procs: list = []
+    servers: list = []
+    try:
+        ports = []
+        if smoke:
+            for i in (1, 2):
+                servers.append(repgroup.ReplicaServer(
+                    n_ens, 3, n_slots, data_dir=f"{tmp}/r{i}",
+                    config=fast_test_config()))
+            ports = [s.repl_port for s in servers]
+        else:
+            for i in (1, 2):
+                ports.append(_repgroup_spawn_subprocess(
+                    n_ens, n_slots, tmp, i, procs))
+        svc = repgroup.ReplicatedService(
+            WallRuntime(), n_ens, 1, n_slots, group_size=3,
+            peers=[("127.0.0.1", p) for p in ports],
+            ack_timeout=60.0, max_ops_per_tick=n_keys * dup,
+            config=fast_test_config(), data_dir=tmp + "/leader",
+            pipeline_depth=2)
+        svc._comm_repl = comm  # the A/B flip (RETPU_COMM_REPL)
+        repgroup.warmup_kernels(svc)
+        assert svc.takeover(), "commrepl bench: takeover failed"
+
+        fun = funref.ref("rmw:add", 1)
+        storm = [f"ctr{j}" for j in range(n_keys)] * dup
+
+        futs = [svc.kmodify_many(e, storm, fun)
+                for e in range(n_ens)]
+        while any(svc.queues):  # warm: slots, elections, compile
+            svc.flush()
+        assert all(f.done for f in futs)
+        svc.ack_timeout = 10.0
+        g0 = dict(svc.stats()["group"])
+
+        lat = []
+        ops = 0
+        inflight = []
+        t_end = time.perf_counter() + max(seconds, 1e-3)
+        t0 = time.perf_counter()
+        while True:
+            now = time.perf_counter()
+            if now < t_end and len(inflight) < 4:
+                inflight.append((now, [
+                    svc.kmodify_many(e, storm, fun)
+                    for e in range(n_ens)]))
+            svc.flush()
+            while inflight and all(f.done for f in inflight[0][1]):
+                tb, fl = inflight.pop(0)
+                lat.append(time.perf_counter() - tb)
+                ops += len(fl) * len(storm)
+            if now >= t_end and not inflight and lat:
+                break
+            assert now < t_end + 120.0, "commrepl bench wedged"
+        elapsed = time.perf_counter() - t0
+        g = svc.stats()["group"]
+        assert g["quorum_failures"] == 0, g
+        entries = max((g["repl_delta_entries"] + g["repl_full_entries"])
+                      - (g0["repl_delta_entries"]
+                         + g0["repl_full_entries"]), 1)
+        out = {
+            "ops_per_sec": round(ops / elapsed, 1),
+            "ack_p50_ms": round(float(np.percentile(
+                np.asarray(lat) * 1e3, 50)), 3),
+            "ack_p99_ms": round(float(np.percentile(
+                np.asarray(lat) * 1e3, 99)), 3),
+            "bytes_per_entry": round(
+                (g["repl_bytes_sections"] - g0["repl_bytes_sections"])
+                / entries, 1),
+            "merge_entries": (g["repl_merge_entries"]
+                              - g0["repl_merge_entries"]),
+            "merge_cells": (g["repl_merge_cells"]
+                            - g0["repl_merge_cells"]),
+            "early_acks": (g["repl_early_acks"]
+                           - g0["repl_early_acks"]),
+            "coalesce_ratio": g["repl_merge_coalesce_ratio"],
+        }
+        if smoke:
+            # comm/ordered convergence tripwire: every replica lane's
+            # engine state bit-equal to the leader's after drain
+            for _ in range(3):
+                svc.heartbeat()
+            svc._drain_pending(block_all=True)
+            want_pos = (svc.core.applied_ge, svc.core.applied_seq)
+            end = time.monotonic() + 60.0
+            while time.monotonic() < end:
+                done = True
+                for s in servers:
+                    with s._lock:
+                        done = done and ((s.core.applied_ge,
+                                          s.core.applied_seq)
+                                         >= want_pos)
+                if done:
+                    break
+                time.sleep(0.02)
+            d_l = repgroup.dump_state(svc)
+            ok = True
+            for s in servers:
+                with s._lock:
+                    d_r = repgroup.dump_state(s.svc)
+                ok = ok and d_l[0] == d_r[0]
+            out["convergence_ok"] = ok
+        svc.stop()
+        return out
+    finally:
+        for s in servers:
+            s.stop()
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_commrepl(seconds: float, smoke: bool) -> dict:
+    """Commutative-replication rung (ARCHITECTURE §18): the contended-
+    counter storm — hot keys duplicated per batch, rmw:add only — on a
+    3-host group, comm lane vs ordered A/B.  The comm arm coalesces
+    duplicates at enqueue, ships merge sections and early-acks on
+    merge-durable quorum receipt; the ordered arm (``svc._comm_repl =
+    False``, the ``RETPU_COMM_REPL=0`` semantics) pays full per-entry
+    sequencing.  ``rmw_comm_x`` = ordered ack p50 / comm ack p50
+    (higher is better; ``tools/bench_trend.py --check`` rides it), and
+    the bytes-per-entry pair feeds the test_bench_smoke tripwire
+    (merge section < ordered delta bytes on the hot-slot shape)."""
+    n_ens, n_slots, n_keys, dup = ((8, 16, 2, 4) if smoke
+                                   else (32, 32, 4, 8))
+    comm = _commrepl_arm(seconds, smoke, n_ens, n_slots, n_keys,
+                         dup, True)
+    plain = _commrepl_arm(seconds, smoke, n_ens, n_slots, n_keys,
+                          dup, False)
+    out = {
+        "commrepl_ops_per_sec": comm["ops_per_sec"],
+        "commrepl_ack_p50_ms": comm["ack_p50_ms"],
+        "commrepl_ack_p99_ms": comm["ack_p99_ms"],
+        "commrepl_ordered_ack_p50_ms": plain["ack_p50_ms"],
+        "commrepl_ordered_ack_p99_ms": plain["ack_p99_ms"],
+        "commrepl_bytes_per_entry": comm["bytes_per_entry"],
+        "commrepl_ordered_bytes_per_entry": plain["bytes_per_entry"],
+        "commrepl_merge_entries": comm["merge_entries"],
+        "commrepl_merge_cells": comm["merge_cells"],
+        "commrepl_early_acks": comm["early_acks"],
+        "commrepl_coalesce_ratio": comm["coalesce_ratio"],
+        "commrepl_shape": {
+            "n_ens": n_ens, "n_slots": n_slots, "n_keys": n_keys,
+            "dup": dup, "smoke": smoke},
+        "rmw_comm_x": round(
+            plain["ack_p50_ms"] / max(comm["ack_p50_ms"], 1e-9), 3),
+    }
+    if "convergence_ok" in comm:
+        out["commrepl_convergence_ok"] = (comm["convergence_ok"]
+                                          and plain["convergence_ok"])
+    return out
+
+
 #: fallback ladder: (label, shapes, per-stage subprocess timeout).
 #: Full TPU shapes first; smaller shapes if the backend is too slow to
 #: compile/run the big ones; forced-CPU small shapes as the last
@@ -3547,6 +3721,8 @@ def _stage_entry(args) -> None:
         out = run_recovery(args.seconds, smoke=False)
     elif args.stage == "ingress":
         out = run_ingress(args.seconds, smoke=False)
+    elif args.stage == "commrepl":
+        out = run_commrepl(args.seconds, smoke=False)
     elif args.stage == "merkle":
         m = run_merkle(args.seconds, smoke=False)
         out = {"ladder_metric": m["metric"], "ladder_value": m["value"]}
@@ -3584,7 +3760,7 @@ def main() -> None:
                              "probe", "stepprobe", "repgroup",
                              "widecmp", "escale", "faultsweep",
                              "autotune", "fleetobs", "recovery",
-                             "ingress", "tpuprobe"),
+                             "ingress", "commrepl", "tpuprobe"),
                     help="internal: run one stage in-process")
     ap.add_argument("--mesh-devices", type=int, default=0,
                     help="escale stage: shard the engine over this "
@@ -3631,6 +3807,7 @@ def main() -> None:
         svc.update(run_fleet_obs_overhead(secs))
         svc.update(run_recovery(secs, smoke=True))
         svc.update(run_ingress(secs, smoke=True))
+        svc.update(run_commrepl(secs, smoke=True))
         svc["platform"] = "smoke"
         svc["bench_trend"] = trend
         label = "64_ens_5_peers_smoke"
@@ -3755,6 +3932,16 @@ def main() -> None:
                 svc.update({k: v for k, v in r.items()
                             if k.startswith(("ingress_",
                                              "follower_"))})
+            # §18 commutative-replication rung: contended-counter
+            # storm, comm vs ordered A/B over a real 3-process group
+            # — sockets + disk + host resolve, so it rides whatever
+            # platform the headline took
+            r = _run_stage("commrepl", label, {}, args.seconds,
+                           600.0, force_cpu)
+            if r is not None:
+                svc.update({k: v for k, v in r.items()
+                            if k.startswith(("commrepl_",
+                                             "rmw_comm_x"))})
             # E-scaling datapoints (ROADMAP carried debt item 2): the
             # 1k-ens CPU rung always rides the round JSON; the 2k-
             # and 4k-ens points land when the box completes them

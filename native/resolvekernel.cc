@@ -145,7 +145,8 @@ inline uint8_t* pk_emit_header(uint8_t* p, uint64_t body_len) {
 extern "C" {
 
 // Build-smoke / ABI handshake for utils/native.py and the tests.
-int retpu_resolve_version() { return 1; }
+// 2 = commutative-lane fold (retpu_comm_fold) added.
+int retpu_resolve_version() { return 2; }
 
 // ---------------------------------------------------------------------
 // 1) Packed-result unpack: one pass over the flat d2h payload.
@@ -497,6 +498,113 @@ int retpu_delta_sections(
   out_meta[0] = ncells;
   out_meta[1] = ncols;
   *out_crc = crc;
+  return 0;
+}
+
+// ---------------------------------------------------------------------
+// 5) Commutative-lane per-column fold (repgroup.build_comm_entry's
+// Python fold, one pass; docs/ARCHITECTURE.md §18): for every
+// candidate column, coalesce its committed OP_RMW cells per slot in
+// FIRST-SEEN slot order, folding operands with the exact int32
+// semantics of funref.fold_seed/fold_operand (sub enters negated —
+// MERGE_ADD normalization — under uint32 wraparound arithmetic).
+// Each surviving cell carries (slot, merge class, folded operand,
+// rank of the slot's LAST committed op within the column, that op's
+// round index).  A candidate column where one slot mixes merge
+// classes is DISQUALIFIED: omitted from out_cols entirely (the
+// caller ships it through the ordered sections).
+//
+// merge_of[16]: RMW fun code -> merge class, -1 = ordered (built from
+// funref.MERGE_OF — merge-class codes pinned by funref.MERGE_*);
+// negate[16]: 1 = the operand enters the fold negated (RMW_SUB).
+// out buffers are caller-allocated: cols/counts/nops at e_dim,
+// slots/funs/ops/rl/jl at the flush's committed-cell count.
+// out_meta = {n_qual_cols, n_cells}.
+int retpu_comm_fold(
+    int32_t k, int32_t e_dim,
+    const uint8_t* committed, const int32_t* exp_e,
+    const int32_t* slot, const int32_t* val,
+    const uint8_t* cand,
+    const int32_t* merge_of, const uint8_t* negate,
+    int32_t* out_cols, int32_t* out_counts, int32_t* out_nops,
+    int32_t* out_slots, uint8_t* out_funs, int32_t* out_ops,
+    int32_t* out_rl, int32_t* out_jl,
+    int64_t* out_meta) {
+  if (k < 0 || e_dim <= 0) return -1;
+  int64_t ncols = 0;
+  int64_t ncells = 0;
+  std::unordered_map<int32_t, int64_t> first;  // slot -> cell index
+  for (int64_t c = 0; c < e_dim; c++) {
+    if (!cand[c]) continue;
+    first.clear();
+    const int64_t base = ncells;
+    int32_t nops = 0;
+    bool ok = true;
+    for (int64_t j = 0; j < k; j++) {
+      const int64_t idx = j * e_dim + c;
+      if (!committed[idx]) continue;
+      const int32_t code = exp_e[idx];
+      const int32_t mcls =
+          (code >= 0 && code < 16) ? merge_of[code] : -1;
+      if (mcls < 0) {  // cand miscomputed: conservatively ordered
+        ok = false;
+        break;
+      }
+      const int32_t v = val[idx];
+      const int32_t nv = negate[code]
+          ? static_cast<int32_t>(0u - static_cast<uint32_t>(v))
+          : v;
+      const int32_t rank = nops++;
+      auto it = first.find(slot[idx]);
+      if (it == first.end()) {
+        first.emplace(slot[idx], ncells);
+        out_slots[ncells] = slot[idx];
+        out_funs[ncells] = static_cast<uint8_t>(mcls);
+        out_ops[ncells] = nv;
+        out_rl[ncells] = rank;
+        out_jl[ncells] = static_cast<int32_t>(j);
+        ncells++;
+      } else {
+        const int64_t ci = it->second;
+        if (out_funs[ci] != mcls) {  // mixed classes on one slot
+          ok = false;
+          break;
+        }
+        int32_t acc = out_ops[ci];
+        switch (mcls) {
+          case 0:  // MERGE_ADD (int32 wraparound)
+            acc = static_cast<int32_t>(static_cast<uint32_t>(acc) +
+                                       static_cast<uint32_t>(nv));
+            break;
+          case 1:  // MERGE_MAX
+            acc = acc > nv ? acc : nv;
+            break;
+          case 2:  // MERGE_MIN
+            acc = acc < nv ? acc : nv;
+            break;
+          case 3:  // MERGE_AND
+            acc = acc & nv;
+            break;
+          default:  // MERGE_OR
+            acc = acc | nv;
+            break;
+        }
+        out_ops[ci] = acc;
+        out_rl[ci] = rank;
+        out_jl[ci] = static_cast<int32_t>(j);
+      }
+    }
+    if (!ok) {
+      ncells = base;  // drop the column's partial cells
+      continue;
+    }
+    out_cols[ncols] = static_cast<int32_t>(c);
+    out_counts[ncols] = static_cast<int32_t>(ncells - base);
+    out_nops[ncols] = nops;
+    ncols++;
+  }
+  out_meta[0] = ncols;
+  out_meta[1] = ncells;
   return 0;
 }
 

@@ -56,6 +56,115 @@ RMW_PIA = 8     # put-if-absent: operand iff nothing committed
 #: name -> fun code for device-expressible registered funs
 _DEVICE: Dict[str, int] = {}
 
+# -- commutative-replication classification (docs/ARCHITECTURE.md §18) -------
+#
+# Every device-table fun is tagged by how its applications compose:
+#
+# - COMMUTATIVE  — add/sub: N applications fold into ONE operand
+#   (the int32-wraparound sum; sub is add of the negated operand), so
+#   the apply stream can ship a merged operand instead of N cells;
+# - SEMILATTICE  — max/min/band/bor: idempotent + commutative +
+#   associative, N operands fold by the fun itself;
+# - ORDERED      — set/bxor/put_if_absent: the outcome depends on the
+#   application ORDER (set: last writer; bxor: parity is commutative
+#   but a merged operand could not report per-op computed values;
+#   put-if-absent: first writer) — these never leave the per-entry
+#   sequenced path.
+#
+# The fold target is a MERGE class (the wire's per-cell fun byte):
+# sub normalizes into MERGE_ADD with a negated operand, so mixed
+# add/sub traffic on one slot still coalesces into one cell.
+
+ORDERED = 0
+COMMUTATIVE = 1
+SEMILATTICE = 2
+
+#: merge-section cell fun codes (disjoint from the RMW_* table codes:
+#: they name the FOLD, not the op — applied replica-side against the
+#: lane's own current value)
+MERGE_ADD = 0   # cur + folded operand (int32 wraparound)
+MERGE_MAX = 1   # max(cur, folded operand)
+MERGE_MIN = 2   # min(cur, folded operand)
+MERGE_AND = 3   # cur & folded operand
+MERGE_OR = 4    # cur | folded operand
+
+#: RMW fun code -> replication class
+RMW_CLASS: Dict[int, int] = {
+    RMW_ADD: COMMUTATIVE,
+    RMW_SUB: COMMUTATIVE,
+    RMW_MAX: SEMILATTICE,
+    RMW_MIN: SEMILATTICE,
+    RMW_BAND: SEMILATTICE,
+    RMW_BOR: SEMILATTICE,
+    RMW_SET: ORDERED,
+    RMW_BXOR: ORDERED,
+    RMW_PIA: ORDERED,
+}
+
+#: RMW fun code -> merge-class code (absent for ORDERED funs)
+MERGE_OF: Dict[int, int] = {
+    RMW_ADD: MERGE_ADD,
+    RMW_SUB: MERGE_ADD,
+    RMW_MAX: MERGE_MAX,
+    RMW_MIN: MERGE_MIN,
+    RMW_BAND: MERGE_AND,
+    RMW_BOR: MERGE_OR,
+}
+
+
+def merge_class(code: int) -> Optional[int]:
+    """The merge-class code a device RMW fun folds into, or None when
+    the fun is ORDERED (must stay on the sequenced path)."""
+    return MERGE_OF.get(code)
+
+
+def fold_operand(code: int, acc: int, operand: int) -> int:
+    """Fold one more operand of RMW fun ``code`` into the running
+    merged operand ``acc`` — host-exact int32 semantics (the same
+    arithmetic the engine kernel and the merge apply run), so leader-
+    coalesced and replica-merged values are bit-identical."""
+    if code == RMW_ADD:
+        return i32(acc + operand)
+    if code == RMW_SUB:
+        # normalized into MERGE_ADD: subtracting v1 then v2 is adding
+        # -(v1 + v2) under int32 wraparound
+        return i32(acc - operand)
+    if code == RMW_MAX:
+        return max(acc, operand)
+    if code == RMW_MIN:
+        return min(acc, operand)
+    if code == RMW_BAND:
+        return acc & operand
+    if code == RMW_BOR:
+        return acc | operand
+    raise ValueError(f"fold of ordered RMW fun {code}")
+
+
+def fold_seed(code: int, operand: int) -> int:
+    """The merged-operand seed for the FIRST op of a coalesced cell:
+    identity-adjusted for the normalizing funs (sub seeds with the
+    negated operand so the cell's merge class is MERGE_ADD)."""
+    return i32(-operand) if code == RMW_SUB else i32(operand)
+
+
+def merge_apply(mcls: int, cur: int, operand: int) -> int:
+    """Host mirror of the replica's compiled merge-scatter: apply one
+    merged cell against the lane's current value — used for cells
+    whose current value was produced earlier in the same apply run
+    (the device still holds the pre-run value), and by the
+    equivalence tests as the oracle."""
+    if mcls == MERGE_ADD:
+        return i32(int(cur) + int(operand))
+    if mcls == MERGE_MAX:
+        return max(int(cur), int(operand))
+    if mcls == MERGE_MIN:
+        return min(int(cur), int(operand))
+    if mcls == MERGE_AND:
+        return int(cur) & int(operand)
+    if mcls == MERGE_OR:
+        return int(cur) | int(operand)
+    raise ValueError(f"unknown merge class {mcls}")
+
 
 def register(name: str) -> Callable[[Callable], Callable]:
     """Decorator: make `fn` addressable on the wire as `name`."""

@@ -134,6 +134,54 @@ class KeyModel:
         self.history.append(("read", value))
 
 
+@dataclass
+class CounterModel:
+    """Convergence model for a key driven ONLY by commutative device
+    RMWs (rmw:add / rmw:sub) — the §18 commutative-lane sweeps.
+
+    Per-op sequencing is invisible in the final value, so the whole
+    history collapses to one obligation: the final counter must equal
+    the int32-wraparound sum of every ACKED operand plus SOME subset
+    of the AMBIGUOUS operands (timeouts / ambiguous disconnects — each
+    may have applied at most once), starting from the initial value.
+    This catches both failure modes the lane could introduce: a
+    double-apply (a merged or retried op counted twice — the final
+    overshoots every reachable sum) and early-ack loss (an acked op
+    missing after a crash/handoff — the final undershoots).
+
+    The reachable-sum set is 2^len(ambiguous); keep ambiguity rare
+    (the sweeps inject a handful of kills, not thousands)."""
+
+    key: Any
+    initial: int = 0
+    acked_sum: int = 0
+    n_acked: int = 0
+    ambiguous: List[int] = field(default_factory=list)
+
+    def ack(self, operand: int) -> None:
+        """An acked rmw:add of ``operand`` (sub acks negated)."""
+        from riak_ensemble_tpu import funref
+        self.acked_sum = funref.i32(self.acked_sum + int(operand))
+        self.n_acked += 1
+
+    def unknown(self, operand: int) -> None:
+        """An op whose outcome is ambiguous (timeout, dropped
+        mid-ack): it applied once or not at all — never twice."""
+        self.ambiguous.append(int(operand))
+
+    def check_final(self, final: int) -> None:
+        from riak_ensemble_tpu import funref
+        reach = {funref.i32(self.initial + self.acked_sum)}
+        for op in self.ambiguous:
+            reach |= {funref.i32(v + op) for v in reach}
+        if funref.i32(int(final)) not in reach:
+            raise Violation(
+                f"counter {self.key!r} diverged: final {final!r} not "
+                f"reachable from initial {self.initial} + "
+                f"{self.n_acked} acked ops (sum {self.acked_sum}) + "
+                f"any subset of ambiguous {self.ambiguous!r}")
+
+
 class Workload:
     """Concurrent random workload + nemesis against one ensemble."""
 

@@ -118,6 +118,35 @@ RMW_BOR = funref.RMW_BOR
 RMW_BXOR = funref.RMW_BXOR
 RMW_PIA = funref.RMW_PIA
 
+# Merge-section cell codes (the commutative replication lane,
+# docs/ARCHITECTURE.md §18): a merged cell names the FOLD to apply
+# against the replica lane's own current value, not an op.
+MERGE_ADD = funref.MERGE_ADD
+MERGE_MAX = funref.MERGE_MAX
+MERGE_MIN = funref.MERGE_MIN
+MERGE_AND = funref.MERGE_AND
+MERGE_OR = funref.MERGE_OR
+
+
+def merge_vals(cur: jax.Array, mcls: jax.Array,
+               operand: jax.Array) -> jax.Array:
+    """The compiled half of the replica's merge-scatter: fold each
+    merged cell's coalesced ``operand`` into the lane's own current
+    value ``cur`` by merge class — the same int32 select ladder the
+    kv round's RMW arm runs, restricted to the order-free funs (add
+    covers sub via leader-side negation; semilattice max/min/and/or
+    fold by themselves).  Elementwise over [n] cell vectors; callers
+    gather ``cur`` from their own object plane and scatter the result
+    back, so N leader-side ops on one hot slot land as ONE lattice
+    merge with no per-entry sequencing."""
+    return jnp.select(
+        [mcls == MERGE_ADD, mcls == MERGE_MAX, mcls == MERGE_MIN,
+         mcls == MERGE_AND],
+        [cur + operand, jnp.maximum(cur, operand),
+         jnp.minimum(cur, operand), cur & operand],
+        default=cur | operand)
+
+
 #: Merkle trie fan-out (the reference's width-16 trie, synctree.erl:88).
 TREE_WIDTH = 16
 

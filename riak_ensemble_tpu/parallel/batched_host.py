@@ -990,6 +990,16 @@ class BatchedEnsembleService:
         #: the device mod-fun table served in one round
         self.rmw_conflicts = 0
         self.rmw_device_fastpath = 0
+        #: commutative replication lane (docs/ARCHITECTURE.md §18):
+        #: gates the leader's merge-section build, kmodify_many's
+        #: enqueue-side coalescing and the replicas' early acks as
+        #: one knob — =0 is the bit-identical ordered oracle arm
+        self._comm_repl = os.environ.get(
+            "RETPU_COMM_REPL", "1") != "0"
+        #: §18 enqueue-side coalescing: duplicate-key commutative ops
+        #: within one kmodify_many absorbed into an already-queued
+        #: device row (ops saved, not rows pushed)
+        self.rmw_enqueue_coalesced = 0
         #: svc_kmodify_error rate limit (a hot mod-fun bug at flush
         #: rate would otherwise emit a traceback per op per retry)
         self._kmodify_err_at = -1e9
@@ -2078,7 +2088,19 @@ class BatchedEnsembleService:
         struct-of-arrays queue entry costing one flush, conflict-free
         by construction.  Non-table funs (or keys holding host
         payloads) fall back to per-key :meth:`kmodify` chains sharing
-        the batch accumulator."""
+        the batch accumulator.
+
+        Enqueue-side coalescing (docs/ARCHITECTURE.md §18): when the
+        comm lane is on and the fun is commutative/semilattice,
+        duplicate keys in one call fold into a SINGLE device row —
+        operands merged with the same int32-exact fold the replication
+        merge section uses (sub normalizes to add of the negated
+        operand), so the slot's final value and version are bit-equal
+        to the sequenced chain's.  All members of a coalesced group
+        share the row's ('ok', vsn): the group commits or fails as
+        one op, and the version is the slot's post-group version, the
+        only one a subsequent CAS could use anyway.  Ordered funs
+        (set/bxor/put_if_absent) never coalesce."""
         from riak_ensemble_tpu import funref
 
         fut = Future()
@@ -2101,14 +2123,18 @@ class BatchedEnsembleService:
                 host_one(i, key)
             return fut
         code, operand = dev
+        coalesce = (self._comm_repl
+                    and funref.merge_class(code) is not None)
         sg = self.slot_gen[ens]
         inline = self._inline_slots[ens]
         ks = self.key_slot[ens]
         fs = self.free_slots[ens]
         slot_l: List[int] = []
-        pos_l: List[int] = []
+        ops_l: List[int] = []
         gen_l: List[int] = []
         live_keys: List[Any] = []
+        members: List[List[int]] = []   # result positions per row
+        row_of: Dict[int, int] = {}
         miss_pos: List[int] = []
         # one dict pass for key→slot + eligibility; the storage-class
         # set/slab adopt the whole batch in bulk below
@@ -2123,12 +2149,23 @@ class BatchedEnsembleService:
             if not self._rmw_eligible(ens, s):
                 host_one(i, key)  # host-payload key: per-key fallback
                 continue
+            if coalesce:
+                r = row_of.get(s)
+                if r is not None:
+                    ops_l[r] = funref.fold_operand(
+                        code, ops_l[r], operand)
+                    members[r].append(i)
+                    self.rmw_enqueue_coalesced += 1
+                    continue
+                row_of[s] = len(slot_l)
             g = sg.get(s, 0) + 1
             sg[s] = g
             slot_l.append(s)
-            pos_l.append(i)
+            ops_l.append(funref.fold_seed(code, operand) if coalesce
+                         else operand)
             gen_l.append(g)
             live_keys.append(key)
+            members.append([i])
         if slot_l:
             inline.update(slot_l)
             self._inline_np[ens, np.asarray(slot_l, np.int32)] = True
@@ -2136,33 +2173,48 @@ class BatchedEnsembleService:
             accum.fill(fut, miss_pos, ["failed"] * len(miss_pos),
                        self._safe_resolve)
         if live_keys:
-            m = len(live_keys)
-            self.rmw_device_fastpath += m
+            m = len(slot_l)
+            self.rmw_device_fastpath += sum(
+                len(mb) for mb in members)
+            # sub ships as add of the (folded) negated operand when
+            # coalescing — fold_seed/fold_operand live in the
+            # MERGE_ADD-normalized domain, so the row's fun code must
+            # match it (bit-equal value either way: cur-a-b == cur+
+            # (-(a+b)) under int32 wraparound)
+            ship_code = (funref.RMW_ADD
+                         if coalesce and code == funref.RMW_SUB
+                         else code)
             # the batch rides an INNER future so transiently-failed
             # rows (quorum blips — a device RMW cannot CAS-conflict)
             # get their remaining ``retries`` through the scalar
-            # path, same contract as kmodify
+            # path, same contract as kmodify; a failed coalesced
+            # group applied NOTHING (all-or-nothing row), so each
+            # member retrying its own single op is exact
             inner = Future()
             self._push(ens, _PendingBatch(
-                eng.OP_RMW, slot_l, [operand] * m, inner,
-                list(range(m)), live_keys, gen_l, [code] * m,
+                eng.OP_RMW, slot_l, ops_l, inner,
+                list(range(m)), live_keys, gen_l, [ship_code] * m,
                 [0] * m, _BatchAccum(m), want_vsn=True, n=m))
 
             def on_batch(results: Any) -> None:
                 if not isinstance(results, list):
-                    accum.fill(fut, pos_l, ["failed"] * len(pos_l),
+                    allp = [p for mb in members for p in mb]
+                    accum.fill(fut, allp, ["failed"] * len(allp),
                                self._safe_resolve)
                     return
-                for pos, key, r in zip(pos_l, live_keys, results):
+                for mb, key, r in zip(members, live_keys, results):
                     if (isinstance(r, tuple) and r[0] == "ok") \
                             or retries <= 1 or self._dead(ens):
-                        accum.fill(fut, [pos], [r],
+                        accum.fill(fut, mb, [r] * len(mb),
                                    self._safe_resolve)
                     else:
-                        f = self.kmodify(ens, key, mod_fun, default,
-                                         retries - 1)
-                        f.add_waiter(lambda r2, pos=pos: accum.fill(
-                            fut, [pos], [r2], self._safe_resolve))
+                        for pos in mb:
+                            f = self.kmodify(ens, key, mod_fun,
+                                             default, retries - 1)
+                            f.add_waiter(
+                                lambda r2, pos=pos: accum.fill(
+                                    fut, [pos], [r2],
+                                    self._safe_resolve))
             inner.add_waiter(on_batch)
         return fut
 
@@ -3976,6 +4028,7 @@ class BatchedEnsembleService:
             "launches_in_flight": len(self._inflight_launches),
             "rmw_conflicts": self.rmw_conflicts,
             "rmw_device_fastpath": self.rmw_device_fastpath,
+            "rmw_enqueue_coalesced": self.rmw_enqueue_coalesced,
             # lease-protected read fast path: mirror-served reads vs
             # device-round fallbacks (by reason), and what fraction of
             # live ensembles hold a margin-valid lease right now

@@ -144,7 +144,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from riak_ensemble_tpu import faults, obs, wire
+from riak_ensemble_tpu import faults, funref, obs, wire
 from riak_ensemble_tpu.config import Config
 from riak_ensemble_tpu.ops import engine as eng
 from riak_ensemble_tpu.parallel.batched_host import (
@@ -493,6 +493,63 @@ def _delta_scatter_cells(svc: BatchedEnsembleService,
         marks["rebuild"] = time.perf_counter() - t1
 
 
+_MERGE_GATHER_FN = None
+
+
+def _merge_fns():
+    """The replica's compiled merge-scatter front half: gather each
+    merged cell's CURRENT value from this lane's own object plane and
+    fold the coalesced operand into it (docs/ARCHITECTURE.md §18).
+    The back half — landing the folded values — rides the existing
+    delta cell scatter, so a merge run costs ONE extra gather program
+    over the plain delta apply."""
+    global _MERGE_GATHER_FN
+    if _MERGE_GATHER_FN is None:
+        import jax
+
+        def gather_merge(st, e_j, s_j, mcls, ops):
+            cur = st.obj_val[e_j, 0, s_j]
+            return eng.merge_vals(cur, mcls, ops)
+
+        _MERGE_GATHER_FN = jax.jit(gather_merge)
+    return _MERGE_GATHER_FN
+
+
+def _merge_gather_cells(svc: BatchedEnsembleService, e_j: np.ndarray,
+                        s_j: np.ndarray, mcls: np.ndarray,
+                        ops: np.ndarray) -> np.ndarray:
+    """Fold merged operands against the lane's PRE-RUN device values:
+    one compiled gather+merge per pow2 bucket (same capped ladder as
+    the cell scatter), blocking d2h — the folded values feed the host
+    walk's WAL records and mirrors, so this read must complete."""
+    import jax.numpy as jnp
+
+    fn = _merge_fns()
+    if svc._obs:
+        fn = svc._watched("merge_gather", fn)
+    out = np.empty(e_j.size, np.int32)
+    for off in range(0, e_j.size, _DELTA_SCATTER_CAP):
+        n = min(_DELTA_SCATTER_CAP, e_j.size - off)
+        b = 8
+        while b < n:
+            b <<= 1
+        sl = slice(off, off + n)
+
+        def pad(a):
+            # pads gather in-range cell (0, 0); their folds are
+            # discarded below
+            if b == n:
+                return jnp.asarray(np.ascontiguousarray(a[sl]))
+            return jnp.asarray(np.concatenate(
+                [a[sl], np.zeros(b - n, a.dtype)]))
+
+        r = fn(svc.state, pad(e_j.astype(np.int32)),
+               pad(s_j.astype(np.int32)), pad(mcls.astype(np.int32)),
+               pad(ops.astype(np.int32)))
+        out[sl] = np.asarray(r)[:n]
+    return out
+
+
 def warm_delta_apply(svc: BatchedEnsembleService) -> None:
     """Pre-compile the delta-apply programs — the WHOLE scatter
     bucket ladder (8..min(cap, E*S): any batch lands on a warmed
@@ -508,6 +565,9 @@ def warm_delta_apply(svc: BatchedEnsembleService) -> None:
     top = 8
     while top < min(_DELTA_SCATTER_CAP, svc.n_ens * svc.n_slots):
         top <<= 1
+    gather = _merge_fns()
+    if svc._obs:
+        gather = svc._watched("merge_gather", gather)
     svc._in_warmup = True  # compile events land under phase=warmup
     try:
         st, b = svc.state, 8
@@ -516,6 +576,8 @@ def warm_delta_apply(svc: BatchedEnsembleService) -> None:
             s_j = jnp.full((b,), svc.n_slots, jnp.int32)  # oor: drop
             z = jnp.zeros((b,), jnp.int32)
             st = scatter(st, e_j, s_j, z, z, z)
+            # merge-gather bucket (§18): reads cell (0, 0), discards
+            gather(st, z, z, z, z)
             b <<= 1
         svc.state = finish(
             st, jnp.asarray(np.asarray(st.obj_seq_ctr, np.int32)),
@@ -776,6 +838,140 @@ def build_delta_entry(seq: int, k: int, committed: Optional[np.ndarray],
     return entry, crc, nbytes
 
 
+#: RMW fun code -> "folds into a merge cell" (ordered funs and every
+#: non-RMW exp_epoch value read False; the exp_epoch plane only means
+#: a fun code on OP_RMW rows, so callers AND with the kind mask)
+_RMW_MERGEABLE = np.zeros(16, bool)
+for _code in funref.MERGE_OF:
+    _RMW_MERGEABLE[_code] = True
+del _code
+
+
+def build_comm_entry(seq: int, k: int, committed: Optional[np.ndarray],
+                     value: Optional[np.ndarray],
+                     kind: np.ndarray, slot: np.ndarray,
+                     val: np.ndarray, exp_e: Optional[np.ndarray],
+                     quorum_ok: np.ndarray, meta: List[Tuple],
+                     n_slots: int = 65536, fid: int = 0,
+                     native: Any = None
+                     ) -> Optional[Tuple[Tuple, int, int, int, int]]:
+    """Build a commutative-replication entry ("m") when the flush has
+    qualifying columns, else None (the caller ships the plain delta —
+    which keeps the RETPU_COMM_REPL=0 arm AND non-commutative traffic
+    byte-identical by construction; docs/ARCHITECTURE.md §18).
+
+    A column qualifies when EVERY committed cell in it is an OP_RMW
+    whose fun is commutative/semilattice AND each of its slots sees a
+    single merge class (sub normalizes into add; a max-then-add slot
+    stays ordered).  Qualifying columns leave the ordered sections
+    entirely and ship as per-(column, slot) COALESCED cells: the
+    folded operand, the merge class, the rank of the slot's LAST
+    committed op inside the column (its seq offset — version vectors
+    land bit-equal to the sequenced apply) and that op's round index
+    (the meta join for WAL/mirror keys).  ``m_nops`` per column
+    advances the replica's seq counter by the ops the cells absorbed.
+
+    Returns ``(entry, crc, nbytes, n_cells, n_ops)`` — crc is the
+    ordered-half CRC chained with the merge-section CRC (the ack
+    contract covers both)."""
+    if committed is None or exp_e is None or not committed.any():
+        return None
+    is_rmw = committed & (kind == eng.OP_RMW)
+    if not is_rmw.any():
+        return None
+    mergeable = is_rmw & _RMW_MERGEABLE[np.clip(exp_e, 0, 15)]
+    per_col = committed.sum(axis=0)
+    cand = (per_col > 0) & (per_col == mergeable.sum(axis=0))
+    if not cand.any():
+        return None
+    m_cols: List[int] = []
+    m_counts: List[int] = []
+    m_nops: List[int] = []
+    m_slots: List[int] = []
+    m_funs: List[int] = []
+    m_ops: List[int] = []
+    m_rl: List[int] = []
+    m_jl: List[int] = []
+    qual = np.zeros(committed.shape[1], bool)
+    n_ops_total = 0
+    fold = None
+    if native is not None:
+        fold = native.comm_fold(committed, exp_e, slot, val, cand)
+    for c in np.nonzero(cand)[0].tolist():
+        if fold is not None:
+            col = fold.get(c)
+            if col is None:
+                continue
+            cells, nops = col
+        else:
+            rows = np.nonzero(committed[:, c])[0]
+            # slot -> [merge class, folded operand, last rank, last j]
+            # in first-seen slot order (dicts preserve insertion)
+            cells_d: Dict[int, List[int]] = {}
+            ok = True
+            for rank, j in enumerate(rows.tolist()):
+                code = int(exp_e[j, c])
+                s = int(slot[j, c])
+                v = int(val[j, c])
+                mcls = funref.MERGE_OF[code]
+                cell = cells_d.get(s)
+                if cell is None:
+                    cells_d[s] = [mcls, funref.fold_seed(code, v),
+                                  rank, j]
+                elif cell[0] != mcls:
+                    ok = False  # mixed classes on one slot: ordered
+                    break
+                else:
+                    cell[1] = funref.fold_operand(code, cell[1], v)
+                    cell[2] = rank
+                    cell[3] = j
+            if not ok:
+                continue
+            nops = int(rows.size)
+            cells = [(s, cl[0], cl[1], cl[2], cl[3])
+                     for s, cl in cells_d.items()]
+        qual[c] = True
+        m_cols.append(int(c))
+        m_counts.append(len(cells))
+        m_nops.append(nops)
+        n_ops_total += nops
+        for s, mcls, acc, rank, j in cells:
+            m_slots.append(s)
+            m_funs.append(mcls)
+            m_ops.append(acc)
+            m_rl.append(rank)
+            m_jl.append(j)
+    if not qual.any():
+        return None
+    # ordered half: the SAME delta builder over the non-merge columns
+    # (native path and byte layout untouched)
+    d_entry, d_crc, d_bytes = build_delta_entry(
+        seq, k, committed & ~qual[None, :], value, kind, slot, val,
+        quorum_ok, meta, n_slots=n_slots, fid=fid, native=native)
+    j_dt = _idx_dtype(max(k, 1))
+    s_dt = _idx_dtype(n_slots)
+    sections = (np.asarray(m_cols, np.uint16),
+                np.asarray(m_counts, np.uint16),
+                np.asarray(m_nops, np.uint16),
+                np.asarray(m_slots, s_dt),
+                np.asarray(m_funs, np.uint8),
+                np.asarray(m_ops, np.int32),
+                np.asarray(m_rl, j_dt),
+                np.asarray(m_jl, j_dt))
+    mcrc = 0
+    mbytes = 0
+    for s in sections:
+        b = np.ascontiguousarray(s)
+        mcrc = zlib.crc32(b.tobytes(), mcrc)
+        mbytes += b.nbytes
+    entry = (("m",) + d_entry[1:14] + (len(m_slots),)
+             + tuple(wire.Raw(np.ascontiguousarray(s))
+                     for s in sections)
+             + (mcrc, meta, int(fid)))
+    return (entry, _crc_chain(d_crc, mcrc), d_bytes + mbytes,
+            len(m_slots), n_ops_total)
+
+
 def build_full_entry(seq: int, k: int, want_vsn: bool,
                      elect: np.ndarray, lease_ok: np.ndarray,
                      kind: np.ndarray, slot: np.ndarray,
@@ -838,6 +1034,16 @@ class ReplicaCore:
         #: hook: the owning server mirrors config changes into its
         #: failover peer list (set by ReplicaServer)
         self.on_cfg = None
+        #: commutative-lane early ack (docs/ARCHITECTURE.md §18): set
+        #: per-frame by the owning server to a send-the-ack callable.
+        #: A PURE-merge frame (every entry "m" with zero ordered
+        #: cells, no grants) fires it right after its WAL sync —
+        #: BEFORE the device scatter is even dispatched — because a
+        #: crash between the two replays the run from the WAL's
+        #: absolute-value records, the same recovery envelope the
+        #: sequenced path already proves at replica_apply_pre_ack.
+        self.early_ack = None
+        self.early_acks = 0
         #: follower-served leased reads (docs/ARCHITECTURE.md §16):
         #: this lane may answer keyed reads from its delta-maintained
         #: mirrors until ``serve_until`` (monotonic, this host's
@@ -1028,6 +1234,14 @@ class ReplicaCore:
             return ("nack", "seq", self.promised, self.applied_ge,
                     self.applied_seq)
         combined = 0
+        # §18 early-ack gate: a frame that is ONE pure-merge run (every
+        # entry "m" with zero ordered cells) and carries no grants may
+        # ack after its WAL sync, before the device scatter — the
+        # validation + meta-coverage conditions are re-checked inside
+        # the run apply, which owns the durability barrier
+        early_ok = (self.early_ack is not None and grants is None
+                    and all(e[0] == "m" and int(e[3]) == 0
+                            for e in entries))
         i, n = 0, len(entries)
         while i < n:
             ent = entries[i]
@@ -1040,16 +1254,18 @@ class ReplicaCore:
                 crc = self._apply_full_entry(ge, ent)
                 combined = _crc_chain(combined, crc)
                 i += 1
-            elif ent[0] == "d":
+            elif ent[0] in ("d", "m"):
                 # group only the CONSECUTIVE-seq prefix: a gap inside
                 # the run must stop it so the next top-of-loop check
                 # nacks "seq" with the in-order prefix applied
                 j, nxt = i, self.applied_seq + 1
-                while j < n and entries[j][0] == "d" \
+                while j < n and entries[j][0] in ("d", "m") \
                         and int(entries[j][1]) == nxt:
                     j += 1
                     nxt += 1
-                crcs = self._apply_delta_run(ge, entries[i:j])
+                crcs = self._apply_delta_run(
+                    ge, entries[i:j],
+                    early=early_ok and i == 0 and j == n)
                 if crcs is None:
                     if grants is not None:
                         self._flw_drop()
@@ -1072,8 +1288,8 @@ class ReplicaCore:
                 grants)
         return ("applied", ge, self.applied_seq, combined)
 
-    def _apply_delta_run(self, ge: int,
-                         run: Sequence[Tuple]) -> Optional[List[int]]:
+    def _apply_delta_run(self, ge: int, run: Sequence[Tuple],
+                         early: bool = False) -> Optional[List[int]]:
         """Apply consecutive changed-slot delta entries IN PLACE — no
         device re-execution.  Everything the full launch would have
         produced is derived from this lane's own (bit-equal) state:
@@ -1082,7 +1298,19 @@ class ReplicaCore:
         scattered objects.  The WHOLE run lands through one device
         scatter, one tree rebuild and one WAL sync (the batched apply
         economics).  Returns per-entry CRCs, or None on a section-CRC
-        or shape violation (the leader re-syncs)."""
+        or shape violation (the leader re-syncs).
+
+        "m" entries (§18) additionally carry merge sections: coalesced
+        commutative/semilattice cells folded against this lane's OWN
+        current value through the compiled merge gather — a cell whose
+        slot was already written earlier in the run folds host-side
+        from that value instead (the device still holds the pre-run
+        plane until the single end-of-run scatter).  ``early=True``
+        (a pure-merge frame) reorders the tail to WAL -> ack -> scatter
+        and fires ``self.early_ack`` with the frame's cumulative CRC,
+        provided every merge cell is covered by a meta row (its final
+        value must be in the WAL for the pre-scatter ack to be
+        durable)."""
         svc = self.svc
         e_n = svc.n_ens
         epoch_np = np.asarray(svc.state.epoch[:, 0], np.int32)
@@ -1105,11 +1333,21 @@ class ReplicaCore:
         # or-nothing keeps the advertised position truthful.
         t_start = time.perf_counter()
         decoded = []
+        meta_covered = True
         for ent in run:
+            merge_b = None
             try:
-                (_, seq, _k, nc, jw, sw, cols_b, counts_b, jj_b,
-                 slots_b, vals_b, rmw_b, q_b, crc_ship, meta,
-                 fid) = ent
+                if ent[0] == "m":
+                    (_, seq, _k, nc, jw, sw, cols_b, counts_b, jj_b,
+                     slots_b, vals_b, rmw_b, q_b, crc_ship, mc,
+                     mcols_b, mcounts_b, mnops_b, mslots_b, mfuns_b,
+                     mops_b, mrl_b, mjl_b, mcrc_ship, meta, fid) = ent
+                    merge_b = (mcols_b, mcounts_b, mnops_b, mslots_b,
+                               mfuns_b, mops_b, mrl_b, mjl_b)
+                else:
+                    (_, seq, _k, nc, jw, sw, cols_b, counts_b, jj_b,
+                     slots_b, vals_b, rmw_b, q_b, crc_ship, meta,
+                     fid) = ent
             except ValueError:
                 return None
             if int(jw) not in (1, 2) or int(sw) not in (1, 2):
@@ -1150,15 +1388,130 @@ class ReplicaCore:
                 return None
             if any(e < 0 or e >= e_n for _, e, _k2, _h, _p in meta):
                 return None
-            decoded.append((int(seq), int(crc_ship), cols, counts,
+            merge = None
+            ecrc = int(crc_ship)
+            if merge_b is not None:
+                # merge sections (§18): own CRC, all-or-nothing with
+                # the run; the entry's ack CRC chains both halves
+                try:
+                    mcols = np.frombuffer(_buf(merge_b[0]), np.uint16)
+                    mcounts = np.frombuffer(_buf(merge_b[1]),
+                                            np.uint16)
+                    mnops = np.frombuffer(_buf(merge_b[2]), np.uint16)
+                    mslots = np.frombuffer(_buf(merge_b[3]), s_dt)
+                    mfuns = np.frombuffer(_buf(merge_b[4]), np.uint8)
+                    mops = np.frombuffer(_buf(merge_b[5]), np.int32)
+                    mrl = np.frombuffer(_buf(merge_b[6]), j_dt)
+                    mjl = np.frombuffer(_buf(merge_b[7]), j_dt)
+                except ValueError:
+                    return None
+                mcrc = 0
+                for b in (mcols, mcounts, mnops, mslots, mfuns, mops,
+                          mrl, mjl):
+                    mcrc = zlib.crc32(b.tobytes(), mcrc)
+                mc = int(mc)
+                mc64 = mcols.astype(np.int64)
+                if (mcrc != int(mcrc_ship) or mc < 1
+                        or mslots.size != mc or mfuns.size != mc
+                        or mops.size != mc or mrl.size != mc
+                        or mjl.size != mc
+                        or mcols.size != mcounts.size
+                        or mcols.size != mnops.size
+                        or int(mcounts.sum()) != mc
+                        or int(mcols.max()) >= e_n
+                        or int(mslots.max()) >= svc.n_slots
+                        or int(mfuns.max()) > funref.MERGE_OR
+                        or not bool((mcounts >= 1).all())
+                        or not bool((mnops.astype(np.int64)
+                                     >= mcounts).all())
+                        or int(mjl.max()) >= max(int(_k), 1)
+                        or (mcols.size > 1
+                            and not bool((np.diff(mc64) > 0).all()))
+                        or np.intersect1d(mcols, cols).size
+                        or not bool((mrl.astype(np.int64)
+                                     < np.repeat(
+                                         mnops.astype(np.int64),
+                                         mcounts)).all())):
+                    return None
+                merge = (mcols, mcounts, mnops, mslots, mfuns, mops,
+                         mrl, mjl)
+                ecrc = _crc_chain(int(crc_ship), mcrc)
+                if meta_covered:
+                    # early-ack durability precondition: every merge
+                    # cell's final value must land in the WAL via its
+                    # meta row
+                    je = {(j, e) for j, e, _k2, _h, _p in meta}
+                    ccol = np.repeat(mcols, mcounts)
+                    meta_covered = all(
+                        (int(mjl[x]), int(ccol[x])) in je
+                        for x in range(mc))
+            decoded.append((int(seq), ecrc, cols, counts,
                             jj, slots, vals, rmwb, qb, meta,
-                            int(fid)))
+                            int(fid), merge))
         t_validated = time.perf_counter()
+        # §18 merge resolution: walk the run in order simulating slot
+        # state so each merged cell folds against the value the
+        # SEQUENCED apply would have seen — the compiled gather+merge
+        # covers first-touch cells (device still pre-run), chained
+        # cells fold host-side from the walk.
+        mvals: List[Optional[np.ndarray]] = [None] * len(decoded)
+        if any(d[11] is not None for d in decoded):
+            simst: Dict[Tuple[int, int], Tuple] = {}
+            chains: List[Tuple[int, int, List[Tuple]]] = []
+            for d_i, d in enumerate(decoded):
+                (_seq, _ec, cols, counts, jj, slots, vals, rmwb, qb,
+                 meta, _fid, merge) = d
+                pos = 0
+                for c_i, cnt in zip(cols.tolist(), counts.tolist()):
+                    for r_i in range(cnt):
+                        simst[(c_i, int(slots[pos + r_i]))] = \
+                            ("v", int(vals[pos + r_i]))
+                    pos += cnt
+                if merge is None:
+                    continue
+                (mcols, mcounts, mnops, mslots, mfuns, mops, mrl,
+                 mjl) = merge
+                mv = np.zeros(mslots.size, np.int32)
+                mvals[d_i] = mv
+                ccol = np.repeat(mcols, mcounts).tolist()
+                for x in range(mslots.size):
+                    c_i = int(ccol[x])
+                    s_i = int(mslots[x])
+                    mcls = int(mfuns[x])
+                    op = int(mops[x])
+                    st_ = simst.get((c_i, s_i))
+                    if st_ is None:
+                        chain: List[Tuple] = [(d_i, x, mcls, op)]
+                        chains.append((c_i, s_i, chain))
+                        simst[(c_i, s_i)] = ("p", chain)
+                    elif st_[0] == "v":
+                        v = funref.merge_apply(mcls, st_[1], op)
+                        mv[x] = v
+                        simst[(c_i, s_i)] = ("v", v)
+                    else:
+                        st_[1].append((d_i, x, mcls, op))
+            if chains:
+                head_vals = _merge_gather_cells(
+                    svc,
+                    np.asarray([c for c, _s, _ch in chains], np.int32),
+                    np.asarray([s for _c, s, _ch in chains], np.int32),
+                    np.asarray([ch[0][2] for _c, _s, ch in chains],
+                               np.int32),
+                    np.asarray([ch[0][3] for _c, _s, ch in chains],
+                               np.int32))
+                for (c_i, s_i, chain), hv in zip(chains,
+                                                 head_vals.tolist()):
+                    v = int(hv)
+                    d_i, x, _mcls, _op = chain[0]
+                    mvals[d_i][x] = v
+                    for d_i2, x2, mcls2, op2 in chain[1:]:
+                        v = funref.merge_apply(mcls2, v, op2)
+                        mvals[d_i2][x2] = v
 
         # Apply pass: nothing below can fail validation — mutations
         # land for the whole run or not at all.
-        for (seq, crc_ship, cols, counts, jj, slots, vals, rmwb, qb,
-             meta, _fid) in decoded:
+        for d_i, (seq, crc_ship, cols, counts, jj, slots, vals, rmwb,
+                  qb, meta, _fid, merge) in enumerate(decoded):
             # committed cells, column-grouped in round order: derive
             # each cell's (epoch, seq) exactly as the kernel assigns
             # them (obj_sequence: consecutive per column)
@@ -1179,6 +1532,32 @@ class ReplicaCore:
                 ctr_np[c_i] = base + cnt
                 touched[c_i] = True
                 pos += cnt
+            if merge is not None:
+                # merged columns (§18): each cell lands its FOLDED
+                # value at the seq of its slot's last absorbed op
+                # (base + rank + 1 — bit-equal version vectors), and
+                # the column's counter advances by every op the cells
+                # absorbed, exactly as the sequenced kernel would
+                (mcols, mcounts, mnops, mslots, mfuns, mops, mrl,
+                 mjl) = merge
+                mv = mvals[d_i]
+                pos = 0
+                for c_i, cnt, nops in zip(mcols.tolist(),
+                                          mcounts.tolist(),
+                                          mnops.tolist()):
+                    ep = int(epoch_np[c_i])
+                    base = int(ctr_np[c_i])
+                    for r_i in range(cnt):
+                        idx = pos + r_i
+                        s_i = int(mslots[idx])
+                        vl = int(mv[idx])
+                        sq = base + int(mrl[idx]) + 1
+                        final[(c_i, s_i)] = (ep, sq, vl)
+                        cell[(int(mjl[idx]), c_i)] = (ep, sq, vl,
+                                                      True, s_i)
+                    ctr_np[c_i] = base + nops
+                    touched[c_i] = True
+                    pos += cnt
             # keyed WAL records + host mirrors: the same meta-driven
             # iteration the full-plane path runs
             for j, e, key, handle, payload in meta:
@@ -1208,29 +1587,63 @@ class ReplicaCore:
             self._flw_collect.update(np.nonzero(touched)[0].tolist())
         t_applied = time.perf_counter()
         marks: Dict[str, float] = {}
-        if final:
-            cells = np.asarray(
-                [(e, s, ep, sq, vl)
-                 for (e, s), (ep, sq, vl) in final.items()], np.int32)
-            rows = np.zeros((e_n, svc.n_peers), bool)
-            rows[touched] = True
-            _delta_scatter_cells(svc, cells, ctr_np, rows,
-                                 marks=marks if svc._obs else None)
+
+        def _scatter() -> None:
+            if final:
+                cells = np.asarray(
+                    [(e, s, ep, sq, vl)
+                     for (e, s), (ep, sq, vl) in final.items()],
+                    np.int32)
+                rows = np.zeros((e_n, svc.n_peers), bool)
+                rows[touched] = True
+                _delta_scatter_cells(svc, cells, ctr_np, rows,
+                                     marks=marks if svc._obs else None)
+
+        def _wal_sync() -> None:
+            if svc._wal is not None:
+                svc._wal.log(recs)
+                if svc._wal.count >= svc.wal_compact_records:
+                    rebuild_derived(svc)
+                    svc.save()
+                    save_group_meta(svc, self.promised,
+                                    self.applied_ge, self.applied_seq,
+                                    self.cfg)
+
         # Durability barrier: one log()/sync covers every entry of the
         # run + the advanced group meta, BEFORE the cumulative ack.
         recs.append((_GRP_KEY, (self.promised, self.applied_ge,
                                 self.applied_seq, self.cfg)))
-        t_scattered = time.perf_counter()
-        if svc._wal is not None:
-            svc._wal.log(recs)
-            if svc._wal.count >= svc.wal_compact_records:
-                rebuild_derived(svc)
-                svc.save()
-                save_group_meta(svc, self.promised, self.applied_ge,
-                                self.applied_seq, self.cfg)
-        # §15 crash barrier: the run is durable, the ack is not yet
-        # on the wire — the classic replica-crash recovery point
-        faults.crashpoint("replica_apply_pre_ack")
+        if (early and self.early_ack is not None and meta_covered
+                and svc._wal is not None):
+            # §18 early ack: WAL first, ack on the wire, THEN the
+            # device scatter dispatch.  A crash between ack and
+            # scatter replays every merged final from the WAL's
+            # absolute-value records — the same recovery point the
+            # sequenced path proves below; the scatter was async-
+            # dispatched before the ack anyway (never completion-
+            # barriered), so the client-visible guarantee is
+            # unchanged, only the wire ack stops waiting for the
+            # dispatch.
+            t_scattered = time.perf_counter()
+            _wal_sync()
+            t_wal = time.perf_counter() - t_scattered
+            faults.crashpoint("replica_apply_pre_ack")
+            combined = 0
+            for c in crcs:
+                combined = _crc_chain(combined, c)
+            self.early_acks += 1
+            self.early_ack(("applied", int(ge),
+                            int(self.applied_seq), combined))
+            _scatter()
+        else:
+            _scatter()
+            t_scattered = time.perf_counter()
+            _wal_sync()
+            t_wal = time.perf_counter() - t_scattered
+            # §15 crash barrier: the run is durable, the ack is not
+            # yet on the wire — the classic replica-crash recovery
+            # point
+            faults.crashpoint("replica_apply_pre_ack")
         if svc._obs:
             # replica half of the cross-process flush trace: every
             # entry's spans record under the LEADER's flush id (the
@@ -1239,14 +1652,13 @@ class ReplicaCore:
             # leader's enqueue/build/ship spans.  Run-shared passes
             # (validate, the one coalesced scatter + WAL sync) are
             # charged to the run and marked with its size.
-            t_wal = time.perf_counter() - t_scattered
             n_run = len(decoded)
             # fleet alignment anchor: spans lay out ENDING at this
             # record-time stamp on THIS host's monotonic clock (the
             # clock the leader's per-link offset estimate maps from)
             t_mono = time.monotonic()
             for (seq, _c, _cols, _cnt, _jj, _s, _v, _r, _q, _m,
-                 fid) in decoded:
+                 fid, _mg) in decoded:
                 obs.SPANS.record(
                     fid, self._obs_role(),
                     [("validate", t_validated - t_start),
@@ -2407,6 +2819,14 @@ class ReplicatedService(BatchedEnsembleService):
                             "follower_lease_write_blocks": 0,
                             "follower_reads_served": 0,
                             "follower_reads_blocked": 0}
+        #: commutative-lane counters (docs/ARCHITECTURE.md §18) —
+        #: kept OUT of group_stats so their metric names are the
+        #: documented ``retpu_repl_*`` family, not auto-prefixed
+        #: ``retpu_group_*`` rows
+        self.comm_stats = {"repl_merge_entries": 0,
+                           "repl_merge_cells": 0,
+                           "repl_merge_ops": 0,
+                           "repl_early_acks": 0}
         # group-level metrics join the service's registry (the
         # svcnode `metrics` verb and the docs ratchet see one plane)
         self.obs_registry.collect(self._obs_group_collect)
@@ -2457,6 +2877,20 @@ class ReplicatedService(BatchedEnsembleService):
                 "counter",
                 "replication group stat (see stats()['group'])",
                 round(val, 6) if isinstance(val, float) else val)
+        # commutative replication lane (§18): always registered, so
+        # the RETPU_COMM_REPL=0 arm exports the same (zeroed) names
+        cs = self.comm_stats
+        out["retpu_repl_merge_cells"] = fam(
+            "counter", "coalesced merge cells shipped (§18)",
+            cs["repl_merge_cells"])
+        out["retpu_repl_early_acks"] = fam(
+            "counter",
+            "pure-commutative entries settled on early acks (§18)",
+            cs["repl_early_acks"])
+        out["retpu_repl_merge_coalesce_ratio"] = fam(
+            "gauge", "committed RMW ops absorbed per merge cell",
+            round(cs["repl_merge_ops"]
+                  / max(cs["repl_merge_cells"], 1), 6))
         return out
 
     # -- fleet-scope observability (docs/ARCHITECTURE.md §11) ---------------
@@ -3143,10 +3577,27 @@ class ReplicatedService(BatchedEnsembleService):
                     and not bool(elect.any())
                     and self.corruptions == fl.grp_corr0)
         if delta_ok:
-            entry_t, crc, nbytes = build_delta_entry(
-                seq, fl.k, committed, value, kind, slot, val,
-                fl.quorum_np, meta, n_slots=self.n_slots,
-                fid=fl.flush_id, native=self._native_resolve)
+            comm = None
+            if self._comm_repl:
+                # §18 commutative fast lane: columns whose committed
+                # cells are all mergeable RMWs ship coalesced merge
+                # sections; anything else (including the knob-off
+                # arm) falls through to the byte-identical plain
+                # delta builder
+                comm = build_comm_entry(
+                    seq, fl.k, committed, value, kind, slot, val,
+                    exp_e, fl.quorum_np, meta, n_slots=self.n_slots,
+                    fid=fl.flush_id, native=self._native_resolve)
+            if comm is not None:
+                entry_t, crc, nbytes, n_cells, n_ops = comm
+                self.comm_stats["repl_merge_entries"] += 1
+                self.comm_stats["repl_merge_cells"] += n_cells
+                self.comm_stats["repl_merge_ops"] += n_ops
+            else:
+                entry_t, crc, nbytes = build_delta_entry(
+                    seq, fl.k, committed, value, kind, slot, val,
+                    fl.quorum_np, meta, n_slots=self.n_slots,
+                    fid=fl.flush_id, native=self._native_resolve)
             self.group_stats["repl_delta_entries"] += 1
         else:
             entry_t, nbytes = build_full_entry(
@@ -3665,6 +4116,13 @@ class ReplicatedService(BatchedEnsembleService):
             self.group_stats["repl_ack_s"] += \
                 time.monotonic() - batch.ship_t
             self.group_stats["repl_acked_batches"] += 1
+            for entry in batch.entries:
+                # §18: pure-merge entries in a single-run frame are
+                # the ones replicas could ack pre-scatter — the
+                # quorum-confirmed settle is where that early path
+                # becomes client-visible
+                if entry.entry[0] == "m" and int(entry.entry[3]) == 0:
+                    self.comm_stats["repl_early_acks"] += 1
         else:
             self._host_lease_until = 0.0
             self.group_stats["quorum_failures"] += 1
@@ -3907,11 +4365,16 @@ class ReplicatedService(BatchedEnsembleService):
             "repl_window": self.repl_window,
             "pipeline_pending": self._outstanding(),
             "repl_delta": self._repl_delta and self._delta_shape_ok,
+            "comm_repl": bool(self._comm_repl),
             "trust_host_lease": self.trust_host_lease,
             "host_lease_valid": bool(
                 self._host_lease_until
                 > self.runtime.now + self._read_margin),
             **self.group_stats,
+            **self.comm_stats,
+            "repl_merge_coalesce_ratio": round(
+                self.comm_stats["repl_merge_ops"]
+                / max(self.comm_stats["repl_merge_cells"], 1), 6),
         }
         return s
 
@@ -4081,6 +4544,9 @@ class ReplicaServer:
                 frame = recv_frame(sock)
             except (ConnectionError, OSError, wire.WireError):
                 return
+            #: §18 early-ack outcome for THIS frame (reset every
+            #: iteration: a stale entry must never suppress a reply)
+            fired: List[bool] = []
             try:
                 if frame and frame[0] == "promote":
                     # promotion runs OUTSIDE the big lock: a campaign
@@ -4102,13 +4568,38 @@ class ReplicaServer:
                     # the round-trip bound for nothing)
                     resp = self._handle_obsq(frame)
                 else:
+                    # §18 early ack: arm the core's pre-scatter send
+                    # hook for abatch frames (under the big lock, so
+                    # at most one frame's hook is live); ``fired``
+                    # records the outcome so the reply path below
+                    # never double-sends the ack
+                    arm = (frame and frame[0] == "abatch"
+                           and self.svc._comm_repl
+                           and not self._campaign)
+
+                    def _early(resp_t, _s=sock, _f=fired):
+                        try:
+                            send_frame(_s, resp_t)
+                            _f.append(True)
+                        except (ConnectionError, OSError):
+                            _f.append(False)
+
                     with self._lock:
-                        resp = self._handle_repl(frame)
+                        if arm:
+                            self.core.early_ack = _early
+                        try:
+                            resp = self._handle_repl(frame)
+                        finally:
+                            self.core.early_ack = None
             except Exception:
                 import traceback
                 self.svc._emit("grp_replica_error",
                                {"error": traceback.format_exc(limit=8)})
                 resp = ("error", "internal")
+            if fired:
+                if not fired[0]:
+                    return  # the early send hit a dead socket
+                continue  # ack already on the wire
             try:
                 send_frame(sock, resp)
             except (ConnectionError, OSError):

@@ -58,6 +58,26 @@ def _pt(a: Optional[np.ndarray]):
     return None if a is None else a.ctypes.data_as(ctypes.c_void_p)
 
 
+_MERGE_LUT: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+
+def _merge_lut() -> Tuple[np.ndarray, np.ndarray]:
+    """(merge_of[16], negate[16]) LUTs for the native comm fold —
+    built from :mod:`riak_ensemble_tpu.funref`'s classification so C
+    never hard-codes the RMW fun table (merge-CLASS codes it does pin:
+    they are the wire's cell-fun bytes)."""
+    global _MERGE_LUT
+    if _MERGE_LUT is None:
+        from riak_ensemble_tpu import funref
+        merge_of = np.full((16,), -1, np.int32)
+        for code, mcls in funref.MERGE_OF.items():
+            merge_of[code] = mcls
+        negate = np.zeros((16,), np.uint8)
+        negate[funref.RMW_SUB] = 1
+        _MERGE_LUT = (merge_of, negate)
+    return _MERGE_LUT
+
+
 class NativeResolve:
     """Thin, allocation-explicit wrapper over the C ABI.  Every method
     returns numpy arrays shaped exactly like its Python-fallback
@@ -221,3 +241,56 @@ class NativeResolve:
                 jj[:ncells].copy(), slots[:ncells].copy(),
                 vals[:ncells].copy(), rmw_b[:(ncells + 7) // 8].copy(),
                 q_b.copy(), int(crc.value))
+
+    # -- 5) commutative-lane fold ---------------------------------------
+
+    def comm_fold(self, committed: np.ndarray, exp_e: np.ndarray,
+                  slot: np.ndarray, val: np.ndarray,
+                  cand: np.ndarray) -> Optional[dict]:
+        """The per-candidate-column coalescing fold of
+        :func:`repgroup.build_comm_entry` (ARCHITECTURE §18), one C
+        pass.  Returns ``{col: (cells, n_ops)}`` where cells =
+        ``[(slot, merge_class, folded_operand, last_rank, last_j),
+        ...]`` in first-seen slot order and candidate columns
+        disqualified by a mixed-class slot are ABSENT — or None when
+        the loaded library predates the symbol (the caller runs the
+        Python fold, which is also the equivalence oracle)."""
+        fn = getattr(self._lib, "retpu_comm_fold", None)
+        if fn is None:
+            return None
+        k, e_dim = committed.shape
+        committed_u8 = np.ascontiguousarray(committed, np.uint8)
+        ncap = max(int(committed_u8.sum()), 1)
+        merge_of, negate = _merge_lut()
+        out_cols = np.empty((e_dim,), np.int32)
+        out_counts = np.empty((e_dim,), np.int32)
+        out_nops = np.empty((e_dim,), np.int32)
+        out_slots = np.empty((ncap,), np.int32)
+        out_funs = np.empty((ncap,), np.uint8)
+        out_ops = np.empty((ncap,), np.int32)
+        out_rl = np.empty((ncap,), np.int32)
+        out_jl = np.empty((ncap,), np.int32)
+        meta = np.zeros((2,), np.int64)
+        rc = fn(
+            int(k), int(e_dim), _pt(committed_u8),
+            _pt(np.ascontiguousarray(exp_e, np.int32)),
+            _pt(np.ascontiguousarray(slot, np.int32)),
+            _pt(np.ascontiguousarray(val, np.int32)),
+            _pt(np.ascontiguousarray(cand, np.uint8)),
+            _pt(merge_of), _pt(negate),
+            _pt(out_cols), _pt(out_counts), _pt(out_nops),
+            _pt(out_slots), _pt(out_funs), _pt(out_ops),
+            _pt(out_rl), _pt(out_jl), _pt(meta))
+        if rc != 0:
+            return None
+        out = {}
+        pos = 0
+        for i in range(int(meta[0])):
+            cnt = int(out_counts[i])
+            out[int(out_cols[i])] = (
+                [(int(out_slots[x]), int(out_funs[x]),
+                  int(out_ops[x]), int(out_rl[x]), int(out_jl[x]))
+                 for x in range(pos, pos + cnt)],
+                int(out_nops[i]))
+            pos += cnt
+        return out

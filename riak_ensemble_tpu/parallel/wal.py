@@ -12,7 +12,12 @@ restart.
 
 The store is *latest-record-per-(ensemble, slot)* — not a strictly
 ordered log — because that is all recovery needs: the newest committed
-(epoch, seq, payload) per slot, plus committed membership rows.  The
+(epoch, seq, payload) per slot, plus committed membership rows.
+Commutative-lane merge cells (docs/ARCHITECTURE.md §18) need no new
+record kind for the same reason: the apply writes the slot's ABSOLUTE
+post-merge value (never an operand delta) at the section's high-water
+(epoch, seq), so latest-per-key replay reconstructs exactly the state
+a sequenced apply would have logged.  The
 C++ treestore (``native/treestore.cc``: CRC-framed append log +
 in-memory ordered index + snapshot compaction) provides those
 semantics natively and is used when the toolchain is available;

@@ -176,6 +176,10 @@ class LeaderLink:
                     continue
             if r == ServiceClient.DISCONNECTED:
                 await self._drop(c)
+                # writes — kmodify/kmodify_many included (NOT
+                # idempotent: an ambiguous drop may have committed,
+                # and a retried RMW double-applies) — surface the
+                # ambiguity to the proxy client unchanged
                 if (op in ServiceClient.IDEMPOTENT_OPS
                         and not retried_disconnect
                         and time.monotonic() < deadline):

@@ -466,7 +466,15 @@ class ServiceClient:
     committed would double-apply."""
 
     #: side-effect-free verbs a mid-flight connection loss may safely
-    #: re-issue (exactly once) after reconnecting
+    #: re-issue (exactly once) after reconnecting.  ``kmodify`` /
+    #: ``kmodify_many`` are deliberately NOT here and must never be
+    #: added: a modify is a read-modify-WRITE, so an ambiguous drop
+    #: after which the first attempt may have committed would
+    #: double-apply on retry (rmw:add applied twice is a wrong
+    #: counter — unlike a CAS, nothing downstream rejects the
+    #: duplicate).  The §18 commutative lane raises the stakes: its
+    #: early ack makes RMW storms the hot ambiguous-drop shape.
+    #: tests/test_comm_repl.py pins this set's write-free-ness.
     IDEMPOTENT_OPS = frozenset({
         "kget", "kget_vsn", "kget_many", "kget_slab",
         "stats", "health", "metrics"})

@@ -192,5 +192,14 @@ def load_resolve() -> Optional[ctypes.CDLL]:
                 lib.retpu_enqueue_gather.argtypes = [
                     ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
                     p, p, p, p, p, p, p, p, p, p, p, p, p]
+        # commutative-lane fold (ARCHITECTURE §18) — probed: a stale
+        # .so predating it still serves the other halves;
+        # resolve_native's comm_fold returns None when the symbol is
+        # absent and the Python fold runs instead.
+        if hasattr(lib, "retpu_comm_fold"):
+            p = ctypes.c_void_p
+            lib.retpu_comm_fold.restype = ctypes.c_int
+            lib.retpu_comm_fold.argtypes = (
+                [ctypes.c_int32, ctypes.c_int32] + [p] * 16)
         _resolve_lib = lib
         return _resolve_lib

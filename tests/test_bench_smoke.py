@@ -311,6 +311,67 @@ def test_bench_trend_polices_ingress_x(tmp_path):
     assert rows[2]["ingress_x"] is None
 
 
+def test_commrepl_rung_smoke():
+    """The --stage commrepl runner (§18): the contended-counter
+    storm, comm lane vs ordered A/B on an in-process 3-host group.
+    The smoke pins that both arms RUN, the comm arm really shipped
+    merge entries and settled early acks, both arms converge to the
+    identical final KV state, and the bytes-per-entry tripwire: on
+    the hot-slot shape the coalesced merge stream must undercut the
+    ordered delta stream per entry — a layout regression that
+    re-inflates the merge section fails tier-1 here."""
+    out = bench.run_commrepl(0.5, smoke=True)
+    assert out["commrepl_ops_per_sec"] > 0
+    assert out["commrepl_ack_p99_ms"] >= out["commrepl_ack_p50_ms"] \
+        >= 0
+    assert out["commrepl_merge_entries"] > 0, out
+    assert out["commrepl_merge_cells"] > 0, out
+    assert out["commrepl_early_acks"] > 0, out
+    assert out["commrepl_coalesce_ratio"] >= 1.0
+    assert out["rmw_comm_x"] > 0
+    assert out["commrepl_convergence_ok"] is True, out
+    assert (out["commrepl_bytes_per_entry"]
+            < out["commrepl_ordered_bytes_per_entry"]), out
+    assert out["commrepl_shape"]["smoke"] is True
+
+
+def test_bench_trend_polices_rmw_comm_x(tmp_path):
+    """The rmw_comm_x column's ratchet (ISSUE 18): higher-is-better,
+    so a same-box comm-lane collapse below tolerance x the best
+    earlier round trips --check; rounds predating the stage neither
+    ratchet nor fail."""
+    import json
+
+    import pytest as _pytest
+
+    from tools import bench_trend
+
+    box = {"cpu_count": 2, "jax": "j", "jaxlib": "jl",
+           "platform": "p"}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"parsed": {"value": 100.0, "box": box, "rmw_comm_x": 2.0}}))
+    # regression: 0.6x vs best 2.0x at tolerance 0.5 (half-of-best)
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"parsed": {"value": 100.0, "box": box, "rmw_comm_x": 0.6}}))
+    with _pytest.raises(bench_trend.TrendError):
+        bench_trend.check(str(tmp_path), tolerance=0.5)
+    # inside the band: ok, and the report names the comparison
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"parsed": {"value": 100.0, "box": box, "rmw_comm_x": 1.5}}))
+    rep = bench_trend.check(str(tmp_path), tolerance=0.5)
+    assert rep["best_same_box_rmw_comm_x"] == 2.0
+    assert rep["newest_rmw_comm_x"] == 1.5
+    # a newest round predating the stage (no rmw_comm_x) passes
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(
+        {"parsed": {"value": 100.0, "box": box}}))
+    bench_trend.check(str(tmp_path), tolerance=0.5)
+    # the column renders in the trajectory
+    rows = bench_trend.trajectory(bench_trend.load_rounds(
+        str(tmp_path)))
+    assert rows[0]["rmw_comm_x"] == 2.0
+    assert rows[2]["rmw_comm_x"] is None
+
+
 def test_bench_smoke_trend_tripwire():
     """The current smoke rung vs the best same-fingerprint recorded
     point (BENCH_SMOKE_TREND.json), within a tolerance band: a

@@ -96,6 +96,11 @@ COLUMNS: Tuple[Tuple[str, str], ...] = (
     # same-fingerprint — and the fingerprint includes device_count,
     # so points from different mesh widths never compare (§17)
     ("escale_eff", "esc_eff"),
+    # commutative-lane advantage on the contended-counter storm:
+    # ordered ack p50 / comm ack p50 (bench --stage commrepl; §18).
+    # HIGHER is better; --check polices it same-fingerprint like the
+    # headline, rounds predating the stage exempt
+    ("rmw_comm_x", "comm_x"),
 )
 
 
@@ -327,6 +332,24 @@ def check(root: str, tolerance: float = 0.5) -> Dict[str, Any]:
                         f"{newest['round']} escale efficiency "
                         f"{eff_v:.2f} is below {tolerance:.0%} of "
                         f"the best same-box {best_eff:.2f}")
+            # rmw_comm_x ratchet (ISSUE 18): the commutative lane's
+            # ack-latency advantage on the contended-counter storm is
+            # higher-is-better like the headline.  Rounds predating
+            # the stage (no rmw_comm_x) neither ratchet nor fail.
+            cx_v = newest["parsed"].get("rmw_comm_x")
+            cx_same = [r["parsed"]["rmw_comm_x"] for r in same
+                       if isinstance(r["parsed"].get("rmw_comm_x"),
+                                     (int, float))]
+            if isinstance(cx_v, (int, float)) and cx_same:
+                best_cx = max(cx_same)
+                report["best_same_box_rmw_comm_x"] = best_cx
+                report["newest_rmw_comm_x"] = cx_v
+                if cx_v < tolerance * best_cx:
+                    raise TrendError(
+                        f"out-of-band comm-lane regression: round "
+                        f"{newest['round']} rmw_comm_x "
+                        f"{cx_v:.2f}x is below {tolerance:.0%} of "
+                        f"the best same-box {best_cx:.2f}x")
     return report
 
 
